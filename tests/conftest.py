@@ -1,0 +1,20 @@
+"""Test harness configuration.
+
+Runs the suite on a virtual 8-device CPU mesh — the JAX idiom for exercising
+pjit/shard_map parallelism without TPU hardware (SURVEY.md §4e).
+
+Note: this environment ships an `axon` TPU plugin that force-selects itself
+via `jax.config.update("jax_platforms", ...)` at registration, so the
+JAX_PLATFORMS env var alone is not enough — we must override the config knob
+after importing jax, before any backend is initialized.
+"""
+
+import os
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,66 @@
+"""Independent numpy reference DWT used to cross-check the JAX implementation.
+
+Implements pywt's single-level convolution semantics by direct indexing (a
+deliberately different code path from the XLA strided-conv implementation):
+extend the signal by L-1 per side, correlate with the flipped decomposition
+filter, keep odd output positions. Verified by hand against haar closed forms
+in tests/test_dwt.py.
+"""
+
+import numpy as np
+
+
+def _extend(x: np.ndarray, pad: int, mode: str) -> np.ndarray:
+    if mode == "zero":
+        return np.pad(x, pad, mode="constant")
+    if mode == "constant":
+        return np.pad(x, pad, mode="edge")
+    if mode == "symmetric":
+        return np.pad(x, pad, mode="symmetric")
+    if mode == "reflect":
+        return np.pad(x, pad, mode="reflect")
+    if mode == "periodic":
+        return np.pad(x, pad, mode="wrap")
+    raise ValueError(mode)
+
+
+def ref_dwt1(x, dec_lo, dec_hi, mode="symmetric"):
+    L = len(dec_lo)
+    ext = _extend(np.asarray(x, dtype=np.float64), L - 1, mode)
+    flip_lo, flip_hi = dec_lo[::-1], dec_hi[::-1]
+    n_full = len(ext) - L + 1
+    corr_lo = np.array([np.dot(ext[i : i + L], flip_lo) for i in range(n_full)])
+    corr_hi = np.array([np.dot(ext[i : i + L], flip_hi) for i in range(n_full)])
+    return corr_lo[1::2], corr_hi[1::2]
+
+
+def ref_idwt1(cA, cD, rec_lo, rec_hi):
+    L = len(rec_lo)
+    n = len(cA)
+    up_a = np.zeros(2 * n - 1)
+    up_a[::2] = cA
+    up_d = np.zeros(2 * n - 1)
+    up_d[::2] = cD
+    full = np.convolve(up_a, rec_lo) + np.convolve(up_d, rec_hi)
+    if L > 2:
+        full = full[L - 2 : -(L - 2)]
+    return full
+
+
+def ref_wavedec(x, dec_lo, dec_hi, level, mode="symmetric"):
+    coeffs = []
+    a = np.asarray(x, dtype=np.float64)
+    for _ in range(level):
+        a, d = ref_dwt1(a, dec_lo, dec_hi, mode)
+        coeffs.append(d)
+    coeffs.append(a)
+    return coeffs[::-1]
+
+
+def ref_waverec(coeffs, rec_lo, rec_hi):
+    a = coeffs[0]
+    for d in coeffs[1:]:
+        if len(a) > len(d):
+            a = a[: len(d)]
+        a = ref_idwt1(a, d, rec_lo, rec_hi)
+    return a

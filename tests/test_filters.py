@@ -1,0 +1,87 @@
+"""Filter-bank generation tests: closed-form golden values + orthogonality
+properties every generated bank must satisfy (SURVEY.md §4a)."""
+
+import numpy as np
+import pytest
+
+from wam_tpu.wavelets.filters import build_wavelet, daubechies_scaling, qmf, symlet_scaling
+
+SQRT2 = np.sqrt(2.0)
+
+
+def test_haar_closed_form():
+    w = build_wavelet("haar")
+    np.testing.assert_allclose(w.rec_lo, [1 / SQRT2, 1 / SQRT2], atol=1e-12)
+    np.testing.assert_allclose(w.rec_hi, [1 / SQRT2, -1 / SQRT2], atol=1e-12)
+    np.testing.assert_allclose(w.dec_lo, [1 / SQRT2, 1 / SQRT2], atol=1e-12)
+    np.testing.assert_allclose(w.dec_hi, [-1 / SQRT2, 1 / SQRT2], atol=1e-12)
+
+
+def test_db2_closed_form():
+    # (1+sqrt3, 3+sqrt3, 3-sqrt3, 1-sqrt3) / (4 sqrt2) — the standard db2 filter.
+    s3 = np.sqrt(3.0)
+    expected = np.array([1 + s3, 3 + s3, 3 - s3, 1 - s3]) / (4 * SQRT2)
+    np.testing.assert_allclose(daubechies_scaling(2), expected, atol=1e-10)
+
+
+@pytest.mark.parametrize("name", ["haar", "db2", "db4", "db6", "db8", "db10", "sym3", "sym4", "sym8"])
+def test_orthogonality_properties(name):
+    w = build_wavelet(name)
+    h = w.rec_lo
+    # normalization
+    np.testing.assert_allclose(h.sum(), SQRT2, atol=1e-8)
+    np.testing.assert_allclose(np.dot(h, h), 1.0, atol=1e-8)
+    # even-shift orthogonality of the scaling filter
+    L = len(h)
+    for k in range(1, L // 2):
+        shifted = np.dot(h[2 * k :], h[: L - 2 * k])
+        assert abs(shifted) < 1e-8, f"shift {k} not orthogonal: {shifted}"
+    # high-pass has zero mean (one vanishing moment minimum)
+    np.testing.assert_allclose(w.rec_hi.sum(), 0.0, atol=1e-8)
+    # lo/hi orthogonality at even shifts
+    g = w.rec_hi
+    for k in range(-(L // 2) + 1, L // 2):
+        if 2 * k >= L or 2 * k <= -L:
+            continue
+        if k >= 0:
+            v = np.dot(h[2 * k :], g[: L - 2 * k])
+        else:
+            v = np.dot(g[-2 * k :], h[: L + 2 * k])
+        assert abs(v) < 1e-8
+
+
+@pytest.mark.parametrize("N", [2, 3, 4, 6, 8, 10])
+def test_db_vanishing_moments(N):
+    """dbN high-pass must kill polynomials up to degree N-1."""
+    g = qmf(daubechies_scaling(N))
+    k = np.arange(len(g), dtype=np.float64)
+    for p in range(N):
+        np.testing.assert_allclose(np.dot(g, k**p), 0.0, atol=1e-5)
+
+
+@pytest.mark.parametrize("N", [2, 3, 4, 8])
+def test_sym_vanishing_moments(N):
+    g = qmf(symlet_scaling(N))
+    k = np.arange(len(g), dtype=np.float64)
+    for p in range(N):
+        np.testing.assert_allclose(np.dot(g, k**p), 0.0, atol=1e-5)
+
+
+def test_sym_more_symmetric_than_db():
+    """The symlet selection must produce lower phase non-linearity than dbN."""
+    from wam_tpu.wavelets.filters import _phase_nonlinearity
+
+    for N in (4, 8):
+        assert _phase_nonlinearity(symlet_scaling(N)) <= _phase_nonlinearity(daubechies_scaling(N)) + 1e-9
+
+
+def test_filter_lengths():
+    for N in (1, 2, 5, 10):
+        assert len(daubechies_scaling(N)) == 2 * N
+    for N in (2, 5, 8):
+        assert len(symlet_scaling(N)) == 2 * N
+
+
+def test_unknown_wavelet_raises():
+    with pytest.raises(ValueError):
+        build_wavelet("coif99x")

@@ -1,0 +1,27 @@
+"""wam_tpu — TPU-native Wavelet Attribution Method framework.
+
+A ground-up JAX/XLA re-design of the capabilities of the reference
+`michalpiasecki0/wam` repository (Wavelet Attribution Method, ICML 2025):
+differentiable multi-level wavelet transforms (1D/2D/3D), gradient-based
+attribution in the wavelet domain, SmoothGrad / Integrated-Gradients
+estimators, a faithfulness-evaluation suite, scale analyzers, and
+visualization for audio / image / volume modalities.
+
+Everything in the compute path is pure-functional JAX: transforms are
+jit-able, vmap-able, and shardable over a `jax.sharding.Mesh`.
+"""
+
+from wam_tpu.wavelets import (
+    Wavelet,
+    build_wavelet,
+    dwt,
+    idwt,
+    wavedec,
+    waverec,
+    wavedec2,
+    waverec2,
+    wavedec3,
+    waverec3,
+)
+
+__version__ = "0.1.0"

@@ -1,0 +1,46 @@
+"""Differentiable wavelet transforms — the foundation of the framework.
+
+TPU-native replacement for the reference's ptwt (differentiable, torch) and
+pywt (C, non-differentiable) usage; one implementation serves both roles here
+because JAX transforms are differentiable by construction.
+"""
+
+from wam_tpu.wavelets.filters import Wavelet, build_wavelet, qmf
+from wam_tpu.wavelets.transform import (
+    DETAIL3D_KEYS,
+    Detail2D,
+    dwt,
+    dwt2,
+    dwt3,
+    dwt_max_level,
+    idwt,
+    idwt2,
+    idwt3,
+    wavedec,
+    wavedec2,
+    wavedec3,
+    waverec,
+    waverec2,
+    waverec3,
+)
+
+__all__ = [
+    "Wavelet",
+    "build_wavelet",
+    "qmf",
+    "Detail2D",
+    "DETAIL3D_KEYS",
+    "dwt",
+    "idwt",
+    "dwt2",
+    "idwt2",
+    "dwt3",
+    "idwt3",
+    "wavedec",
+    "waverec",
+    "wavedec2",
+    "waverec2",
+    "wavedec3",
+    "waverec3",
+    "dwt_max_level",
+]

@@ -1,0 +1,350 @@
+"""Differentiable multi-level discrete wavelet transforms for TPU (JAX/XLA).
+
+This is the central build item: the TPU-native replacement for the reference's
+ptwt/pywt usage — ``ptwt.wavedec/waverec`` (`lib/wam_1D.py:109,117`),
+``ptwt.wavedec2/waverec2`` (`lib/wam_2D.py:96,113`) and
+``ptwt.wavedec3/waverec3`` (`lib/wam_3D.py:194,206`). Coefficient layouts and
+boundary-mode semantics follow the pywt conventions those call sites rely on:
+
+- 1D ``wavedec`` returns ``[cA_J, cD_J, ..., cD_1]`` with per-level length
+  floor((n + L - 1)/2).
+- 2D ``wavedec2`` returns ``[cA_J, Detail2D(H_J, V_J, D_J), ..., Detail2D_1]``
+  where H = hi-pass along rows (axis -2), V = hi-pass along cols (axis -1),
+  D = hi-pass along both (pywt's (cH, cV, cD) = dwtn 'da','ad','dd').
+- 3D ``wavedec3`` returns ``[cA_J, {'aad': ..., ..., 'ddd': ...}, ...]``
+  with keys ordered by axes (-3, -2, -1), matching ptwt's dicts
+  (`lib/wam_3D.py:197-202`).
+
+Everything is expressed as XLA strided convolutions (`lax.conv_general_dilated`)
+over fused subband channels — 2 channels for 1D, 4 for 2D, 8 for 3D — so a full
+level is ONE conv that tiles onto the MXU, and the whole decomposition is
+differentiable by construction (no requires_grad dance; `jax.grad` flows
+through). All functions are jit/vmap/shard_map compatible: static shapes,
+no Python control flow on traced values.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from wam_tpu.wavelets.filters import Wavelet, build_wavelet
+
+__all__ = [
+    "Detail2D",
+    "dwt",
+    "idwt",
+    "wavedec",
+    "waverec",
+    "dwt2",
+    "idwt2",
+    "wavedec2",
+    "waverec2",
+    "dwt3",
+    "idwt3",
+    "wavedec3",
+    "waverec3",
+    "dwt_max_level",
+]
+
+DETAIL3D_KEYS = ("aad", "ada", "add", "daa", "dad", "dda", "ddd")
+
+
+class Detail2D(NamedTuple):
+    """One level of 2D detail coefficients (ptwt WaveletDetailTuple2d analogue,
+    `lib/wam_2D.py:29`)."""
+
+    horizontal: jax.Array
+    vertical: jax.Array
+    diagonal: jax.Array
+
+
+# pywt boundary-mode name -> jnp.pad mode. Note the naming mismatch:
+# pywt 'constant' replicates the edge value (jnp 'edge'); pywt 'zero' pads
+# zeros (jnp 'constant'); pywt 'reflect' is whole-sample, 'symmetric'
+# half-sample — same names in jnp.pad.
+_PAD_MODE = {
+    "zero": "constant",
+    "constant": "edge",
+    "symmetric": "symmetric",
+    "reflect": "reflect",
+    "periodic": "wrap",
+}
+
+
+def _resolve(wavelet) -> Wavelet:
+    return wavelet if isinstance(wavelet, Wavelet) else build_wavelet(wavelet)
+
+
+def dwt_max_level(data_len: int, filt_len: int) -> int:
+    """pywt.dwt_max_level: floor(log2(data_len / (filt_len - 1)))."""
+    if data_len < filt_len - 1 or filt_len < 2:
+        return 0
+    return int(np.floor(np.log2(data_len / (filt_len - 1.0))))
+
+
+def _pad_axes(x: jax.Array, pad: int, axes: Sequence[int], mode: str) -> jax.Array:
+    if mode not in _PAD_MODE:
+        raise ValueError(f"Unsupported mode {mode!r}; one of {sorted(_PAD_MODE)}")
+    widths = [(0, 0)] * x.ndim
+    for ax in axes:
+        widths[ax % x.ndim] = (pad, pad)
+    jmode = _PAD_MODE[mode]
+    if jmode in ("reflect", "symmetric"):
+        # jnp.pad cannot extend past the signal in one go; loop for tiny inputs.
+        while True:
+            ok = all(
+                widths[ax % x.ndim][0] < x.shape[ax % x.ndim]
+                or jmode == "symmetric"
+                and widths[ax % x.ndim][0] <= x.shape[ax % x.ndim]
+                for ax in axes
+            )
+            if ok:
+                break
+            step = [(0, 0)] * x.ndim
+            rem = list(widths)
+            for ax in axes:
+                a = ax % x.ndim
+                cap = x.shape[a] - 1 if jmode == "reflect" else x.shape[a]
+                take = min(widths[a][0], max(cap, 1))
+                step[a] = (take, take)
+                rem[a] = (widths[a][0] - take, widths[a][1] - take)
+            x = jnp.pad(x, step, mode=jmode)
+            widths = rem
+            if all(w == (0, 0) for w in widths):
+                return x
+    return jnp.pad(x, widths, mode=jmode)
+
+
+def _subband_kernel(wav: Wavelet, ndim: int, dtype) -> jnp.ndarray:
+    """Fused analysis kernel: (2^ndim, 1, L, ..., L) of flipped dec-filter
+    outer products, channel order = binary a/d counting over axes."""
+    lo = np.asarray(wav.dec_lo[::-1])
+    hi = np.asarray(wav.dec_hi[::-1])
+    banks = []
+    for code in range(2**ndim):
+        k = np.array(1.0)
+        for axis in range(ndim):
+            f = hi if (code >> (ndim - 1 - axis)) & 1 else lo
+            k = np.multiply.outer(k, f)
+        banks.append(k)
+    kernel = np.stack(banks)[:, None]  # (O, I=1, L...L)
+    return jnp.asarray(kernel, dtype=dtype)
+
+
+def _inv_subband_kernel(wav: Wavelet, ndim: int, dtype) -> jnp.ndarray:
+    """Fused synthesis kernel: (1, 2^ndim, L, ..., L), rec-filter outer
+    products flipped along every spatial axis (true convolution)."""
+    lo = np.asarray(wav.rec_lo)
+    hi = np.asarray(wav.rec_hi)
+    banks = []
+    for code in range(2**ndim):
+        k = np.array(1.0)
+        for axis in range(ndim):
+            f = hi if (code >> (ndim - 1 - axis)) & 1 else lo
+            k = np.multiply.outer(k, f)
+        for axis in range(k.ndim):
+            k = np.flip(k, axis=axis)
+        banks.append(k)
+    kernel = np.stack(banks)[None]  # (O=1, I, L...L)
+    return jnp.asarray(kernel, dtype=dtype)
+
+
+def _conv_dims(ndim: int):
+    spatial = "HWD"[:ndim] if ndim <= 3 else None
+    lhs = "NC" + spatial
+    rhs = "OI" + spatial
+    return lax.conv_dimension_numbers((1, 1) + (1,) * ndim, (1, 1) + (1,) * ndim, (lhs, rhs, lhs))
+
+
+def _analysis(x: jax.Array, wav: Wavelet, mode: str, ndim: int) -> jax.Array:
+    """One analysis level over the trailing `ndim` axes.
+
+    x: (..., S1..Sn) -> (..., 2^ndim, S1'..Sn') with Si' = floor((Si+L-1)/2).
+    """
+    L = wav.filt_len
+    batch_shape = x.shape[:-ndim]
+    spatial = x.shape[-ndim:]
+    xb = x.reshape((-1, 1) + spatial)
+    xp = _pad_axes(xb, L - 1, range(-ndim, 0), mode)
+    # Offset so strided correlation lands on pywt's odd output positions.
+    xp = xp[(Ellipsis,) + tuple(slice(1, None) for _ in range(ndim))]
+    kernel = _subband_kernel(wav, ndim, x.dtype)
+    out = lax.conv_general_dilated(
+        xp,
+        kernel,
+        window_strides=(2,) * ndim,
+        padding=[(0, 0)] * ndim,
+        dimension_numbers=_conv_dims(ndim),
+    )
+    return out.reshape(batch_shape + out.shape[1:])
+
+
+def _synthesis(subbands: jax.Array, wav: Wavelet, ndim: int, out_shape: Sequence[int]) -> jax.Array:
+    """Inverse of one analysis level.
+
+    subbands: (..., 2^ndim, S1..Sn) -> (..., O1..On), trimmed to out_shape.
+    """
+    L = wav.filt_len
+    batch_shape = subbands.shape[: -(ndim + 1)]
+    xb = subbands.reshape((-1,) + subbands.shape[-(ndim + 1) :])
+    kernel = _inv_subband_kernel(wav, ndim, subbands.dtype)
+    # Full reconstruction = true convolution with the rec filters (padding
+    # L-1) trimmed by L-2 per side, i.e. correlation with the flipped
+    # kernel at padding 1 — for every filter length.
+    out = lax.conv_general_dilated(
+        xb,
+        kernel,
+        window_strides=(1,) * ndim,
+        padding=[(1, 1)] * ndim,
+        lhs_dilation=(2,) * ndim,
+        dimension_numbers=_conv_dims(ndim),
+    )
+    out = out[(slice(None), 0)]
+    # Full reconstruction length is 2*Si - L + 2; trim to requested shape.
+    out = out[(Ellipsis,) + tuple(slice(0, s) for s in out_shape)]
+    return out.reshape(batch_shape + tuple(out_shape))
+
+
+# ---------------------------------------------------------------------------
+# 1D  (reference: ptwt.wavedec/waverec at lib/wam_1D.py:109,117)
+# ---------------------------------------------------------------------------
+
+
+def dwt(x: jax.Array, wavelet, mode: str = "symmetric"):
+    """Single-level 1D DWT along the last axis. Returns (cA, cD)."""
+    wav = _resolve(wavelet)
+    out = _analysis(x, wav, mode, 1)
+    return out[..., 0, :], out[..., 1, :]
+
+
+def idwt(cA: jax.Array, cD: jax.Array, wavelet, out_len: int | None = None):
+    """Single-level inverse 1D DWT. Output length 2n - L + 2 unless trimmed."""
+    wav = _resolve(wavelet)
+    n = cA.shape[-1]
+    full = 2 * n - wav.filt_len + 2
+    target = full if out_len is None else out_len
+    sub = jnp.stack([cA, cD], axis=-2)
+    return _synthesis(sub, wav, 1, (target,))
+
+
+def wavedec(x: jax.Array, wavelet, level: int, mode: str = "symmetric"):
+    """Multi-level 1D DWT: [cA_J, cD_J, ..., cD_1] (coarsest first, pywt order)."""
+    wav = _resolve(wavelet)
+    coeffs = []
+    a = x
+    for _ in range(level):
+        a, d = dwt(a, wav, mode)
+        coeffs.append(d)
+    coeffs.append(a)
+    return coeffs[::-1]
+
+
+def waverec(coeffs: Sequence[jax.Array], wavelet):
+    """Inverse of `wavedec`. Trims each level to the next detail's length."""
+    wav = _resolve(wavelet)
+    a = coeffs[0]
+    for d in coeffs[1:]:
+        if a.shape[-1] > d.shape[-1]:
+            a = a[..., : d.shape[-1]]
+        a = idwt(a, d, wav)
+    return a
+
+
+# ---------------------------------------------------------------------------
+# 2D  (reference: ptwt.wavedec2/waverec2 at lib/wam_2D.py:96,113)
+# ---------------------------------------------------------------------------
+
+
+def dwt2(x: jax.Array, wavelet, mode: str = "reflect"):
+    """Single-level 2D DWT over the last two axes. Returns (cA, Detail2D)."""
+    wav = _resolve(wavelet)
+    out = _analysis(x, wav, mode, 2)
+    # channel order (row, col): 0=aa, 1=ad, 2=da, 3=dd
+    return out[..., 0, :, :], Detail2D(
+        horizontal=out[..., 2, :, :], vertical=out[..., 1, :, :], diagonal=out[..., 3, :, :]
+    )
+
+
+def idwt2(cA: jax.Array, detail: Detail2D, wavelet, out_shape=None):
+    wav = _resolve(wavelet)
+    n0, n1 = cA.shape[-2:]
+    L = wav.filt_len
+    target = (2 * n0 - L + 2, 2 * n1 - L + 2) if out_shape is None else tuple(out_shape)
+    sub = jnp.stack([cA, detail.vertical, detail.horizontal, detail.diagonal], axis=-3)
+    return _synthesis(sub, wav, 2, target)
+
+
+def wavedec2(x: jax.Array, wavelet, level: int, mode: str = "reflect"):
+    """Multi-level 2D DWT: [cA_J, Detail2D_J, ..., Detail2D_1]."""
+    wav = _resolve(wavelet)
+    coeffs = []
+    a = x
+    for _ in range(level):
+        a, det = dwt2(a, wav, mode)
+        coeffs.append(det)
+    coeffs.append(a)
+    return coeffs[::-1]
+
+
+def waverec2(coeffs, wavelet):
+    """Inverse of `wavedec2` (reference reconstruction path, lib/wam_2D.py:113)."""
+    wav = _resolve(wavelet)
+    a = coeffs[0]
+    for det in coeffs[1:]:
+        tgt = det.horizontal.shape[-2:]
+        a = a[..., : tgt[0], : tgt[1]]
+        L = wav.filt_len
+        a = idwt2(a, det, wav, out_shape=(2 * tgt[0] - L + 2, 2 * tgt[1] - L + 2))
+    return a
+
+
+# ---------------------------------------------------------------------------
+# 3D  (reference: ptwt.wavedec3/waverec3 at lib/wam_3D.py:194,206)
+# ---------------------------------------------------------------------------
+
+
+def dwt3(x: jax.Array, wavelet, mode: str = "symmetric"):
+    """Single-level 3D DWT over the last three axes. Returns (cA, {key: arr})."""
+    wav = _resolve(wavelet)
+    out = _analysis(x, wav, mode, 3)
+    keys = ("aaa",) + DETAIL3D_KEYS
+    coeffs = {k: out[..., i, :, :, :] for i, k in enumerate(keys)}
+    return coeffs.pop("aaa"), coeffs
+
+
+def idwt3(cA: jax.Array, details: dict, wavelet, out_shape=None):
+    wav = _resolve(wavelet)
+    L = wav.filt_len
+    n = cA.shape[-3:]
+    target = tuple(2 * s - L + 2 for s in n) if out_shape is None else tuple(out_shape)
+    sub = jnp.stack([cA] + [details[k] for k in DETAIL3D_KEYS], axis=-4)
+    return _synthesis(sub, wav, 3, target)
+
+
+def wavedec3(x: jax.Array, wavelet, level: int, mode: str = "symmetric"):
+    """Multi-level 3D DWT: [cA_J, {aad..ddd}_J, ..., {aad..ddd}_1]."""
+    wav = _resolve(wavelet)
+    coeffs = []
+    a = x
+    for _ in range(level):
+        a, det = dwt3(a, wav, mode)
+        coeffs.append(det)
+    coeffs.append(a)
+    return coeffs[::-1]
+
+
+def waverec3(coeffs, wavelet):
+    wav = _resolve(wavelet)
+    a = coeffs[0]
+    L = wav.filt_len
+    for det in coeffs[1:]:
+        tgt = det["ddd"].shape[-3:]
+        a = a[..., : tgt[0], : tgt[1], : tgt[2]]
+        a = idwt3(a, det, wav, out_shape=tuple(2 * s - L + 2 for s in tgt))
+    return a
