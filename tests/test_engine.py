@@ -1,0 +1,142 @@
+"""Core engine + estimator tests: analytic gradients through the IDWT for a
+linear model, y=None representation mode, SmoothGrad/IG semantics
+(SURVEY.md §4b)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from wam_tpu.core.engine import WamEngine, target_loss
+from wam_tpu.core.estimators import integrated_path, noise_sigma, smoothgrad, trapezoid
+from wam_tpu.wavelets import wavedec2
+
+
+def _linear_model(W):
+    """x (B,C,H,W) -> logits (B,K) via flattened matmul."""
+
+    def fn(x):
+        return x.reshape(x.shape[0], -1) @ W
+
+    return fn
+
+
+def test_target_loss_picks_diag():
+    out = jnp.arange(12.0).reshape(3, 4)
+    y = jnp.array([1, 0, 3])
+    np.testing.assert_allclose(target_loss(out, y), (1.0 + 4.0 + 11.0) / 3.0)
+
+
+def test_target_loss_none_is_mean():
+    out = jnp.arange(6.0).reshape(2, 3)
+    np.testing.assert_allclose(target_loss(out, None), out.mean())
+
+
+def test_engine_linear_model_analytic():
+    """For model(x) = <w, x>, the coefficient gradient must equal the DWT of
+    the (reshaped) weight, because the adjoint of the orthogonal IDWT is the
+    DWT."""
+    rng = np.random.default_rng(0)
+    B, C, H, Wd, K = 2, 1, 16, 16, 5
+    W = jnp.asarray(rng.standard_normal((C * H * Wd, K)), dtype=jnp.float32)
+    eng = WamEngine(_linear_model(W), ndim=2, wavelet="haar", level=2, mode="reflect")
+    x = jnp.asarray(rng.standard_normal((B, C, H, Wd)), dtype=jnp.float32)
+    y = jnp.array([3, 1])
+    _, grads = eng.attribute(x, y)
+
+    # grad for sample i = wavedec2(w_{y_i}) / B
+    for i in range(B):
+        w_img = W[:, int(y[i])].reshape(1, C, H, Wd)
+        expected = wavedec2(w_img, "haar", 2, "reflect")
+        got_flat = jax.tree_util.tree_leaves(
+            jax.tree_util.tree_map(lambda g: g[i : i + 1], grads)
+        )
+        exp_flat = jax.tree_util.tree_leaves(expected)
+        for g, e in zip(got_flat, exp_flat):
+            np.testing.assert_allclose(np.asarray(g), np.asarray(e) / B, atol=1e-5)
+
+
+def test_engine_representation_mode():
+    """y=None differentiates the output mean (lib/wam_3D.py:226-232)."""
+    W = jnp.ones((16, 4), dtype=jnp.float32)
+    eng = WamEngine(_linear_model(W), ndim=2, wavelet="haar", level=1, mode="zero")
+    x = jnp.ones((1, 1, 4, 4))
+    _, grads = eng.attribute(x, None)
+    assert jax.tree_util.tree_leaves(grads)[0] is not None
+
+
+def test_engine_1d_and_3d():
+    rng = np.random.default_rng(1)
+    W1 = jnp.asarray(rng.standard_normal((32, 3)), dtype=jnp.float32)
+    eng1 = WamEngine(_linear_model(W1), ndim=1, wavelet="db2", level=2, mode="symmetric")
+    x1 = jnp.asarray(rng.standard_normal((2, 1, 32)), dtype=jnp.float32)
+    c1, g1 = eng1.attribute(x1, jnp.array([0, 2]))
+    assert len(c1) == 3 and jax.tree_util.tree_leaves(g1)[0].shape == c1[0].shape
+
+    W3 = jnp.asarray(rng.standard_normal((8 * 8 * 8, 2)), dtype=jnp.float32)
+    eng3 = WamEngine(_linear_model(W3), ndim=3, wavelet="haar", level=1, mode="symmetric")
+    x3 = jnp.asarray(rng.standard_normal((1, 1, 8, 8, 8)), dtype=jnp.float32)
+    c3, g3 = eng3.attribute(x3, jnp.array([1]))
+    assert set(c3[1].keys()) == {"aad", "ada", "add", "daa", "dad", "dda", "ddd"}
+    assert g3[1]["ddd"].shape == c3[1]["ddd"].shape
+
+
+def test_front_grads_tap():
+    """Front-end gradient tap = the melspec retain_grad analogue."""
+    W = jnp.asarray(np.random.default_rng(2).standard_normal((64, 3)), dtype=jnp.float32)
+
+    def front(x):  # some differentiable front-end
+        return jnp.tanh(x) * 2.0
+
+    eng = WamEngine(
+        _linear_model(W), ndim=1, wavelet="haar", level=1, mode="zero", front_fn=front
+    )
+    x = jnp.asarray(np.random.default_rng(3).standard_normal((1, 1, 64)), dtype=jnp.float32)
+    coeffs, g_coeffs, g_front = eng.attribute_with_front_grads(x, jnp.array([0]))
+    assert g_front.shape == (1, 1, 64)
+    # front grad = W[:, y] reshaped (linear model): d loss / d front = W col
+    np.testing.assert_allclose(
+        np.asarray(g_front).ravel(), np.asarray(W[:, 0]).ravel(), atol=1e-5
+    )
+
+
+def test_noise_sigma_per_image():
+    x = jnp.stack([jnp.zeros((1, 4, 4)), jnp.ones((1, 4, 4)) * 2.0])
+    x = x.at[1, 0, 0, 0].set(0.0)
+    s = noise_sigma(x, 0.5)
+    np.testing.assert_allclose(s, [0.0, 1.0])
+
+
+def test_smoothgrad_zero_noise_equals_step():
+    x = jnp.asarray(np.random.default_rng(4).standard_normal((2, 1, 8, 8)), dtype=jnp.float32)
+    step = lambda v: v * 2.0
+    out = smoothgrad(step, x, jax.random.PRNGKey(0), n_samples=4, stdev_spread=0.0)
+    np.testing.assert_allclose(out, x * 2.0, atol=1e-6)
+
+
+def test_smoothgrad_reduces_variance_and_is_deterministic():
+    x = jnp.ones((1, 1, 8, 8))
+    step = lambda v: v
+    a = smoothgrad(step, x, jax.random.PRNGKey(7), n_samples=50, stdev_spread=0.3)
+    b = smoothgrad(step, x, jax.random.PRNGKey(7), n_samples=50, stdev_spread=0.3)
+    np.testing.assert_allclose(a, b)  # same key -> same result
+    # mean of x + noise ≈ x
+    assert float(jnp.abs(a - x).mean()) < 0.2
+
+
+def test_trapezoid_matches_numpy():
+    rng = np.random.default_rng(5)
+    path = rng.standard_normal((7, 3, 4)).astype(np.float32)
+    got = trapezoid(jnp.asarray(path))
+    expected = np.trapezoid(path, axis=0) if hasattr(np, "trapezoid") else np.trapz(path, axis=0)
+    np.testing.assert_allclose(got, expected, atol=1e-5)
+
+
+def test_integrated_path_linear_grad():
+    """For grad_fn(c) = c (identity), the path integral of α·c over α∈[0,1]
+    with dx=1 equals c · (n-1)/2 · dα-free trapz = c · (n-1)/2."""
+    c = {"a": jnp.ones((2, 2))}
+    n = 5
+    out = integrated_path(lambda cs: cs["a"], c, n_steps=n)
+    # trapz of α over linspace(0,1,5) with dx=1: mean-ish = (0+.25+.5+.75+1) with ends halved = 2.0
+    np.testing.assert_allclose(out, np.full((2, 2), 2.0), atol=1e-6)

@@ -1,0 +1,86 @@
+"""Golden-value tests for the 2D mosaic layout and reprojection
+(SURVEY.md §4c: mosaic packing layouts must be pinned)."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from wam_tpu.ops.packing2d import disentangle_scales, mosaic2d, mosaic_size, reproject_mosaic
+from wam_tpu.wavelets import Detail2D
+
+
+def _const_coeffs(J=2, size=16, batch=1, channels=1):
+    """Coefficient pytree with distinct constant values per block so the
+    layout can be read off the mosaic."""
+    coeffs = []
+    n = size // (2**J)
+    coeffs.append(jnp.full((batch, channels, n, n), 10.0))  # approx
+    for lev in range(J, 0, -1):  # coarsest -> finest, pywt order
+        n = size // (2**lev)
+        coeffs.append(
+            Detail2D(
+                horizontal=jnp.full((batch, channels, n, n), float(lev) + 0.1),
+                vertical=jnp.full((batch, channels, n, n), float(lev) + 0.2),
+                diagonal=jnp.full((batch, channels, n, n), float(lev) + 0.3),
+            )
+        )
+    return coeffs
+
+
+def test_mosaic_layout_quadrants():
+    m = np.asarray(mosaic2d(_const_coeffs(J=2, size=16), normalize=False))[0]
+    assert m.shape == (16, 16)
+    # approx top-left 4x4
+    np.testing.assert_allclose(m[:4, :4], 10.0)
+    # level 2 (coarsest): blocks span [4:8]
+    np.testing.assert_allclose(m[4:8, 4:8], 2.3)  # diagonal
+    np.testing.assert_allclose(m[4:8, :4], 2.2)  # vertical
+    np.testing.assert_allclose(m[:4, 4:8], 2.1)  # horizontal
+    # level 1 (finest): blocks span [8:16]
+    np.testing.assert_allclose(m[8:16, 8:16], 1.3)
+    np.testing.assert_allclose(m[8:16, :8], 1.2)
+    np.testing.assert_allclose(m[:8, 8:16], 1.1)
+
+
+def test_mosaic_normalization():
+    m = np.asarray(mosaic2d(_const_coeffs(J=1, size=8), normalize=True))[0]
+    # each constant block normalized to 1
+    np.testing.assert_allclose(m, 1.0)
+
+
+def test_mosaic_channel_mean_then_abs():
+    """Channels averaged before abs: (+1, -1) channels cancel to 0."""
+    c = [
+        jnp.stack([jnp.ones((1, 2, 2)), -jnp.ones((1, 2, 2))], axis=1)[:, :, 0],
+    ]
+    # build a 1-level pytree with 2 channels
+    approx = jnp.stack([jnp.ones((2, 2)), -jnp.ones((2, 2))])[None]  # (1,2,2,2)
+    det = Detail2D(
+        horizontal=jnp.ones((1, 2, 2, 2)),
+        vertical=jnp.ones((1, 2, 2, 2)),
+        diagonal=jnp.ones((1, 2, 2, 2)),
+    )
+    m = np.asarray(mosaic2d([approx, det], normalize=False))[0]
+    np.testing.assert_allclose(m[:2, :2], 0.0, atol=1e-7)  # cancelled approx
+    np.testing.assert_allclose(m[2:4, 2:4], 1.0)
+
+
+def test_mosaic_size_derived_not_hardcoded():
+    """Reference hard-codes 224 (defect §2.11.3); ours follows the input."""
+    for size in (16, 32, 64):
+        assert mosaic_size(_const_coeffs(J=2, size=size)) == size
+
+
+def test_reproject_shapes_and_values():
+    avg = jnp.ones((2, 16, 16))
+    maps = reproject_mosaic(avg, levels=2, approx_coeffs=True)
+    assert maps.shape == (2, 3, 16, 16)
+    # constant mosaic -> each level map = h+v+d = 3 (bilinear of constants)
+    np.testing.assert_allclose(np.asarray(maps[:, :2]), 3.0, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(maps[:, 2]), 1.0, atol=1e-5)
+
+
+def test_disentangle_shapes():
+    maps = disentangle_scales(_const_coeffs(J=3, size=32, batch=2, channels=3), approx_coeffs=False)
+    assert maps.shape == (2, 3, 32, 32)
+    maps_a = disentangle_scales(_const_coeffs(J=3, size=32, batch=2), approx_coeffs=True)
+    assert maps_a.shape == (2, 4, 32, 32)

@@ -1,0 +1,11 @@
+from wam_tpu.core.engine import WamEngine, target_loss
+from wam_tpu.core.estimators import integrated_path, noise_sigma, smoothgrad, trapezoid
+
+__all__ = [
+    "WamEngine",
+    "target_loss",
+    "smoothgrad",
+    "integrated_path",
+    "noise_sigma",
+    "trapezoid",
+]

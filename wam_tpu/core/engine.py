@@ -1,0 +1,124 @@
+"""Core gradient engine: ∂ logit_y / ∂ wavelet-coefficients as a pure VJP.
+
+Replaces the reference's requires_grad/backward dance
+(`lib/wam_2D.py:102-116`, `lib/wam_1D.py:112-126`, `lib/wam_3D.py:197-238`)
+with `jax.grad` of the function coeffs ↦ model(idwt(coeffs)) — differentiable
+by construction, jit-able, vmap-able (SURVEY.md §7.1 step 2).
+
+Supports the `y=None` representation mode of the 3D engine
+(`lib/wam_3D.py:226-232`): differentiate the mean of the model output instead
+of a class logit.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from wam_tpu.wavelets import transform as wt
+
+__all__ = ["WamEngine", "target_loss"]
+
+_DEC = {1: wt.wavedec, 2: wt.wavedec2, 3: wt.wavedec3}
+_REC = {1: wt.waverec, 2: wt.waverec2, 3: wt.waverec3}
+
+
+def target_loss(output: jax.Array, y: jax.Array | None) -> jax.Array:
+    """Scalar objective: mean over the batch of logit[i, y[i]]
+    (the reference's `torch.diag(output[:, y]).mean()`, lib/wam_2D.py:115),
+    or mean of the whole output when y is None (representation mode)."""
+    if y is None:
+        return output.mean()
+    y = jnp.asarray(y)
+    picked = jnp.take_along_axis(output, y[:, None], axis=1)[:, 0]
+    return picked.mean()
+
+
+class WamEngine:
+    """Single-pass wavelet attribution for one modality.
+
+    Parameters
+    ----------
+    model_fn : callable mapping the reconstructed input batch to logits
+        (params already bound; compose with a front-end like a mel
+        spectrogram by passing ``front_fn``).
+    ndim : spatial rank (1 audio, 2 image, 3 volume).
+    front_fn : optional differentiable transform between reconstruction and
+        the model (the 1D melspec front-end, `lib/wam_1D.py:117-126`). Its
+        gradients can be harvested via ``attribute_with_front_grads``.
+    """
+
+    def __init__(
+        self,
+        model_fn: Callable[[jax.Array], jax.Array],
+        *,
+        ndim: int,
+        wavelet: str = "haar",
+        level: int = 3,
+        mode: str = "reflect",
+        front_fn: Callable[[jax.Array], jax.Array] | None = None,
+    ):
+        if ndim not in (1, 2, 3):
+            raise ValueError(f"ndim must be 1, 2 or 3, got {ndim}")
+        self.model_fn = model_fn
+        self.ndim = ndim
+        self.wavelet = wavelet
+        self.level = level
+        self.mode = mode
+        self.front_fn = front_fn
+
+    # -- decomposition / reconstruction ------------------------------------
+
+    def decompose(self, x: jax.Array):
+        return _DEC[self.ndim](x, self.wavelet, self.level, self.mode)
+
+    def reconstruct(self, coeffs, spatial_shape: Sequence[int]):
+        rec = _REC[self.ndim](coeffs, self.wavelet)
+        # Reconstruction length is >= the original for non-haar filters /
+        # odd sizes; crop to the model's expected spatial shape.
+        idx = (Ellipsis,) + tuple(slice(0, s) for s in spatial_shape)
+        return rec[idx]
+
+    # -- attribution -------------------------------------------------------
+
+    def _loss_from_coeffs(self, coeffs, y, spatial_shape):
+        x = self.reconstruct(coeffs, spatial_shape)
+        if self.front_fn is not None:
+            x = self.front_fn(x)
+        return target_loss(self.model_fn(x), y)
+
+    def grads_from_coeffs(self, coeffs, y, spatial_shape) -> Any:
+        """Gradient pytree with the same structure as the coefficients —
+        the per-coefficient attribution."""
+        return jax.grad(lambda cs: self._loss_from_coeffs(cs, y, spatial_shape))(coeffs)
+
+    def attribute(self, x: jax.Array, y: jax.Array | None):
+        """Full single pass: decompose → grads. Returns (coeffs, grads)."""
+        coeffs = self.decompose(x)
+        grads = self.grads_from_coeffs(coeffs, y, x.shape[-self.ndim :])
+        return coeffs, grads
+
+    def attribute_with_front_grads(self, x: jax.Array, y: jax.Array | None):
+        """Like `attribute`, additionally returning the gradient at the
+        front-end output (the reference's `melspecs.retain_grad()` tap,
+        `lib/wam_1D.py:121`). Implemented with a zero additive tap so a
+        single backward pass yields both gradients."""
+        if self.front_fn is None:
+            raise ValueError("attribute_with_front_grads requires front_fn")
+        coeffs = self.decompose(x)
+        spatial = x.shape[-self.ndim :]
+
+        front_shape = jax.eval_shape(
+            lambda cs: self.front_fn(self.reconstruct(cs, spatial)), coeffs
+        )
+
+        def loss(cs, tap):
+            rec = self.reconstruct(cs, spatial)
+            front = self.front_fn(rec) + tap
+            return target_loss(self.model_fn(front), y)
+
+        zeros_tap = jnp.zeros(front_shape.shape, front_shape.dtype)
+        g_coeffs, g_front = jax.grad(loss, argnums=(0, 1))(coeffs, zeros_tap)
+        return coeffs, g_coeffs, g_front

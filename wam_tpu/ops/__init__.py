@@ -1,0 +1,3 @@
+from wam_tpu.ops.packing2d import disentangle_scales, mosaic2d, mosaic_size, reproject_mosaic
+
+__all__ = ["mosaic2d", "mosaic_size", "reproject_mosaic", "disentangle_scales"]

@@ -1,0 +1,124 @@
+"""2D dyadic-mosaic packing and per-scale reprojection (pure jnp, size-generic).
+
+Replaces the reference's numpy/cv2 post-processing with on-device ops:
+- `mosaic2d` ↔ `BaseWAM2D.visualize_grad_wam` (`lib/wam_2D.py:200-264`) —
+  the hard-coded 224 at `:238-239` is a known defect (SURVEY.md §2.11.3);
+  sizes here derive from the coefficient shapes.
+- `reproject_mosaic` ↔ `WaveletAttribution2D.reproject_wam`
+  (`lib/wam_2D.py:488-536`), cv2.resize INTER_LINEAR → `jax.image.resize`
+  bilinear.
+- `disentangle_scales` ↔ `BaseWAM2D.disentangle_scales`
+  (`lib/wam_2D.py:133-198`) — with the per-batch approx write the reference
+  intended (its `img_batch` leak is defect §2.11.5).
+
+Mosaic layout (quadrant convention of the reference): approximation in the
+top-left corner; for each level with block span [s, e) (s = S/2^{i+1},
+e = S/2^i, i = 0 for the finest level): diagonal at [s:e, s:e], vertical at
+[s:e, :s], horizontal at [:s, s:e].
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["mosaic2d", "reproject_mosaic", "disentangle_scales", "mosaic_size"]
+
+
+def _norm(a: jax.Array, enabled: bool) -> jax.Array:
+    if not enabled:
+        return a
+    m = jnp.max(a)
+    return a / jnp.where(m == 0, 1.0, m)
+
+
+def _prep(block: jax.Array, normalize: bool) -> jax.Array:
+    """abs → channel-mean → optional global-max normalization.
+
+    Matches the reference order (mean over channels, then abs, then /max —
+    `lib/wam_2D.py:243-256`); abs∘mean ≠ mean∘abs so the order matters.
+    """
+    return _norm(jnp.abs(block.mean(axis=1)), normalize)
+
+
+def mosaic_size(coeffs) -> int:
+    """Mosaic side = 2 × finest-level detail size (lib/wam_2D.py:217)."""
+    return int(2 * coeffs[-1].horizontal.shape[-1])
+
+
+def mosaic2d(coeffs, normalize: bool = True) -> jax.Array:
+    """Pack per-coefficient values [cA, Detail2D_J..Detail2D_1] (each
+    (B, C, h, w)) into the dyadic mosaic (B, S, S).
+
+    Channel axis is averaged; each orientation block and the approximation
+    are (optionally) normalized by their global max, reproducing
+    `normalize_coeffs=True` semantics.
+    """
+    size = mosaic_size(coeffs)
+    batch = coeffs[0].shape[0]
+    out = jnp.zeros((batch, size, size), dtype=coeffs[0].dtype)
+
+    approx = _prep(coeffs[0], normalize)
+    ha = min(approx.shape[-2], size)
+    wa = min(approx.shape[-1], size)
+    out = out.at[:, :ha, :wa].set(approx[:, :ha, :wa])
+
+    # coeffs[1:] is coarsest→finest; enumerate finest-first like the
+    # reference's coeffs[1:][::-1] loop.
+    for i, det in enumerate(coeffs[1:][::-1]):
+        end = size // (2**i)
+        start = size // (2 ** (i + 1))
+        b = end - start
+        # Off-diagonal blocks are (b, start)/(start, b): for non-dyadic
+        # mosaic sizes (long filters) start != b, unlike the reference's
+        # square-only assumption.
+        h = _prep(det.horizontal, normalize)[:, :start, :b]
+        v = _prep(det.vertical, normalize)[:, :b, :start]
+        d = _prep(det.diagonal, normalize)[:, :b, :b]
+        out = out.at[:, start:end, start:end].set(d)
+        out = out.at[:, start:end, :start].set(v)
+        out = out.at[:, :start, start:end].set(h)
+    return out
+
+
+def _resize_bilinear(a: jax.Array, size: int) -> jax.Array:
+    return jax.image.resize(a, a.shape[:-2] + (size, size), method="bilinear")
+
+
+def reproject_mosaic(avg: jax.Array, levels: int, approx_coeffs: bool = False) -> jax.Array:
+    """Unpack an averaged mosaic (B, S, S) into per-level pixel-domain maps
+    (B, levels(+1), S, S): each level's H+V+D blocks upsampled to full size
+    and summed (lib/wam_2D.py:488-536)."""
+    size = avg.shape[-1]
+    maps = []
+    for j in range(levels):
+        end = size // (2**j)
+        start = size // (2 ** (j + 1))
+        diag = avg[:, start:end, start:end]
+        vert = avg[:, start:end, :start]
+        horz = avg[:, :start, start:end]
+        maps.append(
+            _resize_bilinear(horz, size) + _resize_bilinear(vert, size) + _resize_bilinear(diag, size)
+        )
+    if approx_coeffs:
+        end = size // (2**levels)
+        maps.append(_resize_bilinear(avg[:, :end, :end], size))
+    return jnp.stack(maps, axis=1)
+
+
+def disentangle_scales(coeffs, approx_coeffs: bool = False, size: int | None = None) -> jax.Array:
+    """Per-level pixel-domain importance maps straight from coefficient
+    grads: (B, J(+1), S, S), finest level first (lib/wam_2D.py:133-198)."""
+    if size is None:
+        size = mosaic_size(coeffs)
+    maps = []
+    for det in coeffs[1:][::-1]:
+        total = (
+            _resize_bilinear(_prep(det.horizontal, True), size)
+            + _resize_bilinear(_prep(det.vertical, True), size)
+            + _resize_bilinear(_prep(det.diagonal, True), size)
+        )
+        maps.append(total)
+    if approx_coeffs:
+        maps.append(_resize_bilinear(_prep(coeffs[0], True), size))
+    return jnp.stack(maps, axis=1)
