@@ -1,0 +1,20 @@
+from wam_tpu.models.resnet import (
+    ResNet,
+    bind_inference,
+    resnet18,
+    resnet34,
+    resnet50,
+    resnet101,
+)
+from wam_tpu.models.ingest import strip_module_prefix, torch_resnet_to_flax
+
+__all__ = [
+    "ResNet",
+    "resnet18",
+    "resnet34",
+    "resnet50",
+    "resnet101",
+    "bind_inference",
+    "strip_module_prefix",
+    "torch_resnet_to_flax",
+]

@@ -1,0 +1,79 @@
+"""Checkpoint ingestion: PyTorch state dicts → Flax variable pytrees.
+
+The reference loads torch checkpoints from disk (`src/helpers.py:95,111,283`)
+and pretrained models via timm/torchvision (`src/helpers.py:468-479`). This
+module maps torchvision-style ResNet state dicts into the
+`wam_tpu.models.resnet` variable tree, handling:
+
+- conv weights (O, I, kh, kw) → (kh, kw, I, O)
+- linear weights (out, in) → kernel (in, out)
+- batchnorm weight/bias/running_mean/running_var → scale/bias + batch_stats
+- DataParallel "module."-prefix stripping (`src/helpers.py:315-325`)
+
+Pure numpy — no torch import needed at runtime; any mapping of
+name → array-like works (a torch state_dict, an npz, ...).
+"""
+
+from __future__ import annotations
+
+from typing import Mapping
+
+import numpy as np
+
+__all__ = ["strip_module_prefix", "torch_resnet_to_flax"]
+
+
+def strip_module_prefix(state: Mapping[str, np.ndarray]) -> dict[str, np.ndarray]:
+    """Remove the 'module.' prefix DataParallel training leaves on keys."""
+    return {k.removeprefix("module."): v for k, v in state.items()}
+
+
+def _np(v) -> np.ndarray:
+    # torch tensors expose .detach().cpu().numpy(); arrays pass through.
+    if hasattr(v, "detach"):
+        v = v.detach().cpu().numpy()
+    return np.asarray(v)
+
+
+def _conv(w) -> np.ndarray:
+    return _np(w).transpose(2, 3, 1, 0)
+
+
+def torch_resnet_to_flax(state: Mapping[str, np.ndarray]) -> dict:
+    """Convert a torchvision ResNet state dict to this package's
+    {'params': ..., 'batch_stats': ...} tree."""
+    state = strip_module_prefix(state)
+    params: dict = {}
+    stats: dict = {}
+
+    def put(tree: dict, path: tuple[str, ...], value: np.ndarray):
+        node = tree
+        for p in path[:-1]:
+            node = node.setdefault(p, {})
+        node[path[-1]] = value
+
+    def take_bn(prefix: str, flax_name: tuple[str, ...]):
+        put(params, flax_name + ("scale",), _np(state[prefix + ".weight"]))
+        put(params, flax_name + ("bias",), _np(state[prefix + ".bias"]))
+        put(stats, flax_name + ("mean",), _np(state[prefix + ".running_mean"]))
+        put(stats, flax_name + ("var",), _np(state[prefix + ".running_var"]))
+
+    put(params, ("conv1", "kernel"), _conv(state["conv1.weight"]))
+    take_bn("bn1", ("bn1",))
+
+    for key in state:
+        parts = key.split(".")
+        if parts[0].startswith("layer") and parts[-1] == "weight" and parts[2].startswith("conv"):
+            stage, idx, conv = parts[0], parts[1], parts[2]
+            block = f"{stage}_{idx}"
+            put(params, (block, conv, "kernel"), _conv(state[key]))
+            take_bn(f"{stage}.{idx}.bn{conv[-1]}", (block, f"bn{conv[-1]}"))
+        elif parts[0].startswith("layer") and "downsample" in key and key.endswith("0.weight"):
+            stage, idx = parts[0], parts[1]
+            block = f"{stage}_{idx}"
+            put(params, (block, "downsample_conv", "kernel"), _conv(state[key]))
+            take_bn(f"{stage}.{idx}.downsample.1", (block, "downsample_bn"))
+
+    put(params, ("fc", "kernel"), _np(state["fc.weight"]).T)
+    put(params, ("fc", "bias"), _np(state["fc.bias"]))
+    return {"params": params, "batch_stats": stats}

@@ -1,0 +1,120 @@
+"""Flax ResNet family (18/34/50/101) — NHWC, TPU-friendly.
+
+Replaces the reference's timm/torchvision model loading
+(`src/helpers.py:468-479`, `wam_example.ipynb` cell 3) with native Flax
+modules. Weights can be ingested from torchvision-style PyTorch state dicts
+via `wam_tpu.models.ingest.load_torch_resnet` (checkpoint layer,
+SURVEY.md §5.4).
+
+Intermediate activations for the GradCAM-family baselines
+(`src/evaluation_helpers.py:72-230`) are exposed through `nn.Module.sow`
+taps after every stage: apply with ``mutable=["intermediates"]``.
+
+Module naming is deliberately aligned with torchvision's state-dict keys
+(conv1, bn1, layer{1..4}.{i}.conv{1..3}/bn{1..3}/downsample, fc) so
+checkpoint ingestion is a mechanical rename.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+__all__ = ["ResNet", "resnet18", "resnet34", "resnet50", "resnet101", "bind_inference"]
+
+ModuleDef = Any
+
+
+class BasicBlock(nn.Module):
+    features: int
+    strides: int = 1
+    norm: ModuleDef = nn.BatchNorm
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = nn.Conv(self.features, (3, 3), (self.strides, self.strides), padding=1,
+                    use_bias=False, name="conv1")(x)
+        y = self.norm(name="bn1")(y)
+        y = nn.relu(y)
+        y = nn.Conv(self.features, (3, 3), padding=1, use_bias=False, name="conv2")(y)
+        y = self.norm(name="bn2")(y)
+        if residual.shape != y.shape:
+            residual = nn.Conv(self.features, (1, 1), (self.strides, self.strides),
+                               use_bias=False, name="downsample_conv")(x)
+            residual = self.norm(name="downsample_bn")(residual)
+        return nn.relu(y + residual)
+
+
+class Bottleneck(nn.Module):
+    features: int
+    strides: int = 1
+    norm: ModuleDef = nn.BatchNorm
+    expansion: int = 4
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = nn.Conv(self.features, (1, 1), use_bias=False, name="conv1")(x)
+        y = self.norm(name="bn1")(y)
+        y = nn.relu(y)
+        y = nn.Conv(self.features, (3, 3), (self.strides, self.strides), padding=1,
+                    use_bias=False, name="conv2")(y)
+        y = self.norm(name="bn2")(y)
+        y = nn.relu(y)
+        y = nn.Conv(self.features * self.expansion, (1, 1), use_bias=False, name="conv3")(y)
+        y = self.norm(name="bn3")(y)
+        if residual.shape != y.shape:
+            residual = nn.Conv(self.features * self.expansion, (1, 1),
+                               (self.strides, self.strides), use_bias=False,
+                               name="downsample_conv")(x)
+            residual = self.norm(name="downsample_bn")(residual)
+        return nn.relu(y + residual)
+
+
+class ResNet(nn.Module):
+    stage_sizes: Sequence[int]
+    block_cls: ModuleDef
+    num_classes: int = 1000
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        """x: (B, H, W, C) NHWC. Returns logits (B, num_classes)."""
+        norm = partial(nn.BatchNorm, use_running_average=not train, momentum=0.9, epsilon=1e-5)
+        x = nn.Conv(64, (7, 7), (2, 2), padding=3, use_bias=False, name="conv1")(x)
+        x = norm(name="bn1")(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))
+        for stage, n_blocks in enumerate(self.stage_sizes):
+            for i in range(n_blocks):
+                strides = 2 if stage > 0 and i == 0 else 1
+                x = self.block_cls(64 * 2**stage, strides=strides, norm=norm,
+                                   name=f"layer{stage + 1}_{i}")(x)
+            self.sow("intermediates", f"stage{stage + 1}", x)
+        x = x.mean(axis=(1, 2))
+        return nn.Dense(self.num_classes, name="fc")(x)
+
+
+resnet18 = partial(ResNet, stage_sizes=(2, 2, 2, 2), block_cls=BasicBlock)
+resnet34 = partial(ResNet, stage_sizes=(3, 4, 6, 3), block_cls=BasicBlock)
+resnet50 = partial(ResNet, stage_sizes=(3, 4, 6, 3), block_cls=Bottleneck)
+resnet101 = partial(ResNet, stage_sizes=(3, 4, 23, 3), block_cls=Bottleneck)
+
+
+def bind_inference(model: nn.Module, variables, nchw: bool = True) -> Callable[[jax.Array], jax.Array]:
+    """Bind params into a pure `x -> logits` function.
+
+    nchw=True accepts (B, C, H, W) input — the reference's tensor layout
+    (`lib/wam_2D.py:79-81`) — and transposes to NHWC for the TPU conv path.
+    """
+
+    def fn(x):
+        if nchw:
+            x = jnp.transpose(x, (0, 2, 3, 1))
+        return model.apply(variables, x)
+
+    return fn
