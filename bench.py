@@ -1,0 +1,171 @@
+"""Benchmark: WAM-2D SmoothGrad attributions/sec (ResNet-50, batch 32, n=25).
+
+The north-star workload from BASELINE.json: ResNet-50 ImageNet, batch=32,
+db4, J=3, SmoothGrad n_samples=25. The reference implementation runs this as
+25 sequential host-loop iterations of (ptwt wavedec2 → waverec2 → torch
+forward/backward) — SURVEY.md §3.1. Since ptwt isn't installed here, the CPU
+baseline is a faithful torch re-statement of that pipeline (ptwt is itself
+strided torch conv) on a reduced workload, extrapolated linearly.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+import json
+import os
+import sys
+import time
+
+BATCH = 32
+N_SAMPLES = 25
+IMAGE = 224
+WAVELET = "db4"
+LEVELS = 3
+QUICK = "--quick" in sys.argv
+
+
+def tpu_throughput() -> float:
+    import jax
+    import jax.numpy as jnp
+
+    from wam_tpu.core.engine import WamEngine
+    from wam_tpu.core.estimators import smoothgrad
+    from wam_tpu.models import bind_inference, resnet50
+    from wam_tpu.ops.packing2d import mosaic2d
+
+    batch, n_samples, image = (4, 3, 64) if QUICK else (BATCH, N_SAMPLES, IMAGE)
+
+    model = resnet50(num_classes=1000)
+    variables = model.init(jax.random.PRNGKey(0), jnp.zeros((1, image, image, 3)))
+    model_fn = bind_inference(model, variables, nchw=True)
+    engine = WamEngine(model_fn, ndim=2, wavelet=WAVELET, level=LEVELS, mode="reflect")
+
+    x = jax.random.normal(jax.random.PRNGKey(1), (batch, 3, image, image), jnp.float32)
+    y = jnp.arange(batch, dtype=jnp.int32) % 1000
+
+    @jax.jit
+    def run(x, key):
+        def step(noisy):
+            _, grads = engine.attribute(noisy, y)
+            return mosaic2d(grads, True)
+
+        return smoothgrad(
+            step, x, key, n_samples=n_samples, stdev_spread=0.25, batch_size=1
+        )
+
+    key = jax.random.PRNGKey(42)
+    jax.block_until_ready(run(x, key))  # compile + warm
+    times = []
+    for _ in range(2 if QUICK else 3):
+        t0 = time.perf_counter()
+        jax.block_until_ready(run(x, key))
+        times.append(time.perf_counter() - t0)
+    t = min(times)
+    return batch / t
+
+
+def cpu_baseline_throughput() -> float:
+    """Reference-pipeline cost on CPU torch, reduced workload, linear
+    extrapolation to (BATCH, N_SAMPLES)."""
+    import numpy as np
+    import torch
+    import torch.nn.functional as F
+
+    from transformers import ResNetConfig, ResNetForImageClassification
+
+    from wam_tpu.wavelets.filters import build_wavelet
+
+    torch.manual_seed(0)
+    torch.set_num_threads(os.cpu_count() or 8)
+
+    wav = build_wavelet(WAVELET)
+    L = wav.filt_len
+    lo = torch.tensor(np.asarray(wav.dec_lo[::-1]).copy(), dtype=torch.float32)
+    hi = torch.tensor(np.asarray(wav.dec_hi[::-1]).copy(), dtype=torch.float32)
+    akern = torch.stack(
+        [
+            torch.outer(a, b)
+            for a in (lo, hi)
+            for b in (lo, hi)
+        ]
+    )[:, None]  # (4,1,L,L)
+    rlo = torch.tensor(np.asarray(wav.rec_lo).copy(), dtype=torch.float32)
+    rhi = torch.tensor(np.asarray(wav.rec_hi).copy(), dtype=torch.float32)
+    # conv_transpose2d performs true convolution of the zero-stuffed input,
+    # so the synthesis kernels are the plain rec-filter outer products;
+    # padding L-2 trims the full convolution to length 2n - L + 2.
+    skern = torch.stack([torch.outer(a, b) for a in (rlo, rhi) for b in (rlo, rhi)])[
+        :, None
+    ]  # (in=4, out=1, L, L)
+
+    def dwt2(x):  # x: (B*C, 1, H, W) -> (B*C, 4, H', W')
+        xp = F.pad(x, (L - 1,) * 4, mode="reflect")[:, :, 1:, 1:]
+        return F.conv2d(xp, akern, stride=2)
+
+    def idwt2(c, out_hw):  # c: (B*C, 4, h, w)
+        y = F.conv_transpose2d(c, skern, stride=2, padding=L - 2)
+        return y[:, :, : out_hw[0], : out_hw[1]]
+
+    model = ResNetForImageClassification(
+        ResNetConfig(
+            depths=[3, 4, 6, 3],
+            layer_type="bottleneck",
+            hidden_sizes=[256, 512, 1024, 2048],
+            embedding_size=64,
+            num_labels=1000,
+        )
+    ).eval()
+
+    batch = 1 if QUICK else 2
+    image = 64 if QUICK else IMAGE
+    x = torch.randn(batch, 3, image, image)
+
+    def one_sample():
+        flat = x.reshape(-1, 1, image, image)
+        coeff_stack = []
+        a = flat
+        shapes = []
+        for _ in range(LEVELS):
+            shapes.append(a.shape[-2:])
+            c = dwt2(a)
+            a = c[:, :1]
+            coeff_stack.append(c[:, 1:].detach().requires_grad_(True))
+        approx = a.detach().requires_grad_(True)
+        # reconstruct
+        rec = approx
+        for det, hw in zip(reversed(coeff_stack), reversed(shapes)):
+            rec = idwt2(torch.cat([rec[:, :1], det], dim=1), hw)
+        img = rec.reshape(batch, 3, image, image)
+        out = model(img).logits
+        loss = out[:, 0].mean()
+        loss.backward()
+
+    one_sample()  # warm
+    t0 = time.perf_counter()
+    one_sample()
+    t = time.perf_counter() - t0
+    # cost scales linearly in samples; per-image throughput:
+    return batch / (t * N_SAMPLES)
+
+
+def main():
+    tpu = tpu_throughput()
+    try:
+        cpu = cpu_baseline_throughput()
+    except Exception as e:  # baseline must never block reporting
+        print(f"# cpu baseline failed: {e}", file=sys.stderr)
+        cpu = float("nan")
+    vs = tpu / cpu if cpu == cpu else float("nan")
+    print(
+        json.dumps(
+            {
+                "metric": "wam2d_smoothgrad_resnet50_b32_n25_attributions_per_sec",
+                "value": round(tpu, 3),
+                "unit": "images/s",
+                "vs_baseline": round(vs, 2) if vs == vs else None,
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

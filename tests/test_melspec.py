@@ -1,0 +1,83 @@
+"""Differentiable melspec front-end tests: STFT frequency localization,
+shapes, dB clamping, filterbank geometry, differentiability, approximate
+invertibility (SURVEY.md §7.2 'differentiating through the melspec')."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from wam_tpu.ops.melspec import (
+    amplitude_to_db,
+    mel_filterbank,
+    mel_to_stft_magnitude,
+    melspectrogram,
+    stft_power,
+)
+
+
+def test_stft_shape():
+    x = jnp.zeros((2, 4096))
+    p = stft_power(x, n_fft=256)
+    # center padding: n_frames = 1 + L // hop
+    assert p.shape == (2, 1 + 4096 // 128, 129)
+
+
+def test_stft_sine_peak():
+    """A pure tone must concentrate power at its FFT bin."""
+    sr, n_fft = 8192, 256
+    f = 32 * sr / n_fft  # exactly bin 32
+    t = np.arange(sr) / sr
+    x = jnp.asarray(np.sin(2 * np.pi * f * t), dtype=jnp.float32)[None]
+    p = np.asarray(stft_power(x, n_fft=n_fft))[0]
+    mid = p[p.shape[0] // 2]
+    assert mid.argmax() == 32
+
+
+def test_stft_matches_numpy_reference():
+    """Cross-check one non-centered frame against a direct numpy rFFT."""
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal(512).astype(np.float32)
+    p = np.asarray(stft_power(jnp.asarray(x)[None], n_fft=256, hop=128, center=False))[0]
+    win = np.hanning(257)[:-1]
+    for frame_i in range(3):
+        seg = x[frame_i * 128 : frame_i * 128 + 256] * win
+        expected = np.abs(np.fft.rfft(seg)) ** 2
+        np.testing.assert_allclose(p[frame_i], expected, rtol=1e-4, atol=1e-4)
+
+
+def test_mel_filterbank_geometry():
+    fb = mel_filterbank(129, 32, 8000)
+    assert fb.shape == (129, 32)
+    assert np.all(fb >= 0)
+    # every filter has some support and a single peak region
+    assert np.all(fb.max(axis=0) > 0)
+
+
+def test_amplitude_to_db_clamp():
+    out = np.asarray(amplitude_to_db(jnp.array([0.0, 1.0, 100.0])))
+    np.testing.assert_allclose(out, [-100.0, 0.0, 20.0], atol=1e-4)
+
+
+def test_melspectrogram_shape_and_grad():
+    x = jnp.asarray(np.random.default_rng(1).standard_normal((2, 2048)), dtype=jnp.float32)
+    mel = melspectrogram(x, sample_rate=8000, n_fft=256, n_mels=32)
+    assert mel.shape == (2, 1 + 2048 // 128, 32)
+
+    g = jax.grad(lambda v: melspectrogram(v, 8000, 256, 32).sum())(x)
+    assert g.shape == x.shape
+    assert np.all(np.isfinite(np.asarray(g)))
+    assert float(jnp.abs(g).max()) > 0
+
+
+def test_mel_inversion_approximate():
+    """pinv inversion recovers the coarse spectral shape of a tone."""
+    sr, n_fft, n_mels = 8192, 512, 64
+    t = np.arange(sr) / sr
+    x = jnp.asarray(np.sin(2 * np.pi * 440 * t), dtype=jnp.float32)[None]
+    mel = np.asarray(melspectrogram(x, sr, n_fft, n_mels, to_db=False))
+    mag = mel_to_stft_magnitude(mel, sr, n_fft, n_mels)
+    true_mag = np.sqrt(np.asarray(stft_power(x, n_fft=n_fft)))
+    # peak bin of the reconstruction must be near the true peak
+    got = mag[0, mag.shape[1] // 2].argmax()
+    want = true_mag[0, true_mag.shape[1] // 2].argmax()
+    assert abs(int(got) - int(want)) <= 2

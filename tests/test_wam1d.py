@@ -1,0 +1,172 @@
+"""End-to-end WAM-1D tests: dual-tap gradients (melspec + wavelet coeffs),
+scaleogram layout, filtering, SmoothGrad/IG estimators, plus AudioCNN and
+PointNet/Voxel model smoke tests."""
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from wam_tpu.wam1d import (
+    BaseWAM1D,
+    VisualizerWAM1D,
+    WaveletAttribution1D,
+    normalize_waveforms,
+    scaleogram,
+)
+
+SR, NFFT, NMELS, WLEN = 8000, 256, 32, 4096
+
+
+class TinyAudioModel(nn.Module):
+    classes: int = 6
+
+    @nn.compact
+    def __call__(self, x):  # (B, 1, T, M)
+        x = jnp.transpose(x, (0, 2, 3, 1))
+        x = nn.Conv(8, (3, 3), strides=(2, 2))(x)
+        x = nn.relu(x)
+        x = x.mean(axis=(1, 2))
+        return nn.Dense(self.classes)(x)
+
+
+@pytest.fixture(scope="module")
+def model_fn():
+    model = TinyAudioModel()
+    T = 1 + WLEN // (NFFT // 2)
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 1, T, NMELS)))
+    return lambda x: model.apply(params, x)
+
+
+def _wam_kwargs():
+    return dict(n_mels=NMELS, n_fft=NFFT, sample_rate=SR)
+
+
+def test_normalize_waveforms_list():
+    wfs = [np.array([1, 2, 4], dtype=np.int16), np.array([2, 8, 4], dtype=np.int16)]
+    out = np.asarray(normalize_waveforms(wfs))
+    np.testing.assert_allclose(out[0], [0.25, 0.5, 1.0])
+    np.testing.assert_allclose(out[1], [0.25, 1.0, 0.5])
+
+
+def test_base_wam1d_dual_taps(model_fn):
+    x = jnp.asarray(np.random.default_rng(0).standard_normal((2, WLEN)), dtype=jnp.float32)
+    wam = BaseWAM1D(model_fn, wavelet="db2", J=3, mode="symmetric", **_wam_kwargs())
+    mel_g, coeff_g = wam(x, jnp.array([1, 3]))
+    T = 1 + WLEN // (NFFT // 2)
+    assert mel_g.shape == (2, T, NMELS)
+    assert len(coeff_g) == 4
+    assert float(jnp.abs(mel_g).max()) > 0
+    assert float(jnp.abs(coeff_g[0]).max()) > 0
+    # gradient chain rule consistency: coeff grads nonzero across levels
+    for g in coeff_g:
+        assert np.all(np.isfinite(np.asarray(g)))
+
+
+def test_scaleogram_layout():
+    coeffs = [np.ones((2, 4)), np.ones((2, 4)) * 2, np.ones((2, 8)) * 3]
+    s = scaleogram(coeffs, J=2)
+    assert s.shape == (2, 3, 8)
+    # approx row: first 4 filled (normalized to 1), rest NaN
+    np.testing.assert_allclose(s[0, 0, :4], 1.0)
+    assert np.all(np.isnan(s[0, 0, 4:]))
+    np.testing.assert_allclose(s[0, 2], 1.0)  # finest fills whole row
+
+
+def test_filter_reconstruction(model_fn):
+    x = jnp.asarray(np.random.default_rng(1).standard_normal((1, WLEN)), dtype=jnp.float32)
+    wam = BaseWAM1D(model_fn, wavelet="haar", J=2, **_wam_kwargs())
+    wam(x, jnp.array([0]))
+    filtered = wam.filter(EPS=0.5)
+    assert filtered.shape[-1] >= WLEN
+    # EPS=0 keeps everything -> exact reconstruction
+    full = wam.filter(EPS=-1.0)
+    np.testing.assert_allclose(np.asarray(full)[..., :WLEN], np.asarray(x), atol=1e-4)
+
+
+def test_smooth_wam1d(model_fn):
+    x = jnp.asarray(np.random.default_rng(2).standard_normal((2, WLEN)), dtype=jnp.float32)
+    expl = WaveletAttribution1D(
+        model_fn, wavelet="haar", J=2, method="smooth", n_samples=4, **_wam_kwargs()
+    )
+    mel_avg, grads = expl(x, jnp.array([0, 2]))
+    assert mel_avg.shape[0] == 2 and len(grads) == 3
+    mel_avg2, _ = expl(x, jnp.array([0, 2]))
+    np.testing.assert_allclose(np.asarray(mel_avg), np.asarray(mel_avg2), atol=1e-6)
+
+
+def test_integrated_wam1d(model_fn):
+    x = jnp.asarray(np.random.default_rng(3).standard_normal((1, WLEN)), dtype=jnp.float32)
+    expl = WaveletAttribution1D(
+        model_fn, wavelet="db2", J=2, method="integratedgrad", n_samples=6, **_wam_kwargs()
+    )
+    mel_attr, coeff_attr = expl(x, jnp.array([4]))
+    assert np.all(np.isfinite(np.asarray(mel_attr)))
+    assert len(coeff_attr) == 3
+
+
+def test_visualizer_filters(model_fn):
+    rng = np.random.default_rng(4)
+    x = rng.standard_normal((2, WLEN)).astype(np.float32)
+    viz = VisualizerWAM1D(
+        model_fn, x, wavelet="haar", J=2, method="smooth", n_samples=2, **_wam_kwargs()
+    )
+    mel_g, grads = viz(x, jnp.array([0, 1]))
+    src, filt = viz.filtered_spectrogram_from_wavelet_coefficients(grads, "ht", EPS=0.3)
+    assert src.shape == filt.shape
+    src2, filt2 = viz.filtered_spectrogram_from_wavelet_coefficients(grads, "st", EPS=0.2)
+    assert np.all(np.isfinite(filt2))
+    src3, filt3 = viz.filtered_spectrogram_from_wavelet_coefficients(grads, "modulation")
+    assert np.all(np.isfinite(filt3))
+    msrc, mfilt = viz.filtered_spectrogram_from_melspec(np.asarray(mel_g), "ht", EPS=0.2)
+    assert msrc.shape == mfilt.shape
+    _, mfilt2 = viz.filtered_spectrogram_from_melspec(np.asarray(mel_g), "modulation")
+    assert np.all(np.isfinite(mfilt2))
+
+
+def test_audio_cnn_smoke():
+    from wam_tpu.models.audio import AudioCNN
+
+    model = AudioCNN(num_classes=50)
+    x = jnp.zeros((1, 1, 128, 128))
+    variables = model.init(jax.random.PRNGKey(0), x)
+    out, state = model.apply(variables, x, mutable=["intermediates"])
+    assert out.shape == (1, 50)
+    assert set(state["intermediates"]) == {"out0", "out1", "out2", "out3"}
+    assert np.all((np.asarray(out) >= 0) & (np.asarray(out) <= 1))  # sigmoid head
+
+
+def test_pointnet_smoke():
+    from wam_tpu.models.pointnet import PointNetCls, feature_transform_regularizer
+
+    model = PointNetCls(k=10, feature_transform=True)
+    x = jnp.asarray(np.random.default_rng(5).standard_normal((2, 3, 64)), dtype=jnp.float32)
+    variables = model.init(jax.random.PRNGKey(0), x)
+    logp, trans, trans_feat = model.apply(variables, x)
+    assert logp.shape == (2, 10)
+    np.testing.assert_allclose(np.exp(np.asarray(logp)).sum(axis=1), 1.0, atol=1e-4)
+    assert trans.shape == (2, 3, 3)
+    assert trans_feat.shape == (2, 64, 64)
+    reg = feature_transform_regularizer(trans)
+    assert float(reg) >= 0
+
+
+def test_pointnet_dense_smoke():
+    from wam_tpu.models.pointnet import PointNetDenseCls
+
+    model = PointNetDenseCls(k=4)
+    x = jnp.asarray(np.random.default_rng(6).standard_normal((1, 3, 32)), dtype=jnp.float32)
+    variables = model.init(jax.random.PRNGKey(0), x)
+    logp, _, _ = model.apply(variables, x)
+    assert logp.shape == (1, 32, 4)
+
+
+def test_voxel_model_smoke():
+    from wam_tpu.models.voxel import VoxelModel
+
+    model = VoxelModel(num_classes=10)
+    x = jnp.zeros((2, 1, 16, 16, 16))
+    variables = model.init(jax.random.PRNGKey(0), x)
+    out = model.apply(variables, x)
+    assert out.shape == (2, 10)

@@ -1,0 +1,71 @@
+"""Flax audio CNN — capability equivalent of the reference's VGG-style
+`weak_mxh64_1024` (`src/network_architectures.py:219-272`): 3×3 conv-BN-ReLU
+pairs with 2×2 maxpools, a 2×2 conv to 1024 channels, a 1×1 sigmoid head,
+global pooling; exposes the four intermediate activation taps (out0..out3)
+via `sow` for the GradCAM-family baselines.
+
+Input layout: melspec batches (B, 1, T, n_mels) (reference `src/dataloader.py`
+`[1, T, 128]` items) — converted to NHWC internally.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+__all__ = ["AudioCNN", "bind_audio_inference"]
+
+
+class AudioCNN(nn.Module):
+    num_classes: int = 50
+    pool: str = "max"  # reference passes F.max_pool2d / F.avg_pool2d as glplfn
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        # (B, 1, T, M) -> NHWC
+        x = jnp.transpose(x, (0, 2, 3, 1))
+        norm = partial(nn.BatchNorm, use_running_average=not train)
+
+        def block(z, feats, name):
+            z = nn.Conv(feats, (3, 3), padding=1, name=f"{name}_conv")(z)
+            z = norm(name=f"{name}_bn")(z)
+            return nn.relu(z)
+
+        x = block(x, 16, "b1")
+        x = block(x, 16, "b2")
+        x = nn.max_pool(x, (2, 2), (2, 2))
+        x = block(x, 32, "b3")
+        x = block(x, 32, "b4")
+        x = nn.max_pool(x, (2, 2), (2, 2))
+        x = block(x, 64, "b5")
+        x = block(x, 64, "b6")
+        x = nn.max_pool(x, (2, 2), (2, 2))
+        x = block(x, 128, "b7")
+        out0 = block(x, 128, "b8")
+        self.sow("intermediates", "out0", out0)
+        x = nn.max_pool(out0, (2, 2), (2, 2))
+        x = block(x, 256, "b9")
+        out1 = block(x, 256, "b10")
+        self.sow("intermediates", "out1", out1)
+        x = nn.max_pool(out1, (2, 2), (2, 2))
+        out2 = block(x, 512, "b11")
+        self.sow("intermediates", "out2", out2)
+        x = nn.max_pool(out2, (2, 2), (2, 2))
+        out3 = nn.relu(norm(name="b12_bn")(nn.Conv(1024, (2, 2), padding="VALID", name="b12_conv")(x)))
+        self.sow("intermediates", "out3", out3)
+        x = nn.sigmoid(nn.Conv(self.num_classes, (1, 1), name="head")(out3))
+        if self.pool == "max":
+            x = x.max(axis=(1, 2))
+        else:
+            x = x.mean(axis=(1, 2))
+        return x
+
+
+def bind_audio_inference(model: nn.Module, variables) -> Callable[[jax.Array], jax.Array]:
+    """Pure `(B, 1, T, M) -> (B, K)` function (the FtEx-wrapper role,
+    `src/helpers.py:289-325`)."""
+    return lambda x: model.apply(variables, x)

@@ -1,0 +1,112 @@
+"""Flax PointNet family — parity with `src/network_architectures.py:15-188`
+(STN3d / STNkd / PointNetfeat / PointNetCls / PointNetDenseCls +
+feature_transform_regularizer).
+
+Point clouds are (B, 3, N) like the reference; internally (B, N, C) so the
+1×1 Conv1d stacks become point-shared Dense layers (same math, MXU-friendly
+matmuls).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+__all__ = ["STN", "PointNetFeat", "PointNetCls", "PointNetDenseCls", "feature_transform_regularizer"]
+
+
+class STN(nn.Module):
+    """Spatial transformer: predicts a (k, k) alignment matrix (+identity)."""
+
+    k: int = 3
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        # x: (B, N, k)
+        norm = partial(nn.BatchNorm, use_running_average=not train)
+        z = nn.relu(norm(name="bn1")(nn.Dense(64, name="mlp1")(x)))
+        z = nn.relu(norm(name="bn2")(nn.Dense(128, name="mlp2")(z)))
+        z = nn.relu(norm(name="bn3")(nn.Dense(1024, name="mlp3")(z)))
+        z = z.max(axis=1)  # global max pool over points
+        z = nn.relu(norm(name="bn4")(nn.Dense(512, name="fc1")(z)))
+        z = nn.relu(norm(name="bn5")(nn.Dense(256, name="fc2")(z)))
+        z = nn.Dense(self.k * self.k, name="fc3")(z)
+        eye = jnp.eye(self.k, dtype=z.dtype).reshape(-1)
+        return (z + eye).reshape(-1, self.k, self.k)
+
+
+class PointNetFeat(nn.Module):
+    global_feat: bool = True
+    feature_transform: bool = False
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        # x: (B, 3, N) -> (B, N, 3)
+        x = jnp.transpose(x, (0, 2, 1))
+        n_pts = x.shape[1]
+        norm = partial(nn.BatchNorm, use_running_average=not train)
+        trans = STN(k=3, name="stn")(x, train)
+        x = jnp.einsum("bnk,bkj->bnj", x, trans)
+        x = nn.relu(norm(name="bn1")(nn.Dense(64, name="mlp1")(x)))
+        if self.feature_transform:
+            trans_feat = STN(k=64, name="fstn")(x, train)
+            x = jnp.einsum("bnk,bkj->bnj", x, trans_feat)
+        else:
+            trans_feat = None
+        point_feat = x
+        x = nn.relu(norm(name="bn2")(nn.Dense(128, name="mlp2")(x)))
+        x = norm(name="bn3")(nn.Dense(1024, name="mlp3")(x))
+        x = x.max(axis=1)  # (B, 1024)
+        if self.global_feat:
+            return x, trans, trans_feat
+        tiled = jnp.repeat(x[:, None, :], n_pts, axis=1)
+        return jnp.concatenate([tiled, point_feat], axis=-1), trans, trans_feat
+
+
+class PointNetCls(nn.Module):
+    k: int = 2
+    feature_transform: bool = False
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        norm = partial(nn.BatchNorm, use_running_average=not train)
+        feat, trans, trans_feat = PointNetFeat(
+            global_feat=True, feature_transform=self.feature_transform, name="feat"
+        )(x, train)
+        z = nn.relu(norm(name="bn1")(nn.Dense(512, name="fc1")(feat)))
+        z = nn.Dense(256, name="fc2")(z)
+        if train:
+            z = nn.Dropout(0.3, deterministic=False)(z)
+        z = nn.relu(norm(name="bn2")(z))
+        z = nn.Dense(self.k, name="fc3")(z)
+        return nn.log_softmax(z, axis=1), trans, trans_feat
+
+
+class PointNetDenseCls(nn.Module):
+    """Per-point segmentation head (`src/network_architectures.py:154-179`)."""
+
+    k: int = 2
+    feature_transform: bool = False
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        norm = partial(nn.BatchNorm, use_running_average=not train)
+        feat, trans, trans_feat = PointNetFeat(
+            global_feat=False, feature_transform=self.feature_transform, name="feat"
+        )(x, train)  # (B, N, 1088)
+        z = nn.relu(norm(name="bn1")(nn.Dense(512, name="c1")(feat)))
+        z = nn.relu(norm(name="bn2")(nn.Dense(256, name="c2")(z)))
+        z = nn.relu(norm(name="bn3")(nn.Dense(128, name="c3")(z)))
+        z = nn.Dense(self.k, name="c4")(z)
+        return nn.log_softmax(z, axis=-1), trans, trans_feat
+
+
+def feature_transform_regularizer(trans: jax.Array) -> jax.Array:
+    """‖T Tᵀ − I‖ mean over the batch (`src/network_architectures.py:181-188`)."""
+    d = trans.shape[1]
+    eye = jnp.eye(d, dtype=trans.dtype)
+    diff = jnp.einsum("bij,bkj->bik", trans, trans) - eye
+    return jnp.linalg.norm(diff, axis=(1, 2)).mean()

@@ -1,0 +1,29 @@
+"""Flax 3D voxel CNN — parity with the reference's `VoxelModel`
+(`src/network_architectures.py:190-215`): two (Conv3d → ReLU → MaxPool3d)
+stages then an MLP head, for 16³ voxel grids (3D-MNIST).
+
+Input layout: (B, 1, D, H, W) like the reference; NDHWC internally.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+__all__ = ["VoxelModel"]
+
+
+class VoxelModel(nn.Module):
+    num_classes: int = 10
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = jnp.transpose(x, (0, 2, 3, 4, 1))  # (B, D, H, W, C)
+        x = nn.relu(nn.Conv(32, (3, 3, 3), padding="VALID", name="conv1")(x))
+        x = nn.max_pool(x, (2, 2, 2), (2, 2, 2))
+        x = nn.relu(nn.Conv(128, (3, 3, 3), padding="VALID", name="conv2")(x))
+        x = nn.max_pool(x, (2, 2, 2), (2, 2, 2))
+        self.sow("intermediates", "features", x)
+        x = x.reshape(x.shape[0], -1)
+        x = nn.relu(nn.Dense(256, name="fc1")(x))
+        return nn.Dense(self.num_classes, name="fc2")(x)

@@ -1,0 +1,103 @@
+"""Differentiable mel-spectrogram front-end (pure JAX).
+
+TPU-native replacement for the reference's torchaudio chain
+``MelSpectrogram(sample_rate, n_fft, n_mels)`` + ``AmplitudeToDB()``
+(`lib/wam_1D.py:194-219`). The 1D attribution path backprops *through* this
+front-end (`lib/wam_1D.py:117-126`), so everything here is jnp and
+differentiable: framing (gather), Hann window, rfft, power, mel filterbank
+matmul (MXU-friendly), and a clamped log10.
+
+Conventions follow torchaudio defaults the reference relies on: hop =
+n_fft // 2, centered reflect padding, power spectrogram (|STFT|²), HTK mel
+scale, f_min=0, f_max=sr/2, no filterbank norm; AmplitudeToDB 'power' mode:
+10·log10(max(x, 1e-10)).
+
+Also provides the host-side approximate inverse (mel → STFT magnitude) used
+only for visualization (`lib/wam_1D.py:442-448` uses librosa's NNLS; here a
+pinv + clip — same role, viz-only).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["mel_filterbank", "stft_power", "melspectrogram", "amplitude_to_db", "mel_to_stft_magnitude"]
+
+
+def _hz_to_mel(f):
+    return 2595.0 * np.log10(1.0 + np.asarray(f) / 700.0)
+
+
+def _mel_to_hz(m):
+    return 700.0 * (10.0 ** (np.asarray(m) / 2595.0) - 1.0)
+
+
+@functools.lru_cache(maxsize=None)
+def mel_filterbank(n_freqs: int, n_mels: int, sample_rate: int, f_min: float = 0.0, f_max: float | None = None) -> np.ndarray:
+    """Triangular HTK-scale filterbank, shape (n_freqs, n_mels)."""
+    f_max = sample_rate / 2 if f_max is None else f_max
+    freqs = np.linspace(0, sample_rate / 2, n_freqs)
+    mel_pts = np.linspace(_hz_to_mel(f_min), _hz_to_mel(f_max), n_mels + 2)
+    hz_pts = _mel_to_hz(mel_pts)
+    fb = np.zeros((n_freqs, n_mels))
+    for m in range(n_mels):
+        lo, ctr, hi = hz_pts[m], hz_pts[m + 1], hz_pts[m + 2]
+        up = (freqs - lo) / max(ctr - lo, 1e-10)
+        down = (hi - freqs) / max(hi - ctr, 1e-10)
+        fb[:, m] = np.clip(np.minimum(up, down), 0.0, None)
+    return fb.astype(np.float32)
+
+
+def stft_power(x: jax.Array, n_fft: int = 1024, hop: int | None = None, center: bool = True) -> jax.Array:
+    """Power spectrogram |STFT|² with a Hann window.
+
+    x: (..., L) → (..., n_frames, n_fft//2 + 1). Differentiable.
+    """
+    hop = n_fft // 2 if hop is None else hop
+    if center:
+        pad = [(0, 0)] * (x.ndim - 1) + [(n_fft // 2, n_fft // 2)]
+        x = jnp.pad(x, pad, mode="reflect")
+    L = x.shape[-1]
+    n_frames = 1 + (L - n_fft) // hop
+    idx = np.arange(n_frames)[:, None] * hop + np.arange(n_fft)[None, :]
+    frames = x[..., idx]  # (..., n_frames, n_fft)
+    window = jnp.asarray(np.hanning(n_fft + 1)[:-1], dtype=x.dtype)  # periodic Hann
+    spec = jnp.fft.rfft(frames * window, axis=-1)
+    return jnp.abs(spec) ** 2
+
+
+def amplitude_to_db(power: jax.Array, amin: float = 1e-10) -> jax.Array:
+    """10·log10(max(x, amin)) — torchaudio AmplitudeToDB('power'), ref=1."""
+    return 10.0 * jnp.log10(jnp.maximum(power, amin))
+
+
+def melspectrogram(
+    x: jax.Array,
+    sample_rate: int = 44100,
+    n_fft: int = 1024,
+    n_mels: int = 128,
+    hop: int | None = None,
+    to_db: bool = True,
+) -> jax.Array:
+    """Batch melspectrogram: (..., L) → (..., n_frames, n_mels).
+
+    Matches the reference's per-waveform layout after its transpose
+    (`lib/wam_1D.py:216`: time-major, mel channels last).
+    """
+    p = stft_power(x, n_fft=n_fft, hop=hop)
+    fb = jnp.asarray(mel_filterbank(n_fft // 2 + 1, n_mels, sample_rate), dtype=x.dtype)
+    mel = p @ fb  # (..., n_frames, n_mels)
+    return amplitude_to_db(mel) if to_db else mel
+
+
+def mel_to_stft_magnitude(mel_power: np.ndarray, sample_rate: int, n_fft: int, n_mels: int) -> np.ndarray:
+    """Approximate inverse mel projection (host-side, viz-only): least-squares
+    via pseudo-inverse, clipped to non-negative, then sqrt to magnitude."""
+    fb = mel_filterbank(n_fft // 2 + 1, n_mels, sample_rate)  # (F, M)
+    pinv = np.linalg.pinv(fb)  # (M, F)
+    power = np.clip(mel_power @ pinv, 0.0, None)  # (..., T, F)
+    return np.sqrt(power)

@@ -1,0 +1,10 @@
+from wam_tpu.parallel.mesh import P, data_sample_mesh, make_mesh
+from wam_tpu.parallel.sharded import sharded_integrated_path, sharded_smoothgrad
+
+__all__ = [
+    "make_mesh",
+    "data_sample_mesh",
+    "P",
+    "sharded_smoothgrad",
+    "sharded_integrated_path",
+]

@@ -1,0 +1,59 @@
+"""Device-mesh helpers: the distributed backbone of the framework.
+
+The reference has no distributed execution at all (SURVEY.md §2.10); the
+TPU-native counterpart scales WAM's two embarrassingly-parallel axes — the
+image batch and the estimator's noise/path samples — over a
+`jax.sharding.Mesh`, with XLA inserting the ICI collectives (psum for the
+sample mean, all_gather for mosaic assembly) per `BASELINE.json`'s
+north-star design.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+__all__ = ["make_mesh", "data_sample_mesh", "P", "NamedSharding", "Mesh"]
+
+P = PartitionSpec
+
+
+def make_mesh(axis_sizes: dict[str, int], devices=None) -> Mesh:
+    """Build a Mesh with named axes from an {axis: size} mapping.
+
+    The product of sizes must equal the device count (use -1 for one axis to
+    infer it)."""
+    devices = jax.devices() if devices is None else devices
+    sizes = dict(axis_sizes)
+    unknown = [k for k, v in sizes.items() if v == -1]
+    known = math.prod(v for v in sizes.values() if v != -1)
+    if len(unknown) > 1:
+        raise ValueError("At most one axis size may be -1")
+    if unknown:
+        if len(devices) % known:
+            raise ValueError(f"{len(devices)} devices not divisible by {known}")
+        sizes[unknown[0]] = len(devices) // known
+    if math.prod(sizes.values()) != len(devices):
+        raise ValueError(f"Mesh {sizes} does not match {len(devices)} devices")
+    arr = np.asarray(devices).reshape(tuple(sizes.values()))
+    return Mesh(arr, tuple(sizes))
+
+
+def data_sample_mesh(n_devices: int | None = None, devices=None) -> Mesh:
+    """Default 2D mesh for attribution workloads: ('data', 'sample').
+
+    Splits the device count into the most square data×sample factorization,
+    favoring the data axis.
+    """
+    devices = jax.devices() if devices is None else devices
+    if n_devices is not None:
+        devices = devices[:n_devices]
+    n = len(devices)
+    best_sample = 1
+    for s in range(1, int(math.isqrt(n)) + 1):
+        if n % s == 0:
+            best_sample = s
+    return make_mesh({"data": n // best_sample, "sample": best_sample}, devices)

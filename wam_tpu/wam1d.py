@@ -1,0 +1,372 @@
+"""WAM-1D: audio/waveform attribution in the wavelet domain (TPU-native).
+
+Capability parity with `lib/wam_1D.py` (BaseWAM1D / WaveletAttribution1D /
+VisualizerWAM1D): the differentiable chain is
+
+    waveform → DWT → IDWT → mel-spectrogram → CNN → diag-logit loss
+
+with gradients harvested at TWO taps — the wavelet coefficients and the
+melspec pixels (`lib/wam_1D.py:117-150`) — here obtained from a single
+backward pass via the engine's zero-tap trick instead of retain_grad.
+
+Outputs follow the reference layout: melspec gradients (N, T, n_mels) and a
+coefficient-gradient list [cA_J, cD_J, ..., cD_1].
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from wam_tpu.core.engine import WamEngine
+from wam_tpu.core.estimators import smoothgrad, trapezoid
+from wam_tpu.ops.melspec import melspectrogram, mel_to_stft_magnitude, stft_power
+from wam_tpu.wavelets import wavedec, waverec
+
+__all__ = [
+    "normalize_waveforms",
+    "BaseWAM1D",
+    "WaveletAttribution1D",
+    "VisualizerWAM1D",
+    "scaleogram",
+]
+
+
+def normalize_waveforms(x) -> jnp.ndarray:
+    """List of (possibly int16) waveforms → (N, W) float32, each divided by
+    its max (`lib/wam_1D.py:105-106`)."""
+    if isinstance(x, (list, tuple)):
+        x = np.stack([np.asarray(wf) / np.asarray(wf).max() for wf in x])
+    return jnp.asarray(x, dtype=jnp.float32)
+
+
+def scaleogram(coeff_grads: Sequence, J: int) -> np.ndarray:
+    """Pseudo-scaleogram (B, J+1, maxlen), NaN-padded: row 0 = normalized
+    |approx| grads, row j+1 = level-j details, coarsest first
+    (`lib/wam_1D.py:152-192`). Host-side viz helper."""
+    arrs = [np.asarray(c) for c in coeff_grads]
+    batch = arrs[0].shape[0]
+    maxlen = arrs[-1].shape[-1]
+    out = np.full((batch, J + 1, maxlen), np.nan)
+    for i in range(batch):
+        for j, level in enumerate(arrs):
+            a = np.abs(level[i])
+            m = a.max()
+            out[i, j, : a.shape[-1]] = a / (m if m > 0 else 1.0)
+    return out
+
+
+class BaseWAM1D:
+    """Single-pass WAM-1D (`lib/wam_1D.py:54-150`).
+
+    ``model_fn`` maps melspec batches (N, 1, T, n_mels) to logits; the mel
+    front-end is built in (differentiable, wam_tpu.ops.melspec).
+    """
+
+    def __init__(
+        self,
+        model_fn: Callable[[jax.Array], jax.Array],
+        wavelet: str = "haar",
+        J: int = 2,
+        mode: str = "symmetric",
+        approx_coeffs: bool = False,
+        n_mels: int = 128,
+        n_fft: int = 1024,
+        sample_rate: int = 44100,
+    ):
+        self.wavelet = wavelet
+        self.J = J
+        self.mode = mode
+        self.approx_coeffs = approx_coeffs
+        self.n_mels = n_mels
+        self.n_fft = n_fft
+        self.sample_rate = sample_rate
+
+        def front(wave):  # (N, W) -> (N, 1, T, n_mels)
+            mel = melspectrogram(wave, sample_rate=sample_rate, n_fft=n_fft, n_mels=n_mels)
+            return mel[:, None, :, :]
+
+        self.engine = WamEngine(
+            model_fn, ndim=1, wavelet=wavelet, level=J, mode=mode, front_fn=front
+        )
+
+    def compute_melspec(self, wave: jax.Array) -> jax.Array:
+        """(N, W) → (N, 1, T, n_mels) in dB (lib/wam_1D.py:194-219)."""
+        mel = melspectrogram(
+            wave, sample_rate=self.sample_rate, n_fft=self.n_fft, n_mels=self.n_mels
+        )
+        return mel[:, None, :, :]
+
+    def __call__(self, x, y, waveform: bool = True):
+        """Returns (melspec gradients (N, T, n_mels), coefficient-gradient
+        list). ``waveform=False`` passes a coefficient pytree directly, the
+        IG path's entry point (`lib/wam_1D.py:111-112`)."""
+        if waveform:
+            x = normalize_waveforms(x)
+            coeffs = self.engine.decompose(x)
+            length = x.shape[-1]
+        else:
+            coeffs = x
+            length = waverec(coeffs, self.wavelet).shape[-1]
+        y = jnp.asarray(y)
+
+        def loss(cs, tap):
+            wave = self.engine.reconstruct(cs, (length,))
+            mel = self.engine.front_fn(wave) + tap
+            out = self.engine.model_fn(mel)
+            picked = jnp.take_along_axis(out, y[:, None], axis=1)[:, 0]
+            return picked.mean()
+
+        mel_shape = jax.eval_shape(
+            lambda cs: self.engine.front_fn(self.engine.reconstruct(cs, (length,))), coeffs
+        )
+        g_coeffs, g_mel = jax.grad(loss, argnums=(0, 1))(
+            coeffs, jnp.zeros(mel_shape.shape, mel_shape.dtype)
+        )
+        self.wavelet_coeffs = coeffs
+        self.gradient_coeffs = g_coeffs
+        return g_mel[:, 0, :, :], g_coeffs
+
+    def visualize_grad_wam(self, coeff_grads):
+        return scaleogram(coeff_grads, self.J)
+
+    def filter(self, EPS: float):
+        """Hard-threshold reconstruction: keep coefficients whose normalized
+        |gradient| exceeds EPS, then inverse transform
+        (`lib/wam_1D.py:221-246`)."""
+        masks = [
+            (jnp.abs(g) / jnp.max(jnp.abs(g)) > EPS).astype(jnp.float32)
+            for g in self.gradient_coeffs
+        ]
+        filtered = [c * m for c, m in zip(self.wavelet_coeffs, masks)]
+        return waverec(filtered, self.wavelet)
+
+
+class WaveletAttribution1D(BaseWAM1D):
+    """SmoothGrad / IG WAM-1D (`lib/wam_1D.py:249-435`), one jit graph."""
+
+    def __init__(
+        self,
+        model_fn,
+        wavelet: str = "haar",
+        J: int = 3,
+        method: str = "smooth",
+        mode: str = "reflect",
+        approx_coeffs: bool = False,
+        n_mels: int = 128,
+        n_fft: int = 1024,
+        sample_rate: int = 44100,
+        n_samples: int = 25,
+        stdev_spread: float = 0.001,
+        random_seed: int = 42,
+        sample_batch_size: int | None = None,
+    ):
+        super().__init__(
+            model_fn,
+            wavelet=wavelet,
+            J=J,
+            mode=mode,
+            approx_coeffs=approx_coeffs,
+            n_mels=n_mels,
+            n_fft=n_fft,
+            sample_rate=sample_rate,
+        )
+        if method not in ("smooth", "integratedgrad"):
+            raise ValueError(f"Unknown method {method!r}")
+        self.method = method
+        self.n_samples = n_samples
+        self.stdev_spread = stdev_spread
+        self.random_seed = random_seed
+        self.sample_batch_size = sample_batch_size
+
+    def _tap_grads(self, x, y):
+        """(mel grads, coeff grads) for one (possibly perturbed) batch."""
+        coeffs = self.engine.decompose(x)
+        length = x.shape[-1]
+
+        def loss(cs, tap):
+            wave = self.engine.reconstruct(cs, (length,))
+            mel = self.engine.front_fn(wave) + tap
+            out = self.engine.model_fn(mel)
+            return jnp.take_along_axis(out, y[:, None], axis=1)[:, 0].mean()
+
+        mel_shape = jax.eval_shape(
+            lambda cs: self.engine.front_fn(self.engine.reconstruct(cs, (length,))), coeffs
+        )
+        g_coeffs, g_mel = jax.grad(loss, argnums=(0, 1))(
+            coeffs, jnp.zeros(mel_shape.shape, mel_shape.dtype)
+        )
+        return g_mel[:, 0, :, :], g_coeffs
+
+    def smooth_wam(self, x, y):
+        x = normalize_waveforms(x)
+        y = jnp.asarray(y)
+        key = jax.random.PRNGKey(self.random_seed)
+
+        @jax.jit
+        def run(x, key):
+            return smoothgrad(
+                lambda noisy: self._tap_grads(noisy, y),
+                x,
+                key,
+                n_samples=self.n_samples,
+                stdev_spread=self.stdev_spread,
+                batch_size=self.sample_batch_size,
+            )
+
+        mel_avg, grad_avg = run(x, key)
+        self.melspecs = mel_avg
+        self.grad_coeffs = grad_avg
+        return mel_avg, grad_avg
+
+    def integrated_wam(self, x, y):
+        """Path integral per tap, each multiplied by its baseline: melspec ×
+        ∫ mel-grads, coeffs × ∫ coeff-grads (`lib/wam_1D.py:353-421`)."""
+        x = normalize_waveforms(x)
+        y = jnp.asarray(y)
+
+        @jax.jit
+        def run(x):
+            coeffs = self.engine.decompose(x)
+            baseline_mel = self.compute_melspec(x)[:, 0]
+            alphas = jnp.linspace(0.0, 1.0, self.n_samples, dtype=x.dtype)
+
+            def one(alpha):
+                scaled = jax.tree_util.tree_map(lambda c: c * alpha, coeffs)
+                return self._tap_grads_from_coeffs(scaled, y, x.shape[-1])
+
+            path = jax.lax.map(one, alphas, batch_size=self.sample_batch_size)
+            integ = jax.tree_util.tree_map(trapezoid, path)
+            mel_attr = baseline_mel * integ[0]
+            coeff_attr = [c * g for c, g in zip(coeffs, integ[1])]
+            return mel_attr, coeff_attr
+
+        mel_attr, coeff_attr = run(x)
+        self.melspecs = mel_attr
+        self.grad_coeffs = coeff_attr
+        return mel_attr, coeff_attr
+
+    def _tap_grads_from_coeffs(self, coeffs, y, length):
+        def loss(cs, tap):
+            wave = self.engine.reconstruct(cs, (length,))
+            mel = self.engine.front_fn(wave) + tap
+            out = self.engine.model_fn(mel)
+            return jnp.take_along_axis(out, y[:, None], axis=1)[:, 0].mean()
+
+        mel_shape = jax.eval_shape(
+            lambda cs: self.engine.front_fn(self.engine.reconstruct(cs, (length,))), coeffs
+        )
+        g_coeffs, g_mel = jax.grad(loss, argnums=(0, 1))(
+            coeffs, jnp.zeros(mel_shape.shape, mel_shape.dtype)
+        )
+        return g_mel[:, 0, :, :], g_coeffs
+
+    def alter(self, alpha, coeffs):
+        return [alpha * c for c in coeffs]
+
+    def __call__(self, x, y):
+        if self.method == "smooth":
+            return self.smooth_wam(x, y)
+        return self.integrated_wam(x, y)
+
+
+def _minmax_normalize(a):
+    lo, hi = np.min(a), np.max(a)
+    return (a - lo) / (hi - lo if hi > lo else 1.0)
+
+
+class VisualizerWAM1D(WaveletAttribution1D):
+    """Spectrogram-domain filtering/visualization (`lib/wam_1D.py:451-643`).
+
+    Host-side (numpy) post-processing of attribution outputs: melspec
+    filtering (ht / modulation), wavelet-domain filtering (ht / st /
+    modulation), and spectrogram rendering. The mel→STFT inversion uses a
+    pinv projection (librosa's NNLS equivalent role, viz-only).
+    """
+
+    def __init__(self, model_fn, x, **kwargs):
+        super().__init__(model_fn, **kwargs)
+        self.x = x
+        self.source_spectrograms = None
+
+    def compute_melspec_power(self, x) -> np.ndarray:
+        """Power-scale melspec (no dB), (N, n_mels, T) mel-major like the
+        reference's viz layout (`lib/wam_1D.py:457-476`)."""
+        wave = normalize_waveforms(x)
+        mel = melspectrogram(
+            wave, sample_rate=self.sample_rate, n_fft=self.n_fft, n_mels=self.n_mels, to_db=False
+        )
+        return np.transpose(np.asarray(mel), (0, 2, 1))
+
+    def compute_spectrogram(self, melspecs: np.ndarray) -> np.ndarray:
+        """Approximate STFT magnitudes from mel-power spectrograms."""
+        out = [
+            mel_to_stft_magnitude(m.T, self.sample_rate, self.n_fft, self.n_mels).T
+            for m in melspecs
+        ]
+        return np.asarray(out)
+
+    def filter_melspec(self, audio_melspecs, grad_melspecs, filtering_method, EPS=0.2):
+        """ht: binary mask of min-max-normalized grads > EPS; modulation:
+        melspec × |grads| (`lib/wam_1D.py:490-520`)."""
+        grads = np.transpose(np.asarray(grad_melspecs), (0, 2, 1))
+        if filtering_method == "ht":
+            mask = (_minmax_normalize(grads) > EPS).astype(audio_melspecs.dtype)
+            return audio_melspecs * mask
+        if filtering_method == "modulation":
+            return audio_melspecs * np.abs(grads)
+        raise ValueError(f"Unknown filtering method {filtering_method!r}")
+
+    def spectrogram_from_waveform(self, waveform) -> np.ndarray:
+        """|STFT| with hop n_fft//4 (`lib/wam_1D.py:522-530`), freq-major."""
+        wave = normalize_waveforms(waveform)
+        p = stft_power(wave, n_fft=self.n_fft, hop=self.n_fft // 4)
+        return np.sqrt(np.asarray(p)).transpose(0, 2, 1)
+
+    def filter_from_wavelet_coefficients(self, coefficients, gradients, filtering_method="ht", EPS=0.2):
+        """Wavelet-domain filtering then inverse transform
+        (`lib/wam_1D.py:532-587`): ht = binary mask on normalized |grads|;
+        st = soft shrinkage of normalized coeff·grad; modulation =
+        coeff × |grad| re-weighted by per-scale importance shares."""
+        coefficients = [np.asarray(c) for c in coefficients]
+        gradients = [np.asarray(g) for g in gradients]
+        if filtering_method == "ht":
+            masks = [
+                (np.abs(g) / np.max(np.abs(g)) > EPS).astype(np.float32) for g in gradients
+            ]
+            filtered = [c * m for c, m in zip(coefficients, masks)]
+        elif filtering_method == "st":
+            masks = [
+                np.maximum(_minmax_normalize(c * g) - EPS, 0.0)
+                for c, g in zip(coefficients, gradients)
+            ]
+            filtered = [c * m for c, m in zip(coefficients, masks)]
+        elif filtering_method == "modulation":
+            # per-scale importance share: sum of grads per level, normalized
+            # over levels for each batch element
+            importances = np.stack([g.sum(axis=-1) for g in gradients])  # (L, B)
+            shares = importances / np.maximum(importances.sum(axis=0, keepdims=True), 1e-12)
+            modulated = [c * np.abs(g) for c, g in zip(coefficients, gradients)]
+            filtered = [m * shares[i][:, None] for i, m in enumerate(modulated)]
+        else:
+            raise ValueError(f"Unknown filtering method {filtering_method!r}")
+        rec = waverec([jnp.asarray(c, dtype=jnp.float32) for c in filtered], self.wavelet)
+        return np.asarray(rec)
+
+    def filtered_spectrogram_from_wavelet_coefficients(self, grad_coeffs, filtering_method, EPS=0.2):
+        wave = normalize_waveforms(self.x)
+        self.source_spectrograms = self.spectrogram_from_waveform(wave)
+        coeffs = wavedec(wave, self.wavelet, level=self.J, mode=self.mode)
+        filtered = self.filter_from_wavelet_coefficients(
+            coeffs, grad_coeffs, filtering_method=filtering_method, EPS=EPS
+        )
+        return self.source_spectrograms, self.spectrogram_from_waveform(filtered)
+
+    def filtered_spectrogram_from_melspec(self, grad_melspecs, filtering_method, EPS=0.2):
+        audio_melspecs = self.compute_melspec_power(self.x)
+        self.source_spectrograms = self.compute_spectrogram(audio_melspecs)
+        filtered = self.filter_melspec(audio_melspecs, grad_melspecs, filtering_method, EPS=EPS)
+        return self.source_spectrograms, self.compute_spectrogram(filtered)
