@@ -1,0 +1,130 @@
+"""WAM-3D tests: cube layout goldens, voxel end-to-end with the Flax
+VoxelModel, y=None representation mode, filtering round-trips, estimators,
+point-cloud path, visualization shapes."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from wam_tpu.ops.packing3d import cube3d, visualize_cube
+from wam_tpu.wam3d import BaseWAM3D, WaveletAttribution3D, filter_coeffs
+from wam_tpu.wavelets import wavedec3
+
+
+def _const_coeffs(J=2, size=16, batch=1):
+    coeffs = []
+    n = size // (2**J)
+    coeffs.append(jnp.full((batch, n, n, n), 10.0))
+    keys = ("aad", "ada", "add", "daa", "dad", "dda", "ddd")
+    for lev in range(J, 0, -1):
+        n = size // (2**lev)
+        coeffs.append({k: jnp.full((batch, n, n, n), float(lev) + i / 10.0) for i, k in enumerate(keys)})
+    return coeffs
+
+
+def test_cube_layout():
+    cube = np.asarray(cube3d(_const_coeffs(J=1, size=8)))[0]
+    assert cube.shape == (8, 8, 8)
+    np.testing.assert_allclose(cube[:4, :4, :4], 10.0)  # approx corner
+    np.testing.assert_allclose(cube[4:, 4:, 4:], 1.6)  # ddd
+    np.testing.assert_allclose(cube[:4, :4, 4:], 1.0)  # aad
+    np.testing.assert_allclose(cube[:4, 4:, :4], 1.1)  # ada
+    np.testing.assert_allclose(cube[:4, 4:, 4:], 1.2)  # add
+    np.testing.assert_allclose(cube[4:, :4, :4], 1.3)  # daa
+    np.testing.assert_allclose(cube[4:, :4, 4:], 1.4)  # dad
+    np.testing.assert_allclose(cube[4:, 4:, :4], 1.5)  # dda
+
+
+def test_cube_from_real_transform():
+    x = jnp.asarray(np.random.default_rng(0).standard_normal((2, 16, 16, 16)), dtype=jnp.float32)
+    coeffs = wavedec3(x, "haar", level=2)
+    cube = cube3d(coeffs)
+    assert cube.shape == (2, 16, 16, 16)
+    assert np.all(np.asarray(cube) >= 0)
+
+
+def test_filter_coeffs():
+    c = jnp.array([0.0, 0.5, 1.0])
+    np.testing.assert_array_equal(np.asarray(filter_coeffs(c, 0.4)), [0, 1, 1])
+    np.testing.assert_array_equal(np.asarray(filter_coeffs(c, 0.5, normalized=True)), [0, 1, 1])
+
+
+@pytest.fixture(scope="module")
+def voxel_model_fn():
+    from wam_tpu.models.voxel import VoxelModel
+
+    model = VoxelModel(num_classes=10)
+    variables = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 1, 16, 16, 16)))
+    return lambda x: model.apply(variables, x)
+
+
+def test_base_wam3d_voxels(voxel_model_fn):
+    x = jnp.asarray(np.random.default_rng(1).standard_normal((2, 1, 16, 16, 16)), dtype=jnp.float32)
+    wam = BaseWAM3D(voxel_model_fn, wavelet="haar", J=2)
+    cube = wam(x, jnp.array([3, 7]))
+    assert cube.shape == (2, 16, 16, 16)
+    assert float(jnp.abs(cube).max()) > 0
+
+
+def test_base_wam3d_representation_mode(voxel_model_fn):
+    x = jnp.asarray(np.random.default_rng(2).standard_normal((1, 1, 16, 16, 16)), dtype=jnp.float32)
+    wam = BaseWAM3D(voxel_model_fn, wavelet="haar", J=1)
+    cube = wam(x, None)  # y=None -> mean-representation gradients
+    assert cube.shape == (1, 16, 16, 16)
+
+
+def test_filter_voxels_roundtrip(voxel_model_fn):
+    x = jnp.asarray(np.random.default_rng(3).standard_normal((2, 1, 16, 16, 16)), dtype=jnp.float32)
+    wam = BaseWAM3D(voxel_model_fn, wavelet="haar", J=1)
+    wam(x, jnp.array([0, 1]))
+    filtered = wam.filter_voxels(EPS=0.0)
+    assert filtered.shape == (2, 1, 16, 16, 16)
+    assert np.all(np.isfinite(np.asarray(filtered)))
+
+
+def test_smooth_wam3d(voxel_model_fn):
+    x = jnp.asarray(np.random.default_rng(4).standard_normal((1, 1, 16, 16, 16)), dtype=jnp.float32)
+    expl = WaveletAttribution3D(voxel_model_fn, J=2, method="smooth", n_samples=4, stdev_spread=0.1)
+    cube = expl(x, jnp.array([5]))
+    assert cube.shape == (1, 16, 16, 16)
+    cube2 = expl(x, jnp.array([5]))
+    np.testing.assert_allclose(np.asarray(cube), np.asarray(cube2), atol=1e-6)
+    viz = expl.visualize()
+    assert viz.shape == (1, 4, 16, 16, 16)
+    assert np.all(np.isfinite(np.asarray(viz)))
+
+
+def test_integrated_wam3d(voxel_model_fn):
+    x = jnp.asarray(np.random.default_rng(5).standard_normal((1, 1, 16, 16, 16)), dtype=jnp.float32)
+    expl = WaveletAttribution3D(voxel_model_fn, J=1, method="integratedgrad", n_samples=5)
+    cube = expl(x, jnp.array([2]))
+    assert cube.shape == (1, 16, 16, 16)
+    assert np.all(np.isfinite(np.asarray(cube)))
+
+
+def test_point_cloud_path():
+    from wam_tpu.models.pointnet import PointNetCls
+
+    model = PointNetCls(k=5)
+    xinit = jnp.zeros((1, 3, 64))
+    variables = model.init(jax.random.PRNGKey(0), xinit)
+    model_fn = lambda x: model.apply(variables, x)[0]
+
+    x = jnp.asarray(np.random.default_rng(6).standard_normal((2, 3, 64)), dtype=jnp.float32)
+    wam = BaseWAM3D(model_fn, wavelet="haar", J=2, instance="point_clouds", EPS=0.1)
+    grads = wam(x, jnp.array([1, 2]))
+    assert len(grads) == 3  # xyz
+    assert len(grads[0]) == 3  # J+1 levels
+    kept, importance = wam.filter_point_clouds()
+    assert importance.shape == (2, 64)
+    assert len(kept) == 2
+    assert all(k.shape[-1] == 3 or k.shape[0] == 0 or k.ndim == 2 for k in kept)
+
+
+def test_visualize_cube_channels():
+    cube = jnp.asarray(np.random.default_rng(7).random((1, 16, 16, 16)), dtype=jnp.float32)
+    viz = visualize_cube(cube, levels=2)
+    assert viz.shape == (1, 4, 16, 16, 16)
+    # all channels max-normalized to <= 1
+    assert float(jnp.nanmax(viz)) <= 1.0 + 1e-5

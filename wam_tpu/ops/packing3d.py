@@ -1,0 +1,101 @@
+"""3D dyadic-cube packing and per-level visualization maps (pure jnp).
+
+Replaces the reference's per-sample numpy `refactor` loop
+(`lib/wam_3D.py:127-166`) with a batched on-device pack. Slab layout per
+level with span [s, e) (s = S/2^{j+1}): ddd in the main diagonal block
+[s:e]³ and the six mixed orientations in the face-adjacent slabs, keys
+ordered by axes (-3, -2, -1):
+
+    aad → [:s, :s, s:e]   ada → [:s, s:e, :s]   add → [:s, s:e, s:e]
+    daa → [s:e, :s, :s]   dad → [s:e, :s, s:e]  dda → [s:e, s:e, :s]
+
+approximation |cA| in the corner [:sJ]³. Values are absolute, unnormalized
+(matching refactor).
+
+`visualize_cube` reprojects each level to full resolution (trilinear) —
+the reference's `visualize` (`lib/wam_3D.py:662-719`) with its
+orientation-sum typo (`add` counted twice, `aad`/`ddd` dropped) fixed to the
+intended sum over all seven orientations (SURVEY.md §2.11 spirit).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from wam_tpu.wavelets.transform import DETAIL3D_KEYS
+
+__all__ = ["cube3d", "cube_size", "visualize_cube"]
+
+_SLABS = {
+    "ddd": lambda s, e: (slice(s, e), slice(s, e), slice(s, e)),
+    "aad": lambda s, e: (slice(0, s), slice(0, s), slice(s, e)),
+    "ada": lambda s, e: (slice(0, s), slice(s, e), slice(0, s)),
+    "add": lambda s, e: (slice(0, s), slice(s, e), slice(s, e)),
+    "daa": lambda s, e: (slice(s, e), slice(0, s), slice(0, s)),
+    "dad": lambda s, e: (slice(s, e), slice(0, s), slice(s, e)),
+    "dda": lambda s, e: (slice(s, e), slice(s, e), slice(0, s)),
+}
+
+
+def cube_size(coeffs) -> int:
+    return int(2 * coeffs[-1]["ddd"].shape[-1])
+
+
+def _crop(a: jax.Array, sl: tuple[slice, slice, slice]) -> jax.Array:
+    dims = tuple(s.stop - s.start for s in sl)
+    return a[..., : dims[0], : dims[1], : dims[2]]
+
+
+def cube3d(coeffs, size: int | None = None) -> jax.Array:
+    """Pack [cA_J, {aad..ddd}_J, ..., {aad..ddd}_1] (leaves (B, d, h, w))
+    into the dyadic cube (B, S, S, S) of absolute values."""
+    size = cube_size(coeffs) if size is None else size
+    batch = coeffs[0].shape[0]
+    out = jnp.zeros((batch, size, size, size), dtype=coeffs[0].dtype)
+
+    approx = jnp.abs(coeffs[0])
+    ea = min(approx.shape[-1], size // (2 ** (len(coeffs) - 1)))
+    out = out.at[:, :ea, :ea, :ea].set(approx[:, :ea, :ea, :ea])
+
+    # coeffs[1:] is coarsest→finest; level j (finest = last) spans
+    # [S/2^(i+1), S/2^i) with i counted from the finest.
+    for i, det in enumerate(coeffs[1:][::-1]):
+        e = size // (2**i)
+        s = size // (2 ** (i + 1))
+        for key in DETAIL3D_KEYS:
+            sl = _SLABS[key](s, e)
+            out = out.at[(slice(None),) + sl].set(_crop(jnp.abs(det[key]), sl))
+    return out
+
+
+def _norm(a):
+    m = jnp.max(a)
+    return a / jnp.where(m == 0, 1.0, m)
+
+
+def visualize_cube(cube: jax.Array, levels: int) -> jax.Array:
+    """Per-level full-resolution maps (B, J+2, S, S, S): channel 0 = approx,
+    1..J = detail levels coarsest-first, last = normalized sum of all."""
+    size = cube.shape[-1]
+    target = cube.shape[:1] + (size, size, size)
+    maps = []
+
+    sa = size // (2**levels)
+    approx = cube[:, :sa, :sa, :sa]
+    maps.append(_norm(jax.image.resize(approx, target, method="trilinear")))
+
+    for j in range(levels, 0, -1):  # coarsest first like the reference
+        i = j - 1  # finest-index convention of cube3d
+        e = size // (2**i)
+        s = size // (2 ** (i + 1))
+        total = None
+        for key in DETAIL3D_KEYS:
+            sl = _SLABS[key](s, e)
+            up = jax.image.resize(cube[(slice(None),) + sl], target, method="trilinear")
+            total = up if total is None else total + up
+        maps.append(_norm(total))
+
+    stacked = jnp.stack(maps, axis=1)
+    combined = _norm(stacked.sum(axis=1))
+    return jnp.concatenate([stacked, combined[:, None]], axis=1)
