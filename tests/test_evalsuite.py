@@ -1,0 +1,212 @@
+"""Evaluation-suite tests: closed-form metric cases (SURVEY.md §4d),
+pack/unpack round-trips, mask nesting invariants, baseline methods on a
+linear oracle, end-to-end evaluators with tiny models."""
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from wam_tpu.evalsuite.metrics import compute_auc, generate_masks, spearman
+from wam_tpu.evalsuite.packing import (
+    array_to_coeffs1d,
+    array_to_coeffs2d,
+    coeffs_to_array1d,
+    coeffs_to_array2d,
+    packed2d_shape,
+)
+from wam_tpu.wavelets import wavedec, wavedec2, waverec2
+
+
+def test_compute_auc_closed_form():
+    probs = jnp.array([0.5, 1.0, 0.5, 1.0])
+    # sum=3, max=1, len=4 -> 0.75
+    np.testing.assert_allclose(compute_auc(probs), 0.75)
+
+
+def test_generate_masks_nesting():
+    attr = jnp.asarray(np.random.default_rng(0).random((8, 8)), dtype=jnp.float32)
+    ins, dele = generate_masks(4, attr)
+    assert ins.shape == (5, 8, 8)
+    ins_n = np.asarray(ins)
+    dele_n = np.asarray(dele)
+    # nesting: each insertion mask contains the previous one
+    for i in range(4):
+        assert np.all(ins_n[i + 1] >= ins_n[i])
+        assert np.all(dele_n[i + 1] <= dele_n[i])
+    # boundary masks
+    assert ins_n[0].sum() == 0 and ins_n[-1].sum() == 64
+    assert dele_n[0].sum() == 64 and dele_n[-1].sum() == 0
+    # insertion masks grow by n_components, adding the most-important first
+    order = np.argsort(-np.asarray(attr), axis=None)
+    top16 = np.unravel_index(order[:16], (8, 8))
+    assert np.all(ins_n[1][top16] == 1)
+
+
+def test_spearman_perfect_and_reverse():
+    a = jnp.array([1.0, 2.0, 3.0, 4.0])
+    np.testing.assert_allclose(spearman(a, a * 10), 1.0, atol=1e-6)
+    np.testing.assert_allclose(spearman(a, -a), -1.0, atol=1e-6)
+
+
+def test_pack1d_roundtrip():
+    x = jnp.asarray(np.random.default_rng(1).standard_normal((2, 64)), dtype=jnp.float32)
+    coeffs = wavedec(x, "db2", level=3)
+    lengths = [c.shape[-1] for c in coeffs]
+    packed = coeffs_to_array1d(coeffs)
+    assert packed.shape == (2, sum(lengths))
+    back = array_to_coeffs1d(packed, lengths)
+    for a, b in zip(coeffs, back):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b))
+
+
+@pytest.mark.parametrize("wavelet,size", [("haar", 32), ("db2", 32), ("haar", 48)])
+def test_pack2d_roundtrip(wavelet, size):
+    x = jnp.asarray(np.random.default_rng(2).standard_normal((3, size, size)), dtype=jnp.float32)
+    coeffs = wavedec2(x, wavelet, level=3)
+    shapes = [tuple(coeffs[0].shape[-2:])] + [tuple(d.diagonal.shape[-2:]) for d in coeffs[1:]]
+    packed = coeffs_to_array2d(coeffs)
+    assert packed.shape[-2:] == packed2d_shape(coeffs)
+    back = array_to_coeffs2d(packed, shapes)
+    rec_orig = waverec2(coeffs, wavelet)
+    rec_back = waverec2(back, wavelet)
+    np.testing.assert_allclose(np.asarray(rec_orig), np.asarray(rec_back), atol=1e-5)
+
+
+def test_pack2d_identity_mask_reconstructs():
+    """All-ones mask through pack→mask→unpack→waverec2 = original image."""
+    x = jnp.asarray(np.random.default_rng(3).standard_normal((3, 32, 32)), dtype=jnp.float32)
+    coeffs = wavedec2(x, "haar", level=3)
+    shapes = [tuple(coeffs[0].shape[-2:])] + [tuple(d.diagonal.shape[-2:]) for d in coeffs[1:]]
+    packed = coeffs_to_array2d(coeffs)
+    masked = packed * jnp.ones(packed.shape[-2:])
+    rec = waverec2(array_to_coeffs2d(masked, shapes), "haar")
+    np.testing.assert_allclose(np.asarray(rec), np.asarray(x), atol=1e-5)
+
+
+# -- baselines on a linear oracle ------------------------------------------
+
+
+def _linear_model(W, C=3, H=16):
+    def fn(x):
+        return x.reshape(x.shape[0], -1) @ W
+
+    return fn
+
+
+def test_saliency_linear_oracle():
+    from wam_tpu.evalsuite.baselines import saliency
+
+    rng = np.random.default_rng(4)
+    W = jnp.asarray(rng.standard_normal((3 * 16 * 16, 4)), dtype=jnp.float32)
+    x = jnp.asarray(rng.standard_normal((2, 3, 16, 16)), dtype=jnp.float32)
+    y = jnp.array([1, 2])
+    sal = saliency(_linear_model(W), x, y)
+    for i in range(2):
+        expected = np.abs(np.asarray(W[:, int(y[i])]).reshape(3, 16, 16)).mean(0) / 2
+        np.testing.assert_allclose(np.asarray(sal[i]), expected, atol=1e-5)
+
+
+def test_integrated_gradients_linear_completeness():
+    """For a linear model, IG = x ⊙ grad exactly (path-independent)."""
+    from wam_tpu.evalsuite.baselines import integrated_gradients
+
+    rng = np.random.default_rng(5)
+    W = jnp.asarray(rng.standard_normal((3 * 16 * 16, 4)), dtype=jnp.float32)
+    x = jnp.asarray(rng.standard_normal((1, 3, 16, 16)), dtype=jnp.float32)
+    y = jnp.array([0])
+    ig = integrated_gradients(_linear_model(W), x, y, n_steps=8)
+    expected = (np.asarray(x[0]) * np.asarray(W[:, 0]).reshape(3, 16, 16)).mean(0)
+    np.testing.assert_allclose(np.asarray(ig[0]), expected, atol=1e-5)
+
+
+def test_smoothgrad_zero_noise_equals_saliency_sign():
+    from wam_tpu.evalsuite.baselines import smoothgrad_pixel
+
+    rng = np.random.default_rng(6)
+    W = jnp.asarray(rng.standard_normal((3 * 16 * 16, 4)), dtype=jnp.float32)
+    x = jnp.asarray(rng.standard_normal((1, 3, 16, 16)), dtype=jnp.float32)
+    y = jnp.array([3])
+    sg = smoothgrad_pixel(_linear_model(W), x, y, jax.random.PRNGKey(0), n_samples=3, stdev_spread=0.0)
+    # implementation: abs of sample-mean grads, then channel mean
+    expected = np.abs(np.asarray(W[:, 3]).reshape(3, 16, 16)).mean(0)
+    np.testing.assert_allclose(np.asarray(sg[0]), expected, atol=1e-5)
+
+
+def test_gradcam_resnet():
+    from wam_tpu.evalsuite.baselines import gradcam, gradcam_pp, layercam
+    from wam_tpu.models import resnet18
+
+    model = resnet18(num_classes=5)
+    variables = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 32, 32, 3)))
+    x = jnp.asarray(np.random.default_rng(7).standard_normal((2, 3, 32, 32)), dtype=jnp.float32)
+    y = jnp.array([0, 4])
+    for fn in (gradcam, gradcam_pp, layercam):
+        cam = fn(model, variables, x, y, layer="stage3")
+        assert cam.shape == (2, 32, 32)
+        assert np.all(np.asarray(cam) >= 0)
+        assert np.all(np.isfinite(np.asarray(cam)))
+
+
+# -- end-to-end evaluators -------------------------------------------------
+
+
+class TinyImgModel(nn.Module):
+    classes: int = 5
+
+    @nn.compact
+    def __call__(self, x):
+        x = jnp.transpose(x, (0, 2, 3, 1))
+        x = nn.Conv(8, (3, 3), strides=(2, 2))(x)
+        x = nn.relu(x).mean(axis=(1, 2))
+        return nn.Dense(self.classes)(x)
+
+
+@pytest.fixture(scope="module")
+def img_model_fn():
+    model = TinyImgModel()
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 3, 32, 32)))
+    return lambda x: model.apply(params, x)
+
+
+def test_eval2dwam_insertion_deletion(img_model_fn):
+    from wam_tpu.evalsuite.eval2d import Eval2DWAM
+    from wam_tpu.wam2d import WaveletAttribution2D
+
+    expl = WaveletAttribution2D(img_model_fn, wavelet="haar", J=2, n_samples=2)
+    ev = Eval2DWAM(img_model_fn, expl, wavelet="haar", J=2, batch_size=16)
+    x = jnp.asarray(np.random.default_rng(8).standard_normal((2, 3, 32, 32)), dtype=jnp.float32)
+    y = [1, 3]
+    ins = ev.insertion(x, y, n_iter=8)
+    dele = ev.deletion(x, y, n_iter=8)
+    assert len(ins) == 2 and len(dele) == 2
+    assert all(0 <= s <= 1 for s in ins + dele)
+    assert len(ev.insertion_curves[0]) == 9
+
+
+def test_eval2dwam_mu_fidelity(img_model_fn):
+    from wam_tpu.evalsuite.eval2d import Eval2DWAM
+    from wam_tpu.wam2d import WaveletAttribution2D
+
+    expl = WaveletAttribution2D(img_model_fn, wavelet="haar", J=2, n_samples=2)
+    ev = Eval2DWAM(img_model_fn, expl, wavelet="haar", J=2, batch_size=16)
+    x = jnp.asarray(np.random.default_rng(9).standard_normal((1, 3, 32, 32)), dtype=jnp.float32)
+    mus = ev.mu_fidelity(x, [2], grid_size=8, sample_size=6, subset_size=12)
+    assert len(mus) == 1
+    assert -1.0 <= mus[0] <= 1.0
+
+
+def test_eval_image_baselines(img_model_fn):
+    from wam_tpu.evalsuite.eval_baselines import EvalImageBaselines
+
+    model = TinyImgModel()
+    variables = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 3, 32, 32)))
+
+    # TinyImgModel consumes NCHW directly
+    ev = EvalImageBaselines(model, variables, method="saliency", batch_size=16, nchw=False)
+    x = jnp.asarray(np.random.default_rng(10).standard_normal((1, 3, 32, 32)), dtype=jnp.float32)
+    ins = ev.insertion(x, [0], n_iter=8)
+    assert len(ins) == 1
+    mus = ev.mu_fidelity(x, [0], grid_size=8, sample_size=5, subset_size=10)
+    assert len(mus) == 1

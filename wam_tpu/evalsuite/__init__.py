@@ -1,0 +1,46 @@
+from wam_tpu.evalsuite.baselines import (
+    gradcam,
+    gradcam_pp,
+    integrated_gradients,
+    layercam,
+    saliency,
+    smoothgrad_pixel,
+)
+from wam_tpu.evalsuite.eval1d import Eval1DWAM
+from wam_tpu.evalsuite.eval2d import Eval2DWAM, imagenet_denormalize, imagenet_preprocess
+from wam_tpu.evalsuite.eval_baselines import AUDIO_METHODS, IMAGE_METHODS, EvalAudioBaselines, EvalImageBaselines
+from wam_tpu.evalsuite.metrics import compute_auc, generate_masks, minmax_normalize, softmax_probs, spearman
+from wam_tpu.evalsuite.packing import (
+    array_to_coeffs1d,
+    array_to_coeffs2d,
+    coeffs_to_array1d,
+    coeffs_to_array2d,
+    packed2d_shape,
+)
+
+__all__ = [
+    "Eval1DWAM",
+    "Eval2DWAM",
+    "EvalImageBaselines",
+    "EvalAudioBaselines",
+    "IMAGE_METHODS",
+    "AUDIO_METHODS",
+    "saliency",
+    "integrated_gradients",
+    "smoothgrad_pixel",
+    "gradcam",
+    "gradcam_pp",
+    "layercam",
+    "compute_auc",
+    "generate_masks",
+    "minmax_normalize",
+    "softmax_probs",
+    "spearman",
+    "coeffs_to_array1d",
+    "array_to_coeffs1d",
+    "coeffs_to_array2d",
+    "array_to_coeffs2d",
+    "packed2d_shape",
+    "imagenet_preprocess",
+    "imagenet_denormalize",
+]

@@ -1,0 +1,124 @@
+"""Baseline attribution methods, native JAX.
+
+Replaces the reference's captum / pytorch_grad_cam / custom-torch baselines
+(`src/evaluators.py:339-351,851-902`; self-contained torch specs at
+`src/evaluation_helpers.py:72-320`):
+
+- saliency — |∂ logit_y / ∂ x| (captum Saliency role)
+- integrated_gradients — pixel-domain IG from a zero baseline
+- smoothgrad — pixel-domain twin of the WAM smoothing
+  (`src/evaluation_helpers.py:234-320`)
+- gradcam / gradcam_pp / layercam — activation-tap methods using the
+  `nn.Module.perturb` gradient taps wired into the model zoo (the JAX
+  analogue of the reference's forward/backward hooks,
+  `src/evaluation_helpers.py:52-70`)
+
+Every method maps (x, y) → a (B, H, W) pixel-domain map.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+from wam_tpu.core.engine import target_loss
+from wam_tpu.core.estimators import noise_sigma
+
+__all__ = ["saliency", "integrated_gradients", "smoothgrad_pixel", "gradcam", "gradcam_pp", "layercam"]
+
+
+def _input_grads(model_fn: Callable, x: jax.Array, y) -> jax.Array:
+    return jax.grad(lambda v: target_loss(model_fn(v), y))(x)
+
+
+def saliency(model_fn: Callable, x: jax.Array, y) -> jax.Array:
+    """|grad| averaged over channels → (B, H, W)."""
+    return jnp.abs(_input_grads(model_fn, x, y)).mean(axis=1)
+
+
+def integrated_gradients(model_fn: Callable, x: jax.Array, y, n_steps: int = 25) -> jax.Array:
+    """x ⊙ mean of grads along the zero→x path (Riemann), channel-averaged."""
+    alphas = jnp.linspace(0.0, 1.0, n_steps, dtype=x.dtype)
+    grads = jax.lax.map(lambda a: _input_grads(model_fn, x * a, y), alphas)
+    return (x * grads.mean(axis=0)).mean(axis=1)
+
+
+def smoothgrad_pixel(
+    model_fn: Callable,
+    x: jax.Array,
+    y,
+    key: jax.Array,
+    n_samples: int = 25,
+    stdev_spread: float = 0.25,
+) -> jax.Array:
+    """Mean |grad| over noisy copies with per-image σ
+    (`src/evaluation_helpers.py:234-320`)."""
+    sigma = noise_sigma(x, stdev_spread)
+    sigma = sigma.reshape(sigma.shape + (1,) * (x.ndim - 1))
+    noise = jax.random.normal(key, (n_samples,) + x.shape, dtype=x.dtype) * sigma
+    grads = jax.lax.map(lambda n: _input_grads(model_fn, x + n, y), noise)
+    return jnp.abs(grads.mean(axis=0)).mean(axis=1)
+
+
+# -- GradCAM family ---------------------------------------------------------
+
+
+def _acts_and_grads(model, variables, x, y, layer: str, nchw: bool):
+    """Forward with sow'd intermediates + gradient at the layer via the
+    zero perturbation tap."""
+    perturbs = jax.tree_util.tree_map(
+        jnp.zeros_like, variables.get("perturbations")
+    )
+    if perturbs is None or layer not in perturbs:
+        raise ValueError(
+            f"Model has no perturbation tap {layer!r}; init the model and pass "
+            "its full variables (including 'perturbations')"
+        )
+    base = {k: v for k, v in variables.items() if k != "perturbations"}
+
+    def loss_fn(pert):
+        inp = jnp.transpose(x, (0, 2, 3, 1)) if nchw else x
+        out, state = model.apply(
+            {**base, "perturbations": pert}, inp, mutable=["intermediates"]
+        )
+        out = out[0] if isinstance(out, tuple) else out
+        return target_loss(out, y), state["intermediates"]
+
+    (_, inter), grads = jax.value_and_grad(loss_fn, has_aux=True)(perturbs)
+    acts = inter[layer][0]  # (B, h, w, c) NHWC
+    g = grads[layer]
+    return acts, g
+
+
+def _resize_to(cam: jax.Array, hw: tuple[int, int]) -> jax.Array:
+    return jax.image.resize(cam, cam.shape[:-2] + hw, method="bilinear")
+
+
+def gradcam(model, variables, x, y, layer: str = "stage4", nchw: bool = True) -> jax.Array:
+    """ReLU(Σ_c w_c A_c), w = spatial mean of gradients
+    (`src/evaluation_helpers.py:157-230`)."""
+    acts, grads = _acts_and_grads(model, variables, x, y, layer, nchw)
+    w = grads.mean(axis=(1, 2), keepdims=True)
+    cam = jax.nn.relu((w * acts).sum(axis=-1))
+    return _resize_to(cam, x.shape[-2:])
+
+
+def gradcam_pp(model, variables, x, y, layer: str = "stage4", nchw: bool = True) -> jax.Array:
+    """GradCAM++ α-weights (`src/evaluation_helpers.py:72-152`):
+    α = g² / (2g² + Σ A g³), w = Σ α·relu(g)."""
+    acts, grads = _acts_and_grads(model, variables, x, y, layer, nchw)
+    g2, g3 = grads**2, grads**3
+    denom = 2.0 * g2 + (acts * g3).sum(axis=(1, 2), keepdims=True)
+    alpha = g2 / jnp.where(denom == 0, 1.0, denom)
+    w = (alpha * jax.nn.relu(grads)).sum(axis=(1, 2), keepdims=True)
+    cam = jax.nn.relu((w * acts).sum(axis=-1))
+    return _resize_to(cam, x.shape[-2:])
+
+
+def layercam(model, variables, x, y, layer: str = "stage3", nchw: bool = True) -> jax.Array:
+    """LayerCAM: ReLU(Σ_c relu(g)⊙A) — positional weighting."""
+    acts, grads = _acts_and_grads(model, variables, x, y, layer, nchw)
+    cam = jax.nn.relu((jax.nn.relu(grads) * acts).sum(axis=-1))
+    return _resize_to(cam, x.shape[-2:])

@@ -1,0 +1,252 @@
+"""Baseline-method evaluators — `EvalImageBaselines` / `EvalAudioBaselines`
+(`src/evaluators.py:805-1180` and `:310-548`): run the classic attribution
+methods (saliency / integrated gradients / smoothgrad / GradCAM / GradCAM++ /
+LayerCAM) and score them with the same insertion/deletion AUC and μ-fidelity
+machinery as WAM, with perturbations applied in the native domain of each
+modality (pixels for images, melspec cells for audio).
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from wam_tpu.evalsuite import baselines as B
+from wam_tpu.evalsuite.eval2d import _minmax01, imagenet_denormalize, imagenet_preprocess
+from wam_tpu.evalsuite.metrics import compute_auc, generate_masks, softmax_probs, spearman
+from wam_tpu.ops.filters import gaussian_filter2d, superpixel_sum, upsample_nearest
+
+__all__ = ["EvalImageBaselines", "EvalAudioBaselines", "IMAGE_METHODS", "AUDIO_METHODS"]
+
+IMAGE_METHODS = ("saliency", "integratedgrad", "smoothgrad", "gradcam", "gradcampp", "layercam")
+AUDIO_METHODS = ("saliency", "integratedgrad", "smoothgrad", "gradcam")
+
+
+class _BaseEvalBaselines:
+    """Shared machinery: method registry + cached explanations + AUC loop."""
+
+    def __init__(self, model, variables, method: str, batch_size: int, random_seed: int,
+                 n_samples: int, stdev_spread: float, cam_layer: str, nchw: bool,
+                 methods: tuple[str, ...]):
+        if method not in methods:
+            raise ValueError(f"Unknown method {method!r}; expected one of {methods}")
+        self.model = model
+        self.variables = variables
+        self.method = method
+        self.batch_size = batch_size
+        self.random_seed = random_seed
+        self.n_samples = n_samples
+        self.stdev_spread = stdev_spread
+        self.cam_layer = cam_layer
+        self.nchw = nchw
+        self.explanations = None
+        self.insertion_curves = []
+        self.deletion_curves = []
+
+        base = {k: v for k, v in variables.items() if k != "perturbations"}
+
+        def model_fn(x):
+            inp = jnp.transpose(x, (0, 2, 3, 1)) if nchw else x
+            out = self.model.apply(base, inp)
+            return out[0] if isinstance(out, tuple) else out
+
+        self.model_fn = model_fn
+
+    def compute_explanations(self, x, y) -> jax.Array:
+        """(B, H, W) maps in the perturbation domain
+        (`src/evaluators.py:904-959`)."""
+        x = jnp.asarray(x)
+        y = jnp.asarray(y)
+        m = self.method
+        if m == "saliency":
+            return B.saliency(self.model_fn, x, y)
+        if m == "integratedgrad":
+            return B.integrated_gradients(self.model_fn, x, y, n_steps=self.n_samples)
+        if m == "smoothgrad":
+            key = jax.random.PRNGKey(self.random_seed)
+            return B.smoothgrad_pixel(
+                self.model_fn, x, y, key, n_samples=self.n_samples, stdev_spread=self.stdev_spread
+            )
+        if m == "gradcam":
+            return B.gradcam(self.model, self.variables, x, y, layer=self.cam_layer, nchw=self.nchw)
+        if m == "gradcampp":
+            return B.gradcam_pp(self.model, self.variables, x, y, layer=self.cam_layer, nchw=self.nchw)
+        if m == "layercam":
+            return B.layercam(self.model, self.variables, x, y, layer=self.cam_layer, nchw=self.nchw)
+        raise AssertionError(m)
+
+    def precompute(self, x, y):
+        if self.explanations is None:
+            self.explanations = self.compute_explanations(x, y)
+        return self.explanations
+
+    def reset(self):
+        self.explanations = None
+
+    def _probs_for(self, inputs, label: int):
+        chunks = []
+        for i in range(0, inputs.shape[0], self.batch_size):
+            logits = self.model_fn(inputs[i : i + self.batch_size])
+            chunks.append(softmax_probs(logits)[:, label])
+        return jnp.concatenate(chunks)
+
+    def _perturb(self, x_s: jax.Array, masks: jax.Array) -> jax.Array:
+        raise NotImplementedError
+
+    def evaluate_auc(self, x, y, mode: str, n_iter: int = 128):
+        x = jnp.asarray(x)
+        y = np.asarray(y)
+        expl = self.precompute(x, y)
+
+        scores, curves = [], []
+        for s in range(x.shape[0]):
+            ins, dele = generate_masks(n_iter, expl[s])
+            masks = ins if mode == "insertion" else dele
+            inputs = self._perturb(x[s], masks)
+            probs = self._probs_for(inputs, int(y[s]))
+            scores.append(float(compute_auc(probs)))
+            curves.append(np.asarray(probs))
+        return scores, curves
+
+    def insertion(self, x, y, n_iter: int = 128):
+        scores, curves = self.evaluate_auc(x, y, "insertion", n_iter)
+        self.insertion_curves = curves
+        return scores
+
+    def deletion(self, x, y, n_iter: int = 128):
+        scores, curves = self.evaluate_auc(x, y, "deletion", n_iter)
+        self.deletion_curves = curves
+        return scores
+
+
+class EvalImageBaselines(_BaseEvalBaselines):
+    """Pixel-domain perturbation of images (B, 3, H, W)
+    (`src/evaluators.py:805-1180`; mask-multiply reconstruction per
+    `src/evaluation_helpers.py:325-357`)."""
+
+    def __init__(
+        self,
+        model,
+        variables,
+        method: str = "saliency",
+        batch_size: int = 128,
+        random_seed: int = 42,
+        n_samples: int = 25,
+        stdev_spread: float = 0.25,
+        cam_layer: str = "stage4",
+        denormalize_fn: Callable = imagenet_denormalize,
+        preprocess_fn: Callable = imagenet_preprocess,
+        nchw: bool = True,
+    ):
+        super().__init__(model, variables, method, batch_size, random_seed,
+                         n_samples, stdev_spread, cam_layer, nchw=nchw,
+                         methods=IMAGE_METHODS)
+        self.denormalize_fn = denormalize_fn
+        self.preprocess_fn = preprocess_fn
+
+    def _perturb(self, x_s, masks):
+        image01 = self.denormalize_fn(x_s)  # (3, H, W)
+        pert = image01[None] * masks[:, None]  # (M, 3, H, W)
+        return self.preprocess_fn(_minmax01(pert))
+
+    def mu_fidelity(self, x, y, grid_size: int = 28, sample_size: int = 128, subset_size: int = 157):
+        """Pixel-domain μ-fidelity (`src/evaluators.py:1074-1180`)."""
+        x = jnp.asarray(x)
+        y = np.asarray(y)
+        expl = self.precompute(x, y)
+        rng = np.random.default_rng(self.random_seed)
+        base_probs = np.asarray(softmax_probs(self.model_fn(x)))
+
+        results = []
+        for s in range(x.shape[0]):
+            label = int(y[s])
+            attr_map = gaussian_filter2d(expl[s], sigma=2.0)
+
+            subsets = np.stack(
+                [
+                    rng.choice(grid_size * grid_size, size=subset_size, replace=False)
+                    for _ in range(sample_size)
+                ]
+            )
+            onehot = np.zeros((sample_size, grid_size * grid_size), dtype=np.float32)
+            np.put_along_axis(onehot, subsets, 1.0, axis=1)
+            masks_grid = 1.0 - jnp.asarray(onehot.reshape(sample_size, grid_size, grid_size))
+            masks = upsample_nearest(masks_grid, tuple(x.shape[-2:]))
+            probs = self._probs_for(self._perturb(x[s], masks), label)
+            deltas = base_probs[s, label] - probs
+
+            g = attr_map.shape[-1] // grid_size * grid_size
+            cell = superpixel_sum(attr_map[:g, :g], grid_size).reshape(-1)
+            attrs = jnp.asarray(onehot) @ cell
+            results.append(float(spearman(deltas, attrs)))
+        return results
+
+
+class EvalAudioBaselines(_BaseEvalBaselines):
+    """Melspec-domain perturbation of audio inputs (B, 1, T, M)
+    (`src/evaluators.py:310-548`): explanations are computed on the melspec
+    input and masks multiply the melspec cells."""
+
+    def __init__(
+        self,
+        model,
+        variables,
+        method: str = "saliency",
+        batch_size: int = 128,
+        random_seed: int = 42,
+        n_samples: int = 25,
+        stdev_spread: float = 0.001,
+        cam_layer: str = "out3",
+    ):
+        super().__init__(model, variables, method, batch_size, random_seed,
+                         n_samples, stdev_spread, cam_layer, nchw=False,
+                         methods=AUDIO_METHODS)
+
+    def _perturb(self, x_s, masks):
+        # x_s: (1, T, M); masks: (n_iter+1, T, M) -> (n_iter+1, 1, T, M)
+        return x_s[None] * masks[:, None]
+
+    def insertion(self, x, y, n_iter: int = 64):
+        scores, curves = self.evaluate_auc(x, y, "insertion", n_iter)
+        self.insertion_curves = curves
+        return scores
+
+    def deletion(self, x, y, n_iter: int = 64):
+        scores, curves = self.evaluate_auc(x, y, "deletion", n_iter)
+        self.deletion_curves = curves
+        return scores
+
+    def evaluate_auc(self, x, y, mode: str, n_iter: int = 64, argmax: bool = False):
+        x = jnp.asarray(x)
+        y = np.asarray(y)
+        expl = self.precompute(x, y)
+        scores, curves, raw = [], [], []
+        for s in range(x.shape[0]):
+            ins, dele = generate_masks(n_iter, expl[s])
+            masks = ins if mode == "insertion" else dele
+            inputs = x[s][None] * masks[:, None]
+            if argmax:
+                logits = []
+                for i in range(0, inputs.shape[0], self.batch_size):
+                    logits.append(np.asarray(self.model_fn(inputs[i : i + self.batch_size])))
+                raw.append(np.concatenate(logits))
+                continue
+            probs = self._probs_for(inputs, int(y[s]))
+            scores.append(float(compute_auc(probs)))
+            curves.append(np.asarray(probs))
+        if argmax:
+            return raw
+        return scores, curves
+
+    def faithfulness_of_spectra(self, x, y):
+        _, curves = self.evaluate_auc(x, y, "deletion", n_iter=2)
+        arr = np.asarray(curves)
+        return (arr[:, 0] - arr[:, 1]).tolist()
+
+    def input_fidelity(self, x, y):
+        raw = self.evaluate_auc(x, y, "insertion", n_iter=2, argmax=True)
+        preds = np.asarray(raw)[:, 1:, :]
+        return np.argmax(preds, axis=2).tolist()

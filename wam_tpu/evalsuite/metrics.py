@@ -1,0 +1,77 @@
+"""Shared metric machinery: AUC, nested insertion/deletion masks, softmax
+probabilities, min-max normalization, Spearman rank correlation.
+
+Vectorized restatement of `src/evaluation_helpers.py:395-499` — the
+reference's Python mask loop becomes one broadcast comparison against the
+rank array, and the whole (n_iter+1)-mask family is a single (n+1, ...)
+tensor ready for a vmapped reconstruction.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["softmax_probs", "compute_auc", "generate_masks", "minmax_normalize", "spearman"]
+
+
+def softmax_probs(logits: jax.Array) -> jax.Array:
+    return jax.nn.softmax(logits, axis=-1)
+
+
+def compute_auc(probs: jax.Array) -> jax.Array:
+    """sum(p) / (max(p) · len(p)) over the last axis
+    (`src/evaluation_helpers.py:437-453`)."""
+    denom = jnp.max(probs, axis=-1) * probs.shape[-1]
+    return jnp.sum(probs, axis=-1) / jnp.where(denom == 0, 1.0, denom)
+
+
+def generate_masks(n_iter: int, attribution: jax.Array, signed: bool = False):
+    """Nested insertion/deletion masks from an attribution map of any shape.
+
+    Returns (insertion, deletion), each (n_iter+1, *attribution.shape):
+    insertion[k] keeps the top k·(size/n_iter) most-important cells
+    (insertion[0] empty, insertion[-1] full); deletion is the complement
+    family starting full. Importance is the raw value (2D reference,
+    `src/evaluation_helpers.py:455-499`) or |value| when ``signed``
+    (1D reference, `src/evaluators.py:87`).
+    """
+    flat = attribution.reshape(-1)
+    if signed:
+        flat = jnp.abs(flat)
+    n = flat.shape[0]
+    order = jnp.argsort(-flat)  # descending
+    rank = jnp.zeros(n, dtype=jnp.int32).at[order].set(jnp.arange(n, dtype=jnp.int32))
+    n_components = n // n_iter
+    ks = jnp.arange(1, n_iter + 1, dtype=jnp.int32) * n_components  # (n_iter,)
+    keep = rank[None, :] < ks[:, None]  # (n_iter, n)
+    ins = jnp.concatenate([jnp.zeros((1, n), bool), keep], axis=0)
+    ins = ins.at[-1].set(True)  # last mask keeps everything
+    dele = jnp.concatenate([jnp.ones((1, n), bool), ~keep], axis=0)
+    dele = dele.at[-1].set(False)
+    shape = (n_iter + 1,) + attribution.shape
+    return (
+        ins.astype(attribution.dtype).reshape(shape),
+        dele.astype(attribution.dtype).reshape(shape),
+    )
+
+
+def minmax_normalize(a: jax.Array) -> jax.Array:
+    lo, hi = jnp.min(a), jnp.max(a)
+    return (a - lo) / jnp.where(hi > lo, hi - lo, 1.0)
+
+
+def spearman(a: jax.Array, b: jax.Array) -> jax.Array:
+    """Spearman rank correlation of two 1D vectors (scipy.stats.spearmanr
+    role in μ-fidelity, `src/evaluators.py:761-763`), on-device."""
+
+    def ranks(v):
+        order = jnp.argsort(v)
+        r = jnp.zeros_like(v).at[order].set(jnp.arange(v.shape[0], dtype=v.dtype))
+        return r
+
+    ra, rb = ranks(a), ranks(b)
+    ra = ra - ra.mean()
+    rb = rb - rb.mean()
+    denom = jnp.sqrt((ra**2).sum() * (rb**2).sum())
+    return (ra * rb).sum() / jnp.where(denom == 0, 1.0, denom)

@@ -1,0 +1,109 @@
+"""Invertible coefficient↔array packing (the pywt coeffs_to_array /
+array_to_coeffs role, `src/evaluation_helpers.py:521-531`,
+`src/analyzers_helpers.py:67-77`) — pure index arithmetic on static shapes,
+jit/vmap-safe, so evaluation masks can be applied in one fused multiply.
+
+2D layout matches the attribution mosaic quadrants (approx top-left, H
+top-right, V bottom-left, D diagonal); levels may be non-dyadic (long
+filters) — the array grows to fit, like pywt's padded layout.
+
+1D layout is the flattened concatenation [cA_J | cD_J | ... | cD_1] used by
+the reference's flattened multi-scale masks (`src/evaluators.py:56-143`).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from wam_tpu.wavelets import Detail2D
+
+__all__ = [
+    "coeffs_to_array1d",
+    "array_to_coeffs1d",
+    "coeffs_to_array2d",
+    "array_to_coeffs2d",
+    "packed2d_shape",
+]
+
+
+# -- 1D ---------------------------------------------------------------------
+
+
+def coeffs_to_array1d(coeffs: Sequence[jax.Array]) -> jax.Array:
+    """[cA_J, cD_J, ..., cD_1] (each (..., n_i)) → (..., Σ n_i)."""
+    return jnp.concatenate(list(coeffs), axis=-1)
+
+
+def array_to_coeffs1d(arr: jax.Array, lengths: Sequence[int]) -> list[jax.Array]:
+    out, off = [], 0
+    for n in lengths:
+        out.append(arr[..., off : off + n])
+        off += n
+    return out
+
+
+# -- 2D ---------------------------------------------------------------------
+
+
+def _level_layout(shapes: Sequence[tuple[int, int]]):
+    """Per-level block sizes: t_j = elementwise max(prev packed, detail),
+    packed after level j = 2·t_j (pywt pads the smaller side to fit)."""
+    p = tuple(shapes[0])
+    layout = []
+    for d in shapes[1:]:
+        t = (max(p[0], d[0]), max(p[1], d[1]))
+        layout.append((t, tuple(d)))
+        p = (2 * t[0], 2 * t[1])
+    return layout, p
+
+
+def packed2d_shape(coeffs) -> tuple[int, int]:
+    shapes = [tuple(coeffs[0].shape[-2:])] + [tuple(d.diagonal.shape[-2:]) for d in coeffs[1:]]
+    return _level_layout(shapes)[1]
+
+
+def _pad_to(a: jax.Array, h: int, w: int) -> jax.Array:
+    ph, pw = h - a.shape[-2], w - a.shape[-1]
+    if ph == 0 and pw == 0:
+        return a
+    widths = [(0, 0)] * (a.ndim - 2) + [(0, ph), (0, pw)]
+    return jnp.pad(a, widths)
+
+
+def coeffs_to_array2d(coeffs) -> jax.Array:
+    """[cA, Detail2D_J..1] → one packed array, block-recursive:
+    arr_j = [[arr_{j+1}, H], [V, D]] with both sides zero-padded to the
+    level block size. Leading batch/channel dims pass through."""
+    arr = coeffs[0]
+    for det in coeffs[1:]:
+        dh, dw = det.diagonal.shape[-2:]
+        th = max(arr.shape[-2], dh)
+        tw = max(arr.shape[-1], dw)
+        P = _pad_to(arr, th, tw)
+        H = _pad_to(det.horizontal, th, tw)
+        V = _pad_to(det.vertical, th, tw)
+        D = _pad_to(det.diagonal, th, tw)
+        arr = jnp.concatenate(
+            [jnp.concatenate([P, H], axis=-1), jnp.concatenate([V, D], axis=-1)], axis=-2
+        )
+    return arr
+
+
+def array_to_coeffs2d(arr: jax.Array, shapes: Sequence[tuple[int, int]]) -> list:
+    """Inverse of `coeffs_to_array2d`. ``shapes`` = [(hA, wA), (h_J, w_J),
+    ..., (h_1, w_1)] — approx then per-level detail shapes, coarse→fine
+    (grab them from a reference decomposition)."""
+    layout, _ = _level_layout(shapes)
+    details = []
+    for (th, tw), (dh, dw) in reversed(layout):
+        H = arr[..., :dh, tw : tw + dw]
+        V = arr[..., th : th + dh, :dw]
+        D = arr[..., th : th + dh, tw : tw + dw]
+        details.append(Detail2D(horizontal=H, vertical=V, diagonal=D))
+        arr = arr[..., :th, :tw]
+    hA, wA = shapes[0]
+    approx = arr[..., :hA, :wA]
+    return [approx] + details[::-1]

@@ -47,16 +47,20 @@ class AudioCNN(nn.Module):
         x = block(x, 128, "b7")
         out0 = block(x, 128, "b8")
         self.sow("intermediates", "out0", out0)
+        out0 = self.perturb("out0", out0)
         x = nn.max_pool(out0, (2, 2), (2, 2))
         x = block(x, 256, "b9")
         out1 = block(x, 256, "b10")
         self.sow("intermediates", "out1", out1)
+        out1 = self.perturb("out1", out1)
         x = nn.max_pool(out1, (2, 2), (2, 2))
         out2 = block(x, 512, "b11")
         self.sow("intermediates", "out2", out2)
+        out2 = self.perturb("out2", out2)
         x = nn.max_pool(out2, (2, 2), (2, 2))
         out3 = nn.relu(norm(name="b12_bn")(nn.Conv(1024, (2, 2), padding="VALID", name="b12_conv")(x)))
         self.sow("intermediates", "out3", out3)
+        out3 = self.perturb("out3", out3)
         x = nn.sigmoid(nn.Conv(self.num_classes, (1, 1), name="head")(out3))
         if self.pool == "max":
             x = x.max(axis=(1, 2))

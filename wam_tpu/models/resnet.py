@@ -95,6 +95,9 @@ class ResNet(nn.Module):
                 x = self.block_cls(64 * 2**stage, strides=strides, norm=norm,
                                    name=f"layer{stage + 1}_{i}")(x)
             self.sow("intermediates", f"stage{stage + 1}", x)
+            # Gradient tap for the GradCAM-family baselines: no-op unless a
+            # 'perturbations' collection is passed (wam_tpu.evalsuite.baselines).
+            x = self.perturb(f"stage{stage + 1}", x)
         x = x.mean(axis=(1, 2))
         return nn.Dense(self.num_classes, name="fc")(x)
 

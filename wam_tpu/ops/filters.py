@@ -1,0 +1,57 @@
+"""Small image ops: separable Gaussian blur, superpixel pooling, nearest
+upsample — jnp replacements for the reference's scipy.ndimage usage
+(`gaussian_filter` at `src/evaluators.py:715`, `zoom(order=0)` at
+`src/evaluators.py:732`)."""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["gaussian_filter2d", "superpixel_sum", "upsample_nearest"]
+
+
+@functools.lru_cache(maxsize=None)
+def _gauss_kernel(sigma: float, radius: int) -> np.ndarray:
+    x = np.arange(-radius, radius + 1)
+    k = np.exp(-0.5 * (x / sigma) ** 2)
+    return (k / k.sum()).astype(np.float32)
+
+
+def gaussian_filter2d(img: jax.Array, sigma: float = 2.0) -> jax.Array:
+    """Separable Gaussian blur over the last two axes (edge-padded)."""
+    radius = max(1, int(4.0 * sigma + 0.5))
+    k = jnp.asarray(_gauss_kernel(sigma, radius), dtype=img.dtype)
+
+    def blur_axis(a, axis):
+        a = jnp.moveaxis(a, axis, -1)
+        pad = [(0, 0)] * (a.ndim - 1) + [(radius, radius)]
+        ap = jnp.pad(a, pad, mode="edge")
+        flat = ap.reshape(-1, 1, ap.shape[-1])
+        out = jax.lax.conv_general_dilated(
+            flat, k[None, None, :], (1,), [(0, 0)],
+            dimension_numbers=jax.lax.conv_dimension_numbers(
+                flat.shape, (1, 1, 2 * radius + 1), ("NCH", "OIH", "NCH")
+            ),
+        )
+        out = out.reshape(a.shape)
+        return jnp.moveaxis(out, -1, axis)
+
+    return blur_axis(blur_axis(img, -1), -2)
+
+
+def superpixel_sum(img: jax.Array, grid: int) -> jax.Array:
+    """Sum over (grid × grid) superpixels: (..., H, W) → (..., grid, grid).
+    H and W must be divisible by grid."""
+    h, w = img.shape[-2:]
+    ch, cw = h // grid, w // grid
+    r = img.reshape(img.shape[:-2] + (grid, ch, grid, cw))
+    return r.sum(axis=(-3, -1))
+
+
+def upsample_nearest(a: jax.Array, hw: tuple[int, int]) -> jax.Array:
+    """Nearest-neighbour upsample of the last two axes (zoom order=0)."""
+    return jax.image.resize(a, a.shape[:-2] + tuple(hw), method="nearest")
