@@ -1,0 +1,190 @@
+"""Analyzer + viewer + fork-analytics tests."""
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import matplotlib
+
+matplotlib.use("Agg")
+import numpy as np
+import pytest
+
+from wam_tpu.analyzers import (
+    WAMAnalyzer2D,
+    compute_levelized_masks,
+    generate_disentangled_images,
+    generate_partial_image,
+)
+from wam_tpu.analysis import (
+    get_diagonal,
+    get_gradients_attribution_on_levels,
+    get_mean_across_images,
+    get_mean_pixelwise_variance,
+    iou,
+    mean_pairwise_iou,
+    rank_images,
+    reprojection_map,
+    top_percentage_mask,
+)
+
+
+def test_levelized_masks_partition():
+    """The level masks partition the mosaic: their sum recovers it."""
+    wam = jnp.asarray(np.random.default_rng(0).random((16, 16)), dtype=jnp.float32)
+    masks = compute_levelized_masks(wam, J=2)
+    assert masks.shape == (3, 16, 16)
+    np.testing.assert_allclose(np.asarray(masks.sum(axis=0)), np.asarray(wam), atol=1e-6)
+    # disjoint supports
+    support = (np.asarray(masks) != 0).astype(int).sum(axis=0)
+    assert support.max() <= 1
+
+
+def test_generate_partial_image_full_quantile():
+    """q=0 keeps every coefficient -> reconstruction equals the image."""
+    img = jnp.asarray(np.random.default_rng(1).random((3, 16, 16)), dtype=jnp.float32)
+    wam = jnp.asarray(np.random.default_rng(2).random((16, 16)), dtype=jnp.float32)
+    rec, filtered = generate_partial_image(img, wam, q=0.0, J=2)
+    np.testing.assert_allclose(np.asarray(rec), np.asarray(img), atol=1e-5)
+    assert filtered.shape == (16, 16)
+
+
+def test_generate_disentangled_images_shapes():
+    img = jnp.asarray(np.random.default_rng(3).random((3, 16, 16)), dtype=jnp.float32)
+    wam = jnp.asarray(np.random.default_rng(4).random((16, 16)), dtype=jnp.float32)
+    partial, masks = generate_disentangled_images(wam, img, J=2, EPS=0.1)
+    assert partial.shape == (3, 3, 16, 16)
+    assert masks.shape == (3, 16, 16)
+
+
+class TinyImg(nn.Module):
+    @nn.compact
+    def __call__(self, x):
+        x = jnp.transpose(x, (0, 2, 3, 1))
+        x = nn.relu(nn.Conv(8, (3, 3), strides=(2, 2))(x)).mean(axis=(1, 2))
+        return nn.Dense(5)(x)
+
+
+@pytest.fixture(scope="module")
+def model_fn():
+    m = TinyImg()
+    p = m.init(jax.random.PRNGKey(0), jnp.zeros((1, 3, 32, 32)))
+    return lambda x: m.apply(p, x)
+
+
+def test_analyzer_necessary_components(model_fn):
+    from wam_tpu.wam2d import WaveletAttribution2D
+
+    expl = WaveletAttribution2D(model_fn, wavelet="haar", J=2, n_samples=2)
+    an = WAMAnalyzer2D(model_fn, expl, wavelet="haar", J=2)
+    x = jnp.asarray(np.random.default_rng(5).standard_normal((2, 3, 32, 32)), dtype=jnp.float32)
+    y = [0, 1]
+    outs = an.isolate_necessary_components(x, y, qs=[0.9, 0.5, 0.0], mode="insertion")
+    assert len(outs) == 2
+    for (imgs, mask, wam, (probs, idx)) in outs:
+        assert wam.shape == (32, 32)
+        if imgs[0] is not None:
+            assert probs.shape == (3, 5)
+    scales = an.isolate_scales(x, y, EPS=0.05)
+    assert len(scales) == 2
+    assert scales[0][0].shape == (3, 3, 32, 32)
+
+
+def test_fork_analytics_roundtrip():
+    wam = np.random.default_rng(6).random((32, 32))
+    d = get_diagonal(wam, 3)
+    assert set(d) == {"level_0", "level_1", "level_2", "approx"}
+    assert d["level_0"].shape == (16, 16)
+    assert d["approx"].shape == (4, 4)
+
+    mv, vmap_ = get_mean_pixelwise_variance(wam, 3)
+    assert vmap_.shape == (16, 16) and mv >= 0
+    mv_min, vmap_min = get_mean_pixelwise_variance(wam, 3, size="minimal")
+    assert vmap_min.shape == (4, 4)
+
+    ranking = rank_images([wam, wam * 2], 3)
+    assert ranking[0]["mean_pixelwise_variance"] >= ranking[1]["mean_pixelwise_variance"]
+
+    shares = get_gradients_attribution_on_levels([wam], 3)
+    np.testing.assert_allclose(shares[0].sum(), 1.0, atol=1e-6)
+    means = get_mean_across_images([shares])
+    assert means[0].shape == (4,)
+
+
+def test_iou_helpers():
+    m1 = np.zeros((8, 8), bool)
+    m1[:4] = True
+    m2 = np.zeros((8, 8), bool)
+    m2[2:6] = True
+    np.testing.assert_allclose(iou(m1, m2), 16 / 48)
+    assert mean_pairwise_iou([m1, m1]) == 1.0
+
+    a = np.arange(16.0).reshape(4, 4)
+    mask = top_percentage_mask(a, 0.25)
+    assert mask.sum() == 4
+    assert mask[-1, -1]
+
+
+def test_reprojection_map():
+    wam = np.random.default_rng(7).random((16, 16)).astype(np.float32)
+    m = reprojection_map(wam, J=2)
+    assert m.shape == (16, 16)
+
+
+def test_viewers_render():
+    import matplotlib.pyplot as plt
+
+    from wam_tpu.viz import (
+        plot_diagonal,
+        plot_wam,
+        visualize_explanations_basic,
+        visualize_gradients_at_levels,
+    )
+
+    wam = np.random.default_rng(8).random((32, 32))
+    fig, ax = plt.subplots()
+    plot_wam(ax, wam, levels=3, smooth=True, normalize_approx=True)
+    assert len(ax.lines) == 6  # 2 lines per level
+    plt.close(fig)
+
+    fig2 = plot_diagonal(get_diagonal(wam, 2))
+    plt.close(fig2)
+
+    figs = visualize_explanations_basic([wam], [np.random.random((32, 32, 3))], levels=3)
+    for f in figs:
+        plt.close(f)
+
+    f = visualize_gradients_at_levels([[0.4, 0.3, 0.2, 0.1]], "test", names=["m"])
+    plt.close(f)
+
+
+def test_viz3d_render():
+    import matplotlib.pyplot as plt
+
+    from wam_tpu.viz import (
+        scatter3d,
+        scatter3d_batch,
+        scatter3d_colors,
+        scatter3d_explanation_batch,
+        scatter3d_superpose,
+        voxel_figure,
+        voxel_superpose,
+    )
+
+    rng = np.random.default_rng(9)
+    cloud = rng.standard_normal((3, 50))
+    ax, _ = scatter3d(cloud)
+    plt.close(ax.figure)
+    fig = scatter3d_batch([cloud, cloud], titles=["a", "b"])
+    plt.close(fig)
+    fig = scatter3d_superpose(cloud, cloud + 1)
+    plt.close(fig)
+    fig = scatter3d_colors(cloud, rng.random(50))
+    plt.close(fig)
+    fig = scatter3d_explanation_batch([cloud], [rng.random(50)])
+    plt.close(fig)
+
+    vol = (rng.random((8, 8, 8)) > 0.7).astype(float)
+    fig = voxel_figure(vol)
+    plt.close(fig)
+    fig = voxel_superpose(vol, rng.random((8, 8, 8)), heat_threshold=0.8)
+    plt.close(fig)

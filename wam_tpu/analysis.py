@@ -1,0 +1,157 @@
+"""Fork analytics layer — parity with the fork's root `utils.py` and the
+`compare_iou_models.ipynb` experiment helpers: diagonal-block extraction,
+cross-level pixel-wise variance ranking, per-level attribution shares, and
+cross-wavelet IoU of top-p% attribution masks (the metrics behind
+`results/iou.csv` and `results/results_variance.csv`).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = [
+    "get_explanation_for_image",
+    "get_diagonal",
+    "get_mean_pixelwise_variance",
+    "rank_images",
+    "get_gradients_attribution_on_levels",
+    "get_multiple_grad_attr",
+    "get_mean_across_images",
+    "top_percentage_mask",
+    "iou",
+    "mean_pairwise_iou",
+    "cross_wavelet_iou",
+    "reprojection_map",
+]
+
+
+def get_explanation_for_image(image, model_fn, explainer, preprocess) -> np.ndarray:
+    """Single-image explanation at the model's argmax class
+    (`utils.py:8-19`). ``preprocess`` maps the raw image to a (1, C, H, W)
+    tensor."""
+    x = preprocess(image)
+    y = int(np.asarray(model_fn(x)).argmax())
+    return np.asarray(explainer(x, [y])).squeeze()
+
+
+def get_diagonal(grad_wam: np.ndarray, J: int) -> dict:
+    """Diagonal blocks level_0 (finest) .. level_{J-1} + approx
+    (`utils.py:23-42`)."""
+    grad_wam = np.asarray(grad_wam)
+    H, W = grad_wam.shape
+    assert H == W, "grad_wam must be square"
+    out = {}
+    for j in range(J):
+        s, e = H // (2 ** (j + 1)), H // (2**j)
+        out[f"level_{j}"] = grad_wam[s:e, s:e]
+    a = H // (2**J)
+    out["approx"] = grad_wam[:a, :a]
+    return out
+
+
+def _resize_bilinear_np(a: np.ndarray, size: int) -> np.ndarray:
+    import jax
+
+    return np.asarray(jax.image.resize(jnp.asarray(a), (size, size), method="bilinear"))
+
+
+def get_mean_pixelwise_variance(grad_wam: np.ndarray, J: int, size: str = "maximal"):
+    """Pixel-wise variance across detail levels, resized to the largest or
+    smallest level (`utils.py:45-85`). Returns (mean, variance_map)."""
+    diags = get_diagonal(grad_wam, J)
+    details = [diags[f"level_{j}"] for j in range(J)]
+    sizes = [d.shape[0] for d in details]
+    if size == "maximal":
+        target = max(sizes)
+    elif size == "minimal":
+        target = min(sizes)
+    else:
+        raise ValueError("size must be 'maximal' or 'minimal'")
+    stack = np.stack([_resize_bilinear_np(d, target) for d in details])
+    var_map = stack.var(axis=0)
+    return float(var_map.mean()), var_map
+
+
+def rank_images(explanations: Sequence[np.ndarray], J: int, size: str = "maximal"):
+    """Sort images by cross-level variance, descending (`utils.py:88-110`)."""
+    ranking = [
+        {"image_index": i, "mean_pixelwise_variance": get_mean_pixelwise_variance(e, J, size)[0]}
+        for i, e in enumerate(explanations)
+    ]
+    ranking.sort(key=lambda r: r["mean_pixelwise_variance"], reverse=True)
+    return ranking
+
+
+def get_gradients_attribution_on_levels(explanations: Sequence[np.ndarray], J: int):
+    """Normalized per-level attribution mass Σ|grad| per diagonal block
+    (`utils.py:112-134`; method note `results/README.md:1-4`)."""
+    out = []
+    for expl in explanations:
+        sums = np.array([np.abs(v).sum() for v in get_diagonal(expl, J).values()])
+        out.append(sums / sums.sum())
+    return out
+
+
+def get_multiple_grad_attr(explanations_per_model: Sequence[Sequence[np.ndarray]], J: int):
+    """Per-(model, image) level shares (`utils.py:136-141`)."""
+    return [get_gradients_attribution_on_levels(expls, J) for expls in explanations_per_model]
+
+
+def get_mean_across_images(all_grads):
+    """Mean level share per model (`utils.py:143-151`)."""
+    return [np.asarray(g).mean(axis=0) for g in all_grads]
+
+
+# -- cross-wavelet IoU (compare_iou_models.ipynb cells 2, 5-6) --------------
+
+
+def top_percentage_mask(a: np.ndarray, p: float) -> np.ndarray:
+    """Boolean mask of the top-p fraction of values."""
+    flat = np.asarray(a).ravel()
+    k = max(1, int(len(flat) * p))
+    thr = np.sort(flat)[::-1][k - 1]
+    return np.asarray(a) >= thr
+
+
+def iou(m1: np.ndarray, m2: np.ndarray) -> float:
+    union = np.logical_or(m1, m2).sum()
+    if union == 0:
+        return 0.0
+    return float(np.logical_and(m1, m2).sum() / union)
+
+
+def mean_pairwise_iou(masks: Sequence[np.ndarray]) -> float:
+    vals = [iou(masks[i], masks[j]) for i in range(len(masks)) for j in range(i + 1, len(masks))]
+    return float(np.mean(vals)) if vals else 0.0
+
+
+def reprojection_map(explanation: np.ndarray, J: int) -> np.ndarray:
+    """Mosaic → mean over per-level reprojections → single pixel map
+    (`get_grad_reprojection`, notebook cell 2)."""
+    from wam_tpu.ops.packing2d import reproject_mosaic
+
+    expl = jnp.asarray(explanation)[None]
+    maps = reproject_mosaic(expl, J)
+    return np.asarray(maps.mean(axis=1)[0])
+
+
+def cross_wavelet_iou(
+    image,
+    make_explainer: Callable[[str], Callable],
+    wavelets: Sequence[str],
+    p: float,
+    model_fn,
+    preprocess,
+    J: int,
+) -> float:
+    """Mean pairwise IoU of top-p% reprojection masks across wavelets
+    (`get_iou_between_wavelets`, notebook cell 5)."""
+    masks = []
+    for wave in wavelets:
+        explainer = make_explainer(wave)
+        expl = get_explanation_for_image(image, model_fn, explainer, preprocess)
+        masks.append(top_percentage_mask(reprojection_map(expl, J), p))
+    return mean_pairwise_iou(masks)

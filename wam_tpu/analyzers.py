@@ -1,0 +1,171 @@
+"""Scale analyzers — parity with `src/analyzers.py` (WAMAnalyzer2D) and
+`src/analyzers_helpers.py`: decompose an image into per-scale partial images
+and search for the minimal set of wavelet components that preserves the
+prediction.
+
+The reference's per-channel pywt coeffs_to_array round trips
+(`src/analyzers_helpers.py:35-81`) are the batched masked-IDWT used across
+the evaluation suite; the quantile sweep (`src/analyzers.py:94-203`)
+evaluates every quantile's reconstruction in ONE model call per image.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from wam_tpu.evalsuite.eval2d import imagenet_denormalize, imagenet_preprocess, _minmax01
+from wam_tpu.evalsuite.metrics import softmax_probs
+from wam_tpu.evalsuite.packing import array_to_coeffs2d, coeffs_to_array2d
+from wam_tpu.wavelets import wavedec2, waverec2
+
+__all__ = [
+    "compute_levelized_masks",
+    "generate_partial_image",
+    "generate_disentangled_images",
+    "WAMAnalyzer2D",
+]
+
+
+def compute_levelized_masks(grad_wam: jax.Array, J: int) -> jax.Array:
+    """(S, S) mosaic → (J+1, S, S): per-level masks carrying that level's
+    H/V/D blocks (finest first), last = approximation corner
+    (`src/analyzers_helpers.py:6-33`)."""
+    size = grad_wam.shape[-1]
+    out = jnp.zeros((J + 1, size, size), dtype=grad_wam.dtype)
+    for j in range(J):
+        s = size // (2 ** (j + 1))
+        e = size // (2**j)
+        out = out.at[j, s:e, s:e].set(grad_wam[s:e, s:e])
+        out = out.at[j, s:e, :s].set(grad_wam[s:e, :s])
+        out = out.at[j, :s, s:e].set(grad_wam[:s, s:e])
+    sa = size // (2**J)
+    out = out.at[J, :sa, :sa].set(grad_wam[:sa, :sa])
+    return out
+
+
+def _masked_rec(image: jax.Array, masks: jax.Array, J: int, wavelet: str, mode: str = "reflect"):
+    """image (3, H, W) × packed-domain masks (M, Ph, Pw) → (M, 3, H, W)."""
+    H, W = image.shape[-2:]
+    coeffs = wavedec2(image, wavelet, J, mode)
+    shapes = [tuple(coeffs[0].shape[-2:])] + [tuple(d.diagonal.shape[-2:]) for d in coeffs[1:]]
+    packed = coeffs_to_array2d(coeffs)
+    if masks.shape[-2:] != packed.shape[-2:]:
+        masks = jax.image.resize(masks, masks.shape[:-2] + packed.shape[-2:], method="nearest")
+    rec = waverec2(array_to_coeffs2d(packed[None] * masks[:, None], shapes), wavelet)
+    return rec[..., :H, :W]
+
+
+def generate_partial_image(image: jax.Array, grad_wam: jax.Array, q: float, J: int, wavelet: str = "haar"):
+    """Reconstruction keeping coefficients above the q-th quantile of the
+    mosaic (`src/analyzers_helpers.py:35-81`). Returns (image (3,H,W),
+    filtered wam)."""
+    thr = jnp.quantile(grad_wam, q)
+    mask = (grad_wam >= thr).astype(image.dtype)
+    rec = _masked_rec(image, mask[None], J, wavelet)[0]
+    return rec, mask * grad_wam
+
+
+def generate_disentangled_images(
+    grad_wam: jax.Array, image: jax.Array, J: int, EPS: float = 0.1, wavelet: str = "haar"
+):
+    """Per-level partial images (J+1, 3, H, W) + levelized masks
+    (`src/analyzers_helpers.py:83-134`): level mask cells must exceed
+    min + EPS."""
+    masks = compute_levelized_masks(grad_wam, J)
+    binary = (masks > (masks.min() + EPS)).astype(image.dtype)
+    partial = _masked_rec(image, binary, J, wavelet)
+    return partial, masks
+
+
+class WAMAnalyzer2D:
+    """`src/analyzers.py:16-203`. ``explainer``: (x, y) → (B, S, S) mosaics;
+    ``model_fn``: (B, 3, H, W) → logits."""
+
+    def __init__(
+        self,
+        model_fn: Callable[[jax.Array], jax.Array],
+        explainer: Callable,
+        wavelet: str = "haar",
+        J: int = 3,
+        mode: str = "reflect",
+        denormalize_fn: Callable = imagenet_denormalize,
+        preprocess_fn: Callable = imagenet_preprocess,
+    ):
+        self.model_fn = model_fn
+        self.explainer = explainer
+        self.wavelet = wavelet
+        self.J = J
+        self.mode = mode
+        self.denormalize_fn = denormalize_fn
+        self.preprocess_fn = preprocess_fn
+        self.grad_wams = None
+        self.insertion_quantile: list = []
+        self.deletion_quantile: list = []
+
+    def precompute(self, x, y):
+        if self.grad_wams is None:
+            self.grad_wams = jnp.asarray(self.explainer(x, y))
+        return self.grad_wams
+
+    def isolate_scales(self, x, y, EPS: float = 0.1):
+        """Per-image (partial_images (J+1, 3, H, W), masks (J+1, S, S))
+        (`src/analyzers.py:73-92`)."""
+        x = jnp.asarray(x)
+        wams = self.precompute(x, y)
+        outs = []
+        for i in range(x.shape[0]):
+            image01 = self.denormalize_fn(x[i])
+            outs.append(
+                generate_disentangled_images(wams[i], image01, self.J, EPS=EPS, wavelet=self.wavelet)
+            )
+        return outs
+
+    def isolate_necessary_components(self, x, y, qs: Sequence[float], mode: str):
+        """Quantile sweep (`src/analyzers.py:94-203`): reconstructions at
+        every q evaluated in one batch; insertion keeps the first
+        correctly-predicted one, deletion the last. Records the quantile in
+        insertion_quantile/deletion_quantile; yields (None, ...) entries
+        when no reconstruction predicts the true class."""
+        if mode not in ("insertion", "deletion"):
+            raise ValueError("mode must be 'insertion' or 'deletion'")
+        qs = list(qs)
+        if mode == "deletion" and len(qs) > 1:
+            assert qs[0] <= qs[1]
+        if mode == "insertion" and len(qs) > 1:
+            assert qs[0] >= qs[1]
+
+        x = jnp.asarray(x)
+        y = np.asarray(y)
+        wams = self.precompute(x, y)
+
+        outs = []
+        for i in range(x.shape[0]):
+            image01 = self.denormalize_fn(x[i])
+            wam = wams[i]
+            thr = jnp.quantile(wam, jnp.asarray(qs))
+            masks = (wam[None] >= thr[:, None, None]).astype(x.dtype)
+            recs = _masked_rec(image01, masks, self.J, self.wavelet, self.mode)
+            inputs = self.preprocess_fn(_minmax01(recs))
+            probs = np.asarray(softmax_probs(self.model_fn(inputs)))
+            predicted = probs.argmax(axis=1)
+            correct = np.where(predicted == y[i])[0]
+            if len(correct):
+                idx = int(correct[-1] if mode == "deletion" else correct[0])
+                (self.deletion_quantile if mode == "deletion" else self.insertion_quantile).append(
+                    qs[idx]
+                )
+                outs.append(
+                    (
+                        (np.asarray(recs[0]), np.asarray(recs[idx]), np.asarray(recs[-1])),
+                        np.asarray(masks[idx] * wam),
+                        np.asarray(wam),
+                        (probs, idx),
+                    )
+                )
+            else:
+                outs.append(((None, None, None), None, np.asarray(wam), (None, np.nan)))
+        return outs

@@ -1,0 +1,33 @@
+from wam_tpu.viz.viewers import (
+    add_lines,
+    plot_diagonal,
+    plot_wam,
+    visualize_explanations_basic,
+    visualize_gradients_at_levels,
+    wavelet_region_lines,
+)
+from wam_tpu.viz.viz3d import (
+    scatter3d,
+    scatter3d_batch,
+    scatter3d_colors,
+    scatter3d_explanation_batch,
+    scatter3d_superpose,
+    voxel_figure,
+    voxel_superpose,
+)
+
+__all__ = [
+    "plot_wam",
+    "add_lines",
+    "wavelet_region_lines",
+    "plot_diagonal",
+    "visualize_explanations_basic",
+    "visualize_gradients_at_levels",
+    "scatter3d",
+    "scatter3d_batch",
+    "scatter3d_superpose",
+    "scatter3d_colors",
+    "scatter3d_explanation_batch",
+    "voxel_figure",
+    "voxel_superpose",
+]

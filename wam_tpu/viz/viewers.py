@@ -1,0 +1,119 @@
+"""2D mosaic viewers — parity with `src/viewers.py` (plot_wam with optional
+Gaussian smoothing and approx-normalization, dyadic level separator lines)
+and the fork's `plot_utils.py` (diagonal panels, explanation side-by-sides,
+grouped level-share bars). Matplotlib, host-side."""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = [
+    "plot_wam",
+    "wavelet_region_lines",
+    "add_lines",
+    "plot_diagonal",
+    "visualize_explanations_basic",
+    "visualize_gradients_at_levels",
+]
+
+
+def wavelet_region_lines(size: int, levels: int):
+    """Endpoints of the separator lines between dyadic blocks
+    (`src/viewers.py:39-63`): at each level ℓ a horizontal and a vertical
+    line at size/2^(ℓ+1), spanning size/2^ℓ."""
+    lines = []
+    for lev in range(levels):
+        span = size // (2**lev)
+        mid = size // (2 ** (lev + 1))
+        lines.append((((0, mid), (span, mid)), ((mid, span), (mid, 0))))
+    return lines
+
+
+def add_lines(size: int, levels: int, ax) -> None:
+    """White dyadic separators on an imshow'd mosaic (`src/viewers.py:65-79`)."""
+    ax.set_xlim(0, size)
+    ax.set_ylim(size, 0)
+    for (h0, h1), (v0, v1) in wavelet_region_lines(size, levels):
+        ax.plot([h0[0], h1[0]], [h0[1], h1[1]], c="w")
+        ax.plot([v0[0], v1[0]], [v0[1], v1[1]], c="w")
+
+
+def plot_wam(ax, wam, levels: int, smooth: bool = False, sigma: float = 1.0,
+             cmap: str = "viridis", normalize_approx: bool = False):
+    """Render one attribution mosaic with level separators
+    (`src/viewers.py:12-36`)."""
+    wam = np.asarray(wam)
+    size = wam.shape[0]
+    display = wam
+    if normalize_approx:
+        b = size // (2**levels)
+        display = wam / (wam.max() if wam.max() else 1.0)
+        display = display.copy()
+        display[:b, :b] = 0.0
+    if smooth:
+        import jax.numpy as jnp
+
+        from wam_tpu.ops.filters import gaussian_filter2d
+
+        display = np.asarray(gaussian_filter2d(jnp.asarray(display), sigma=sigma))
+    ax.imshow(display, cmap=cmap)
+    add_lines(size, levels, ax)
+
+
+def plot_diagonal(diagonals: dict, cmap: str = "viridis", figsize=(14, 4)):
+    """Panel of diagonal blocks + approx (`plot_utils.py:7-24`)."""
+    import matplotlib.pyplot as plt
+
+    keys = list(diagonals)
+    fig, axes = plt.subplots(1, len(keys), figsize=figsize)
+    if len(keys) == 1:
+        axes = [axes]
+    for ax, key in zip(axes, keys):
+        im = ax.imshow(diagonals[key], cmap=cmap)
+        ax.set_title(str(key))
+        ax.axis("off")
+        fig.colorbar(im, ax=ax, fraction=0.046, pad=0.04)
+    fig.tight_layout()
+    return fig
+
+
+def visualize_explanations_basic(explanations, images, levels: int, cmap="viridis",
+                                 smooth: bool = True, which=0):
+    """Original image + WAM side by side (`plot_utils.py:26-76`)."""
+    import matplotlib.pyplot as plt
+
+    indices = range(len(explanations)) if which == "all" else [which]
+    figs = []
+    for i in indices:
+        fig, (ax1, ax2) = plt.subplots(1, 2, figsize=(12, 5))
+        ax1.imshow(np.asarray(images[i]))
+        ax1.set_title("Original Image")
+        ax1.axis("off")
+        plot_wam(ax2, explanations[i], levels=levels, cmap=cmap, smooth=smooth)
+        ax2.set_title("WAM")
+        ax2.axis("off")
+        fig.tight_layout()
+        figs.append(fig)
+    return figs
+
+
+def visualize_gradients_at_levels(gradients_at_levels, title: str, names=None):
+    """Grouped bar plot of per-level attribution shares
+    (`plot_utils.py:79-114`)."""
+    import matplotlib.pyplot as plt
+
+    arr = np.asarray(gradients_at_levels)
+    n_samples, n_levels = arr.shape
+    names = names or [f"Sample {i + 1}" for i in range(n_samples)]
+    levels = np.arange(n_levels)
+    width = 0.8 / n_samples
+    fig = plt.figure(figsize=(10, 6))
+    for i in range(n_samples):
+        plt.bar(levels + i * width, arr[i], width=width, label=names[i])
+    plt.xlabel("Scale level")
+    plt.ylabel("Attribution")
+    plt.title(title)
+    plt.xticks(levels + 0.4, levels + 1)
+    plt.legend()
+    plt.tight_layout()
+    return fig

@@ -1,0 +1,147 @@
+"""3D visualization — point-cloud scatters and voxel renders with heatmap
+superposition, the role of the reference's plotly module
+(`src/utils_viz3D.py:95-655`). Backend: matplotlib 3D (always available
+here); if plotly is installed, `scatter3d_plotly`/`voxels_plotly` return
+plotly figures with the same data.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = [
+    "scatter3d",
+    "scatter3d_batch",
+    "scatter3d_superpose",
+    "scatter3d_colors",
+    "scatter3d_explanation_batch",
+    "voxel_figure",
+    "voxel_superpose",
+    "HAS_PLOTLY",
+]
+
+try:  # optional backend
+    import plotly.graph_objects as _go  # noqa: F401
+
+    HAS_PLOTLY = True
+except Exception:  # pragma: no cover
+    HAS_PLOTLY = False
+
+
+def _as_points(cloud) -> np.ndarray:
+    """Accept (3, N) or (N, 3); return (N, 3)."""
+    a = np.asarray(cloud)
+    if a.ndim != 2:
+        raise ValueError(f"Expected 2D point array, got {a.shape}")
+    return a.T if a.shape[0] == 3 and a.shape[1] != 3 else a
+
+
+def scatter3d(cloud, ax=None, color=None, size: float = 4.0, title: str | None = None):
+    """One point cloud (`src/utils_viz3D.py:95-126`)."""
+    import matplotlib.pyplot as plt
+
+    pts = _as_points(cloud)
+    if ax is None:
+        fig = plt.figure()
+        ax = fig.add_subplot(projection="3d")
+    sc = ax.scatter(pts[:, 0], pts[:, 1], pts[:, 2], c=color, s=size)
+    if title:
+        ax.set_title(title)
+    return ax, sc
+
+
+def scatter3d_batch(clouds, titles=None, ncols: int = 4, size: float = 4.0):
+    """Grid of point clouds (`src/utils_viz3D.py:130-176`)."""
+    import matplotlib.pyplot as plt
+
+    n = len(clouds)
+    ncols = min(ncols, n)
+    nrows = (n + ncols - 1) // ncols
+    fig = plt.figure(figsize=(4 * ncols, 4 * nrows))
+    for i, cloud in enumerate(clouds):
+        ax = fig.add_subplot(nrows, ncols, i + 1, projection="3d")
+        scatter3d(cloud, ax=ax, size=size, title=titles[i] if titles else None)
+    fig.tight_layout()
+    return fig
+
+
+def scatter3d_superpose(cloud_a, cloud_b, labels=("source", "filtered"), size: float = 4.0):
+    """Two clouds overlaid (`src/utils_viz3D.py:179-222`)."""
+    import matplotlib.pyplot as plt
+
+    fig = plt.figure()
+    ax = fig.add_subplot(projection="3d")
+    for cloud, lbl, c in zip((cloud_a, cloud_b), labels, ("tab:blue", "tab:red")):
+        pts = _as_points(cloud)
+        ax.scatter(pts[:, 0], pts[:, 1], pts[:, 2], s=size, label=lbl, color=c, alpha=0.6)
+    ax.legend()
+    return fig
+
+
+def scatter3d_colors(cloud, values, cmap: str = "viridis", size: float = 6.0):
+    """Cloud colored by per-point scalar (`src/utils_viz3D.py:224-258`)."""
+    import matplotlib.pyplot as plt
+
+    fig = plt.figure()
+    ax = fig.add_subplot(projection="3d")
+    pts = _as_points(cloud)
+    v = np.asarray(values)
+    sc = ax.scatter(pts[:, 0], pts[:, 1], pts[:, 2], c=v, cmap=cmap, s=size)
+    fig.colorbar(sc, ax=ax, fraction=0.03)
+    return fig
+
+
+def scatter3d_explanation_batch(clouds, importances, ncols: int = 4, cmap: str = "viridis"):
+    """Batch of clouds colored by importance (`src/utils_viz3D.py:261-314`)."""
+    import matplotlib.pyplot as plt
+
+    n = len(clouds)
+    ncols = min(ncols, n)
+    nrows = (n + ncols - 1) // ncols
+    fig = plt.figure(figsize=(4 * ncols, 4 * nrows))
+    for i, (cloud, imp) in enumerate(zip(clouds, importances)):
+        ax = fig.add_subplot(nrows, ncols, i + 1, projection="3d")
+        pts = _as_points(cloud)
+        ax.scatter(pts[:, 0], pts[:, 1], pts[:, 2], c=np.asarray(imp), cmap=cmap, s=6)
+    fig.tight_layout()
+    return fig
+
+
+def voxel_figure(volume, threshold: float = 0.5, facecolor: str = "#7aa6c2"):
+    """Solid voxel render of a (D, H, W) occupancy grid
+    (`src/utils_viz3D.py:539-582`)."""
+    import matplotlib.pyplot as plt
+
+    vol = np.asarray(volume)
+    filled = vol > threshold
+    fig = plt.figure()
+    ax = fig.add_subplot(projection="3d")
+    ax.voxels(filled, facecolors=facecolor, edgecolor="k", linewidth=0.2)
+    return fig
+
+
+def voxel_superpose(volume, heatmap, vox_threshold: float = 0.5, heat_threshold: float = 0.5,
+                    cmap: str = "inferno"):
+    """Voxel shape + thresholded attribution heatmap overlay
+    (`src/utils_viz3D.py:585-655`)."""
+    import matplotlib
+    import matplotlib.pyplot as plt
+
+    vol = np.asarray(volume)
+    heat = np.asarray(heatmap)
+    hmin, hmax = heat.min(), heat.max()
+    heat_n = (heat - hmin) / (hmax - hmin if hmax > hmin else 1.0)
+
+    shape_mask = vol > vox_threshold
+    heat_mask = heat_n > heat_threshold
+
+    colors = np.zeros(shape_mask.shape + (4,))
+    colors[shape_mask] = (0.6, 0.6, 0.6, 0.25)
+    mapped = matplotlib.colormaps[cmap](heat_n)
+    mapped[..., 3] = 0.9
+    colors[heat_mask] = mapped[heat_mask]
+
+    fig = plt.figure()
+    ax = fig.add_subplot(projection="3d")
+    ax.voxels(shape_mask | heat_mask, facecolors=colors)
+    return fig
