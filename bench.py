@@ -25,6 +25,12 @@ QUICK = "--quick" in sys.argv
 
 def tpu_throughput() -> float:
     import jax
+
+    try:  # fall back to CPU if the TPU tunnel is unavailable
+        jax.devices()
+    except RuntimeError as e:
+        print(f"# tpu backend unavailable ({e}); benching on CPU", file=sys.stderr)
+        jax.config.update("jax_platforms", "cpu")
     import jax.numpy as jnp
 
     from wam_tpu.core.engine import WamEngine

@@ -1,0 +1,47 @@
+from wam_tpu.data.audio import (
+    ESC50,
+    add_0db_noise,
+    load_sound,
+    logmel_np,
+    make_weights_for_balanced_classes,
+    stft_np,
+)
+from wam_tpu.data.checkpoints import (
+    build_vision_model,
+    load_3d_model,
+    load_3dvoxel_model,
+    load_audio_model,
+    load_variables,
+    save_variables,
+)
+from wam_tpu.data.image import (
+    get_alpha_cmap,
+    load_images,
+    load_imagenet_validation,
+    preprocess_image,
+    show,
+)
+from wam_tpu.data.mnist3d import batches, load_3d_mnist, load_3dvoxel_mnist
+
+__all__ = [
+    "ESC50",
+    "add_0db_noise",
+    "load_sound",
+    "logmel_np",
+    "stft_np",
+    "make_weights_for_balanced_classes",
+    "preprocess_image",
+    "load_images",
+    "load_imagenet_validation",
+    "show",
+    "get_alpha_cmap",
+    "load_3d_mnist",
+    "load_3dvoxel_mnist",
+    "batches",
+    "build_vision_model",
+    "load_3d_model",
+    "load_3dvoxel_model",
+    "load_audio_model",
+    "save_variables",
+    "load_variables",
+]

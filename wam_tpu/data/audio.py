@@ -1,0 +1,178 @@
+"""Audio data layer — ESC-50 dataset and sound loaders.
+
+Parity with `src/dataloader.py` (ESC50 Dataset: fold-based split from
+meta/esc50.csv, 0dB-SNR noise injection, log-mel + STFT features,
+overlap_two mixing, balanced-class weights) and `src/helpers.py:35-70,
+225-274` (add_0db_noise, load_sound sampler). WAV decoding goes through the
+native C++ reader (`wam_tpu.native`), feature extraction through this
+package's own STFT/mel (numpy, host-side — no librosa/torchaudio).
+"""
+
+from __future__ import annotations
+
+import csv
+import os
+from typing import Sequence
+
+import numpy as np
+
+from wam_tpu.native import read_wav
+from wam_tpu.ops.melspec import mel_filterbank
+
+__all__ = [
+    "add_0db_noise",
+    "stft_np",
+    "logmel_np",
+    "ESC50",
+    "load_sound",
+    "make_weights_for_balanced_classes",
+]
+
+
+def add_0db_noise(audio: np.ndarray) -> np.ndarray:
+    """Gaussian noise at 0 dB SNR (noise RMS = signal RMS), preserving int16
+    range/dtype when given int16 (`src/helpers.py:35-70`)."""
+    was_int = audio.dtype == np.int16
+    a = audio.astype(np.float32)
+    rms_signal = np.sqrt(np.mean(a**2))
+    noise = np.random.normal(0, 1, a.shape)
+    noise *= rms_signal / np.sqrt(np.mean(noise**2))
+    noisy = a + noise
+    if was_int:
+        return np.clip(noisy, -32768, 32767).astype(np.int16)
+    return noisy.astype(np.float32)
+
+
+def stft_np(x: np.ndarray, n_fft: int = 1024, hop: int = 512) -> np.ndarray:
+    """Centered Hann STFT, (F, T) complex — the librosa.stft layout the
+    reference's feature code expects (`src/dataloader.py:93`)."""
+    x = np.asarray(x, dtype=np.float32)
+    pad = n_fft // 2
+    xp = np.pad(x, (pad, pad), mode="reflect")
+    n_frames = 1 + (len(xp) - n_fft) // hop
+    idx = np.arange(n_frames)[:, None] * hop + np.arange(n_fft)[None, :]
+    window = np.hanning(n_fft + 1)[:-1]
+    spec = np.fft.rfft(xp[idx] * window, axis=-1)
+    return spec.T  # (F, T)
+
+
+def _power_to_db(p: np.ndarray, amin: float = 1e-10) -> np.ndarray:
+    return 10.0 * np.log10(np.maximum(p, amin))
+
+
+def logmel_np(x: np.ndarray, sr: int = 44100, n_fft: int = 1024, hop: int = 512, n_mels: int = 128):
+    """(log-mel (T, M), |STFT| (F, T), log1p|STFT|, phase) feature tuple."""
+    Xs = stft_np(x, n_fft, hop)
+    mag = np.abs(Xs)
+    fb = mel_filterbank(n_fft // 2 + 1, n_mels, sr)  # (F, M)
+    mel = (mag.T @ fb).T  # (M, T)
+    return _power_to_db(mel).T, mag, np.log1p(mag), Xs / (1e-9 + mag)
+
+
+class ESC50:
+    """ESC-50 dataset with fold-based train/test split
+    (`src/dataloader.py:18-118`). Items: (logmel (1, T, M) float32, label,
+    |STFT|, log-STFT, phase, path, idx). Duck-compatible with
+    torch.utils.data.Dataset.
+    """
+
+    def __init__(self, mode: str = "train", num_FOLD: int = 1, root_dir: str = "ESC50",
+                 select_class: Sequence[int] = (), add_noise: bool = False,
+                 nfft: int = 1024, hop: int = 512, sr: int = 44100, nmel: int = 128):
+        self.mode = mode
+        self.num_FOLD = num_FOLD
+        self.root_dir = root_dir
+        self.subset = list(select_class) if select_class else list(range(50))
+        self.nfft, self.hop, self.sr, self.nmel = nfft, hop, sr, nmel
+        self.noise = add_noise
+
+        rows = []
+        with open(os.path.join(root_dir, "meta", "esc50.csv")) as f:
+            reader = csv.DictReader(f)
+            for row in reader:
+                fold, target = int(row["fold"]), int(row["target"])
+                in_fold = fold == num_FOLD
+                if target not in self.subset:
+                    continue
+                if (mode == "test") == in_fold:
+                    rows.append(row)
+        self.rows = rows
+        self.noise_strength = np.zeros(len(rows))
+        self.signal_strength = np.zeros(len(rows))
+
+    def __len__(self) -> int:
+        return len(self.rows)
+
+    def _load(self, row) -> np.ndarray:
+        path = os.path.join(self.root_dir, "audio", row["filename"])
+        _, audio = read_wav(path)
+        if audio.ndim > 1:
+            audio = audio[:, 0]
+        audio = audio.astype(np.float32)
+        return audio / audio.max()
+
+    def __getitem__(self, idx: int):
+        row = self.rows[idx]
+        y = int(row["target"])
+        if len(self.subset) < 50:
+            y = self.subset.index(y)
+        audio = self._load(row)
+        if self.noise:
+            energy = (audio**2).mean()
+            noise = np.random.normal(0, 0.05, audio.shape[0])
+            noise *= np.sqrt(energy / (noise**2).mean())
+            audio = audio + noise
+        logmel, mag, logmag, phase = logmel_np(audio, self.sr, self.nfft, self.hop, self.nmel)
+        path = os.path.join(self.root_dir, "audio", row["filename"])
+        return logmel[None].astype(np.float32), y, mag, logmag, phase, path, idx
+
+    def overlap_two(self, idx1: int, idx2: int, lambda2: float = 0.2):
+        """Mix two clips: clip1 + λ·clip2, label of clip1
+        (`src/dataloader.py:99-118`)."""
+        a1 = self._load(self.rows[idx1])
+        a2 = self._load(self.rows[idx2])
+        n = min(len(a1), len(a2))
+        mixed = a1[:n] + lambda2 * a2[:n]
+        y = int(self.rows[idx1]["target"])
+        if len(self.subset) < 50:
+            y = self.subset.index(y)
+        logmel, mag, logmag, phase = logmel_np(mixed, self.sr, self.nfft, self.hop, self.nmel)
+        paths = self.rows[idx1]["filename"] + self.rows[idx2]["filename"]
+        return logmel[None].astype(np.float32), y, mag, logmag, phase, paths
+
+
+def load_sound(root_dir: str, n=42, noise: bool = False) -> dict:
+    """Sample n clips (or the named files) from ESC-50; returns
+    {'x': waveforms, 'y': labels} (`src/helpers.py:225-274`)."""
+    meta = {}
+    order = []
+    with open(os.path.join(root_dir, "meta", "esc50.csv")) as f:
+        for row in csv.DictReader(f):
+            meta[row["filename"]] = int(row["target"])
+            order.append(row["filename"])
+
+    if isinstance(n, list):
+        names = n
+    else:
+        rng = np.random.RandomState(42)
+        names = [order[i] for i in rng.randint(0, len(order), n)]
+
+    waveforms, labels = [], []
+    for name in names:
+        _, audio = read_wav(os.path.join(root_dir, "audio", name))
+        if audio.ndim > 1:
+            audio = audio[:, 0]
+        labels.append(meta[name])
+        waveforms.append(add_0db_noise(audio) if noise else audio)
+    return {"x": waveforms, "y": labels}
+
+
+def make_weights_for_balanced_classes(dataset, nclasses: int = 10) -> list[float]:
+    """Inverse-frequency sample weights (`src/dataloader.py:123-134`)."""
+    count = [0] * nclasses
+    labels = [int(dataset[i][1]) for i in range(len(dataset))]
+    for y in labels:
+        count[y] += 1
+    total = float(sum(count))
+    per_class = [total / c if c else 0.0 for c in count]
+    return [per_class[y] for y in labels]

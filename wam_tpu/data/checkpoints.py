@@ -1,0 +1,124 @@
+"""Model registry + checkpoint loading — the `load_vision_model` /
+`load_3d_model` / `load_audio_model` role (`src/helpers.py:84-114,276-325,
+468-479`), TPU-native: builds Flax modules and optionally ingests PyTorch
+state-dict checkpoints (via wam_tpu.models.ingest) or native orbax
+checkpoints.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["build_vision_model", "load_3d_model", "load_3dvoxel_model", "load_audio_model",
+           "save_variables", "load_variables"]
+
+
+def _init(model, example):
+    return model.init(jax.random.PRNGKey(0), example)
+
+
+def build_vision_model(model_key: str = "resnet18", num_classes: int = 1000,
+                       checkpoint_path: str | None = None, image_size: int = 224):
+    """Build a vision model by key; optionally load a torchvision-style
+    checkpoint. Returns (model, variables, model_fn) with model_fn taking
+    NCHW input like the reference tensors."""
+    from wam_tpu.models import bind_inference, resnet18, resnet34, resnet50, resnet101
+    from wam_tpu.models.ingest import torch_resnet_to_flax
+
+    registry = {
+        "resnet18": resnet18,
+        "resnet34": resnet34,
+        "resnet50": resnet50,
+        "resnet101": resnet101,
+    }
+    try:
+        from wam_tpu.models.vit import vit_b16
+
+        registry["vit_b16"] = vit_b16
+    except ImportError:
+        pass
+    try:
+        from wam_tpu.models.convnext import convnext_tiny
+
+        registry["convnext_tiny"] = convnext_tiny
+    except ImportError:
+        pass
+
+    if model_key not in registry:
+        raise ValueError(f"Unknown model key {model_key!r}; options: {sorted(registry)}")
+    model = registry[model_key](num_classes=num_classes)
+    example = jnp.zeros((1, image_size, image_size, 3))
+    variables = _init(model, example)
+    if checkpoint_path is not None:
+        if checkpoint_path.endswith((".pth", ".pt", ".bin")):
+            import torch
+
+            state = torch.load(checkpoint_path, map_location="cpu", weights_only=True)
+            if model_key.startswith("resnet"):
+                loaded = torch_resnet_to_flax(state)
+                loaded = jax.tree_util.tree_map(jnp.asarray, loaded)
+                variables = {**variables, **loaded}
+            else:
+                raise NotImplementedError(
+                    f"torch checkpoint ingestion for {model_key} not wired yet"
+                )
+        else:
+            variables = load_variables(checkpoint_path, variables)
+    return model, variables, bind_inference(model, variables, nchw=True)
+
+
+def load_3d_model(checkpoint_path: str | None, num_classes: int, feature_transform: bool,
+                  num_points: int = 1024):
+    """PointNet classifier (`src/helpers.py:84-98`)."""
+    from wam_tpu.models.pointnet import PointNetCls
+
+    model = PointNetCls(k=num_classes, feature_transform=feature_transform)
+    variables = _init(model, jnp.zeros((1, 3, num_points)))
+    if checkpoint_path:
+        variables = load_variables(checkpoint_path, variables)
+    return model, variables, lambda x: model.apply(variables, x)[0]
+
+
+def load_3dvoxel_model(checkpoint_path: str | None, num_classes: int = 10):
+    """Voxel CNN (`src/helpers.py:100-114`)."""
+    from wam_tpu.models.voxel import VoxelModel
+
+    model = VoxelModel(num_classes=num_classes)
+    variables = _init(model, jnp.zeros((1, 1, 16, 16, 16)))
+    if checkpoint_path:
+        variables = load_variables(checkpoint_path, variables)
+    return model, variables, lambda x: model.apply(variables, x)
+
+
+def load_audio_model(checkpoint_path: str | None = None, num_classes: int = 50,
+                     time_frames: int = 128, n_mels: int = 128):
+    """Audio CNN + bound inference fn (the FtEx wrapper role,
+    `src/helpers.py:276-325`)."""
+    from wam_tpu.models.audio import AudioCNN, bind_audio_inference
+
+    model = AudioCNN(num_classes=num_classes)
+    variables = _init(model, jnp.zeros((1, 1, time_frames, n_mels)))
+    if checkpoint_path:
+        variables = load_variables(checkpoint_path, variables)
+    return model, variables, bind_audio_inference(model, variables)
+
+
+# -- native (orbax) checkpoints --------------------------------------------
+
+
+def save_variables(path: str, variables: Any) -> None:
+    import orbax.checkpoint as ocp
+
+    with ocp.StandardCheckpointer() as ckpt:
+        ckpt.save(os.path.abspath(path), jax.tree_util.tree_map(jnp.asarray, variables))
+
+
+def load_variables(path: str, like: Any) -> Any:
+    import orbax.checkpoint as ocp
+
+    with ocp.StandardCheckpointer() as ckpt:
+        return ckpt.restore(os.path.abspath(path), like)

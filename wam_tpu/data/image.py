@@ -1,0 +1,136 @@
+"""Image data layer — preprocessing and ImageNet/assets loaders.
+
+Parity with `src/helpers.py:328-465` (load_images, load_imagenet_validation,
+show, get_alpha_cmap) without torchvision: PIL + numpy preprocessing that
+reproduces Resize/CenterCrop/ToTensor/Normalize.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Sequence
+
+import numpy as np
+
+IMAGENET_MEAN = np.array([0.485, 0.456, 0.406], dtype=np.float32)
+IMAGENET_STD = np.array([0.229, 0.224, 0.225], dtype=np.float32)
+
+__all__ = [
+    "preprocess_image",
+    "load_images",
+    "load_imagenet_validation",
+    "show",
+    "get_alpha_cmap",
+]
+
+
+def preprocess_image(img, resize: int = 256, crop: int | None = 224, normalize: bool = True) -> np.ndarray:
+    """PIL image → (3, H, W) float32. resize shorter side, optional center
+    crop, optional ImageNet standardization (the reference's default
+    transforms, `src/helpers.py:340-346,390-401`). ``crop=None`` resizes to
+    (resize, resize) exactly."""
+    from PIL import Image
+
+    if not hasattr(img, "convert"):
+        img = Image.fromarray(np.asarray(img))
+    img = img.convert("RGB")
+    if crop is None:
+        img = img.resize((resize, resize), Image.BILINEAR)
+    else:
+        w, h = img.size
+        scale = resize / min(w, h)
+        img = img.resize((round(w * scale), round(h * scale)), Image.BILINEAR)
+        w, h = img.size
+        left, top = (w - crop) // 2, (h - crop) // 2
+        img = img.crop((left, top, left + crop, top + crop))
+    arr = np.asarray(img, dtype=np.float32) / 255.0  # (H, W, 3)
+    if normalize:
+        arr = (arr - IMAGENET_MEAN) / IMAGENET_STD
+    return arr.transpose(2, 0, 1)
+
+
+def load_images(source_dir: str | None = None, label_file: str = "labels.json",
+                labels=None, images_dir: str | None = None):
+    """Assets-style loader (`src/helpers.py:370-419`): images + labels.json
+    mapping name → class. Returns ((N, 3, 224, 224) float32, labels list)."""
+    from PIL import Image
+
+    if labels is None:
+        images_dir = os.path.join(source_dir, "assets")
+        mapping = json.load(open(os.path.join(images_dir, label_file)))
+        names, labels_list = list(mapping.keys()), list(mapping.values())
+        crop = None  # reference uses Resize((224, 224)) here
+    else:
+        names, labels_list = sorted(os.listdir(images_dir)), labels
+        crop = 224
+
+    stack = [
+        preprocess_image(Image.open(os.path.join(images_dir, n)), resize=224 if crop is None else 256, crop=crop)
+        for n in names
+    ]
+    return np.stack(stack), labels_list
+
+
+def load_imagenet_validation(source_dir: str, ground_truth: str = "val.txt",
+                             count: int = 1000, seed: int = 42):
+    """Folder of .JPEG validation images + a `name label` text file
+    (`src/helpers.py:328-368`)."""
+    from PIL import Image
+
+    with open(os.path.join(source_dir, ground_truth)) as f:
+        gt = {line.split()[0]: int(line.split()[1]) for line in f if line.strip()}
+    examples = [e for e in sorted(os.listdir(source_dir)) if e.endswith(".JPEG")]
+    assert len(examples) == count, f"expected {count} images, found {len(examples)}"
+    images = [preprocess_image(Image.open(os.path.join(source_dir, e))) for e in examples]
+    return np.stack(images), [gt[e] for e in examples]
+
+
+def show(img, p=False, inverse_c: bool = False, plot: bool = True, **kwargs):
+    """Tensor → displayable image (`src/helpers.py:421-448`): move channels
+    last, min-max normalize out-of-range data, optional percentile clip."""
+    img = np.array(img, dtype=np.float32)
+    if img.ndim == 3 and img.shape[0] == 1:
+        img = img[0]
+    elif img.ndim == 3 and img.shape[0] == 3:
+        img = np.moveaxis(img, 0, 2)
+    if img.ndim == 3 and img.shape[-1] == 1:
+        img = img[:, :, 0]
+    if img.max() > 1 or img.min() < 0:
+        img = img - img.min()
+        img = img / (img.max() if img.max() else 1.0)
+    if p is not False:
+        img = np.clip(img, np.percentile(img, p), np.percentile(img, 100 - p))
+    if img.ndim == 3 and img.shape[-1] == 3 and inverse_c:
+        img = img[..., ::-1]
+    if plot:
+        import matplotlib.pyplot as plt
+
+        plt.imshow(img, **kwargs)
+        plt.axis("off")
+        plt.grid(None)
+        return None
+    return img
+
+
+def get_alpha_cmap(cmap, min_alpha: float = 0.0):
+    """Colormap with an alpha ramp for heatmap overlays
+    (`src/helpers.py:450-465`)."""
+    import colorsys
+
+    import matplotlib
+    import matplotlib.pyplot as plt
+    from matplotlib.colors import ListedColormap
+
+    if isinstance(cmap, str):
+        base = plt.get_cmap(cmap)
+        colors = base(np.arange(base.N))
+    else:
+        c = np.array(cmap, dtype=np.float64) / 255.0
+        hls = np.array(colorsys.rgb_to_hls(*c))
+        hls[-1] = 1.0
+        cmax = np.clip(np.array(colorsys.hls_to_rgb(*hls)), 0, 1)
+        lin = matplotlib.colors.LinearSegmentedColormap.from_list("", [c, cmax])
+        colors = lin(np.arange(256))
+    colors[:, -1] = np.linspace(min_alpha, 0.85, len(colors))
+    return ListedColormap(colors)

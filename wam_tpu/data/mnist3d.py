@@ -1,0 +1,62 @@
+"""3D-MNIST loaders — parity with `src/helpers.py:116-222`
+(load_3d_mnist point clouds from train/test_point_clouds.h5,
+load_3dVoxel_mnist 16³ voxel grids from full_dataset_vectors.h5),
+returning numpy arrays and a simple batch iterator instead of torch
+DataLoaders.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+__all__ = ["load_3d_mnist", "load_3dvoxel_mnist", "batches"]
+
+
+def batches(x: np.ndarray, y: np.ndarray, batch_size: int, shuffle: bool = False, seed: int = 42):
+    """Yield (x_batch, y_batch) minibatches."""
+    idx = np.arange(len(x))
+    if shuffle:
+        np.random.RandomState(seed).shuffle(idx)
+    for i in range(0, len(idx), batch_size):
+        sel = idx[i : i + batch_size]
+        yield x[sel], y[sel]
+
+
+def _read_point_clouds(path: str, num_points: int, rng: np.random.RandomState):
+    import h5py
+
+    xs, ys = [], []
+    with h5py.File(path, "r") as ds:
+        for i in range(len(ds)):
+            pc = ds[str(i)]["points"][:]
+            idx = rng.choice(pc.shape[0], num_points)
+            xs.append(pc[idx])
+            ys.append(ds[str(i)].attrs["label"])
+    return np.asarray(xs, dtype=np.float32), np.asarray(ys, dtype=np.int64)
+
+
+def load_3d_mnist(source_dir: str, num_points: int = 1024, train: bool = False, seed: int = 42):
+    """Point clouds (N, num_points, 3) + labels; test split, optionally the
+    train split too (`src/helpers.py:116-178`)."""
+    data_dir = os.path.join(source_dir, "3DMNIST")
+    rng = np.random.RandomState(seed)
+    test = _read_point_clouds(os.path.join(data_dir, "test_point_clouds.h5"), num_points, rng)
+    if not train:
+        return test
+    train_split = _read_point_clouds(os.path.join(data_dir, "train_point_clouds.h5"), num_points, rng)
+    return test, train_split
+
+
+def load_3dvoxel_mnist(source_dir: str):
+    """16³ voxel grids: ((X_test, y_test), (X_train, y_train))
+    (`src/helpers.py:181-222`)."""
+    import h5py
+
+    with h5py.File(os.path.join(source_dir, "3DMNIST", "full_dataset_vectors.h5"), "r") as hf:
+        x_train = hf["X_train"][:].reshape(-1, 16, 16, 16).astype(np.float32)
+        y_train = hf["y_train"][:].astype(np.int64)
+        x_test = hf["X_test"][:].reshape(-1, 16, 16, 16).astype(np.float32)
+        y_test = hf["y_test"][:].astype(np.int64)
+    return (x_test, y_test), (x_train, y_train)

@@ -1,0 +1,91 @@
+"""Native runtime components (C++ via ctypes).
+
+`read_wav(path)` decodes a WAV file to float32 through the compiled
+`wavio.cpp` shared library when available (built lazily with g++), falling
+back to scipy.io.wavfile otherwise. Both paths return
+(sample_rate, samples) with samples (frames,) mono or (frames, channels).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+__all__ = ["read_wav", "native_available"]
+
+_HERE = os.path.dirname(__file__)
+_SRC = os.path.join(_HERE, "wavio.cpp")
+_LIB_PATH = os.path.join(_HERE, "_wavio.so")
+_lock = threading.Lock()
+_lib = None
+_build_failed = False
+
+
+def _load() -> ctypes.CDLL | None:
+    global _lib, _build_failed
+    with _lock:
+        if _lib is not None or _build_failed:
+            return _lib
+        try:
+            if not os.path.exists(_LIB_PATH) or os.path.getmtime(_LIB_PATH) < os.path.getmtime(_SRC):
+                subprocess.run(
+                    ["g++", "-O3", "-shared", "-fPIC", "-o", _LIB_PATH, _SRC],
+                    check=True,
+                    capture_output=True,
+                )
+            lib = ctypes.CDLL(_LIB_PATH)
+            lib.wav_info.argtypes = [
+                ctypes.c_char_p,
+                ctypes.POINTER(ctypes.c_int),
+                ctypes.POINTER(ctypes.c_int),
+                ctypes.POINTER(ctypes.c_long),
+            ]
+            lib.wav_info.restype = ctypes.c_int
+            lib.wav_read_f32.argtypes = [
+                ctypes.c_char_p,
+                ctypes.POINTER(ctypes.c_float),
+                ctypes.c_long,
+            ]
+            lib.wav_read_f32.restype = ctypes.c_long
+            _lib = lib
+        except Exception:
+            _build_failed = True
+        return _lib
+
+
+def native_available() -> bool:
+    return _load() is not None
+
+
+def read_wav(path: str) -> tuple[int, np.ndarray]:
+    lib = _load()
+    if lib is None:
+        from scipy.io import wavfile
+
+        sr, data = wavfile.read(path)
+        if data.dtype == np.int16:
+            data = data.astype(np.float32) / 32768.0
+        elif data.dtype == np.int32:
+            data = (data.astype(np.float64) / 2147483648.0).astype(np.float32)
+        else:
+            data = data.astype(np.float32)
+        return int(sr), data
+
+    sr = ctypes.c_int()
+    ch = ctypes.c_int()
+    frames = ctypes.c_long()
+    rc = lib.wav_info(path.encode(), ctypes.byref(sr), ctypes.byref(ch), ctypes.byref(frames))
+    if rc != 0:
+        raise IOError(f"wav_info failed ({rc}) for {path}")
+    out = np.empty(frames.value * ch.value, dtype=np.float32)
+    got = lib.wav_read_f32(path.encode(), out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), frames.value)
+    if got < 0:
+        raise IOError(f"wav_read_f32 failed ({got}) for {path}")
+    samples = out[: got * ch.value]
+    if ch.value > 1:
+        samples = samples.reshape(-1, ch.value)
+    return sr.value, samples
