@@ -1,0 +1,98 @@
+"""ViT / ConvNeXt smoke tests + config/results/profiling infrastructure."""
+
+import argparse
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def test_vit_forward():
+    from wam_tpu.models.vit import vit_tiny_test
+
+    model = vit_tiny_test(num_classes=9)
+    x = jnp.zeros((2, 32, 32, 3))
+    variables = model.init(jax.random.PRNGKey(0), x)
+    out = model.apply(variables, x)
+    assert out.shape == (2, 9)
+
+
+def test_vit_wam_end_to_end():
+    from wam_tpu.models.vit import vit_tiny_test
+    from wam_tpu.wam2d import WaveletAttribution2D
+
+    model = vit_tiny_test(num_classes=5)
+    variables = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 32, 32, 3)))
+    fn = lambda x: model.apply(variables, jnp.transpose(x, (0, 2, 3, 1)))
+    expl = WaveletAttribution2D(fn, wavelet="haar", J=2, method="integratedgrad", n_samples=4)
+    x = jnp.asarray(np.random.default_rng(0).standard_normal((1, 3, 32, 32)), dtype=jnp.float32)
+    out = expl(x, jnp.array([2]))
+    assert out.shape == (1, 32, 32)
+    assert np.all(np.isfinite(np.asarray(out)))
+
+
+def test_convnext_forward_and_taps():
+    from wam_tpu.models.convnext import convnext_test
+
+    model = convnext_test(num_classes=6)
+    x = jnp.zeros((1, 32, 32, 3))
+    variables = model.init(jax.random.PRNGKey(0), x)
+    out, state = model.apply(variables, x, mutable=["intermediates"])
+    assert out.shape == (1, 6)
+    assert "stage1" in state["intermediates"]
+    assert "perturbations" in variables  # gradcam taps present
+
+
+def test_config_defaults_match_reference():
+    from wam_tpu.config import WAM1DConfig, WAM2DConfig, WAM3DConfig
+
+    c2 = WAM2DConfig()
+    assert (c2.wavelet, c2.J, c2.mode, c2.n_samples, c2.stdev_spread, c2.random_seed) == (
+        "haar", 3, "reflect", 25, 0.25, 42)
+    c1 = WAM1DConfig()
+    assert (c1.n_mels, c1.n_fft, c1.sample_rate, c1.stdev_spread) == (128, 1024, 44100, 0.001)
+    c3 = WAM3DConfig()
+    assert (c3.mode, c3.EPS, c3.instance) == ("symmetric", 0.451, "voxels")
+
+
+def test_config_cli_roundtrip():
+    from wam_tpu.config import WAM2DConfig, add_config_args, config_from_args
+
+    parser = argparse.ArgumentParser()
+    add_config_args(parser, WAM2DConfig)
+    args = parser.parse_args(["--wavelet", "db4", "--n-samples", "10"])
+    cfg = config_from_args(args, WAM2DConfig)
+    assert cfg.wavelet == "db4" and cfg.n_samples == 10 and cfg.J == 3
+
+
+def test_results_writers(tmp_path):
+    from wam_tpu.results import CsvWriter, JsonlWriter, MetricRecord, read_jsonl
+
+    jpath = str(tmp_path / "metrics.jsonl")
+    w = JsonlWriter(jpath)
+    w.write(MetricRecord(metric="insertion_auc", value=0.7, unit="auc"))
+    w.write({"metric": "deletion_auc", "value": 0.2})
+    rows = read_jsonl(jpath)
+    assert len(rows) == 2 and rows[0]["metric"] == "insertion_auc"
+    assert w.done_keys() == {"insertion_auc", "deletion_auc"}
+
+    cpath = str(tmp_path / "iou.csv")
+    c = CsvWriter(cpath, ["percentage", "mean_iou"])
+    c.write({"percentage": 0.05, "mean_iou": 0.156})
+    assert "0.156" in open(cpath).read()
+
+
+def test_stage_timer():
+    from wam_tpu.profiling import StageTimer, trace
+
+    t = StageTimer()
+    with t.stage("a"):
+        pass
+    out = t.timed("jit", jax.jit(lambda v: v * 2), jnp.ones(4))
+    assert out[0] == 2
+    s = t.summary()
+    assert set(s) == {"a", "jit"} and s["jit"]["calls"] == 1
+
+    with trace("region"):
+        jnp.ones(2)

@@ -1,0 +1,122 @@
+"""Typed configuration — the explicit replacement for the reference's
+constructor-kwargs-only knob surface (SURVEY.md §5.6), with the reference's
+defaults preserved verbatim: 2D (haar, J=3, reflect, n=25, σ-spread 0.25,
+seed 42 — `lib/wam_2D.py:343-356`), 1D (haar, J=3, n=25, σ-spread 0.001,
+n_mels=128, n_fft=1024, sr=44100 — `lib/wam_1D.py:249-263`), 3D (haar, J=3,
+symmetric, n=25, σ-spread 1e-4, EPS=0.451 — `lib/wam_3D.py:501-520`).
+
+`device=` is the backend selector mandated by BASELINE.json's north star:
+"pipelines pick the backend via a device= flag".
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+from dataclasses import dataclass, field, fields
+
+__all__ = [
+    "WAM2DConfig",
+    "WAM1DConfig",
+    "WAM3DConfig",
+    "EvalConfig",
+    "select_backend",
+    "add_config_args",
+    "config_from_args",
+]
+
+
+def select_backend(device: str | None) -> None:
+    """Pick the JAX platform ('tpu'/'cpu'/None=auto). Must run before the
+    first backend use."""
+    import jax
+
+    if device is None or device == "auto":
+        return
+    platform = {"tpu": "tpu,axon", "axon": "axon", "cpu": "cpu"}.get(device, device)
+    jax.config.update("jax_platforms", platform)
+
+
+@dataclass
+class WAM2DConfig:
+    wavelet: str = "haar"
+    method: str = "smooth"
+    J: int = 3
+    mode: str = "reflect"
+    approx_coeffs: bool = False
+    normalize_coeffs: bool = True
+    n_samples: int = 25
+    stdev_spread: float = 0.25
+    random_seed: int = 42
+    sample_batch_size: int | None = None
+    device: str = "auto"
+
+
+@dataclass
+class WAM1DConfig:
+    wavelet: str = "haar"
+    method: str = "smooth"
+    J: int = 3
+    mode: str = "reflect"
+    approx_coeffs: bool = False
+    n_mels: int = 128
+    n_fft: int = 1024
+    sample_rate: int = 44100
+    n_samples: int = 25
+    stdev_spread: float = 0.001
+    random_seed: int = 42
+    sample_batch_size: int | None = None
+    device: str = "auto"
+
+
+@dataclass
+class WAM3DConfig:
+    wavelet: str = "haar"
+    method: str = "smooth"
+    J: int = 3
+    mode: str = "symmetric"
+    instance: str = "voxels"
+    normalize: bool = True
+    EPS: float = 0.451
+    n_samples: int = 25
+    stdev_spread: float = 1e-4
+    random_seed: int = 42
+    sample_batch_size: int | None = None
+    device: str = "auto"
+
+
+@dataclass
+class EvalConfig:
+    n_iter: int = 64
+    baseline_n_iter: int = 128
+    grid_size: int = 28
+    sample_size: int = 128
+    subset_size: int = 157
+    batch_size: int = 128
+    device: str = "auto"
+
+
+def add_config_args(parser: argparse.ArgumentParser, cfg_cls, prefix: str = "") -> None:
+    """Register every dataclass field as a CLI flag (the thin CLI)."""
+    for f in fields(cfg_cls):
+        name = f"--{prefix}{f.name.replace('_', '-')}"
+        if f.type in ("bool", bool):
+            parser.add_argument(name, type=lambda s: s.lower() in ("1", "true", "yes"),
+                                default=f.default)
+        else:
+            typ = {int: int, float: float}.get(f.type, str)
+            if isinstance(f.type, str):
+                typ = {"int": int, "float": float, "str": str}.get(f.type.split(" ")[0], str)
+            default = f.default if f.default is not dataclasses.MISSING else None
+            parser.add_argument(name, type=typ, default=default)
+
+
+def config_from_args(args: argparse.Namespace, cfg_cls, prefix: str = ""):
+    kwargs = {}
+    for f in fields(cfg_cls):
+        key = f"{prefix}{f.name}"
+        if hasattr(args, key):
+            v = getattr(args, key)
+            if v is not None:
+                kwargs[f.name] = v
+    return cfg_cls(**kwargs)

@@ -1,0 +1,59 @@
+"""Flax ConvNeXt (Tiny by default) — the model behind the fork's
+cross-wavelet IoU experiment (`compare_iou_models.ipynb` cell 3:
+torchvision convnext_tiny).
+
+Standard ConvNeXt recipe: patchify stem (4×4/4 conv + LayerNorm), stages of
+(7×7 depthwise conv → LN → 4× pointwise MLP with GELU → layer scale →
+residual), LN+2×2/2 downsampling between stages, global-pool LN head.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+__all__ = ["ConvNeXt", "convnext_tiny", "convnext_test"]
+
+
+class ConvNeXtBlock(nn.Module):
+    dim: int
+    ls_init: float = 1e-6
+
+    @nn.compact
+    def __call__(self, x):
+        y = nn.Conv(self.dim, (7, 7), padding=3, feature_group_count=self.dim, name="dwconv")(x)
+        y = nn.LayerNorm(name="ln")(y)
+        y = nn.gelu(nn.Dense(4 * self.dim, name="pw1")(y))
+        y = nn.Dense(self.dim, name="pw2")(y)
+        gamma = self.param("gamma", nn.initializers.constant(self.ls_init), (self.dim,))
+        return x + gamma * y
+
+
+class ConvNeXt(nn.Module):
+    num_classes: int = 1000
+    depths: Sequence[int] = (3, 3, 9, 3)
+    dims: Sequence[int] = (96, 192, 384, 768)
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        """x: (B, H, W, C) NHWC → logits."""
+        x = nn.Conv(self.dims[0], (4, 4), (4, 4), padding="VALID", name="stem_conv")(x)
+        x = nn.LayerNorm(name="stem_ln")(x)
+        for stage, (depth, dim) in enumerate(zip(self.depths, self.dims)):
+            if stage > 0:
+                x = nn.LayerNorm(name=f"down{stage}_ln")(x)
+                x = nn.Conv(dim, (2, 2), (2, 2), padding="VALID", name=f"down{stage}_conv")(x)
+            for i in range(depth):
+                x = ConvNeXtBlock(dim, name=f"stage{stage}_block{i}")(x)
+            self.sow("intermediates", f"stage{stage + 1}", x)
+            x = self.perturb(f"stage{stage + 1}", x)
+        x = x.mean(axis=(1, 2))
+        x = nn.LayerNorm(name="head_ln")(x)
+        return nn.Dense(self.num_classes, name="head")(x)
+
+
+convnext_tiny = partial(ConvNeXt, depths=(3, 3, 9, 3), dims=(96, 192, 384, 768))
+convnext_test = partial(ConvNeXt, depths=(1, 1), dims=(16, 32))

@@ -1,0 +1,71 @@
+"""Flax Vision Transformer (ViT-B/16 family) — the `BASELINE.json` IG
+workload model ("wam_2D: ViT-B/16 ImageNet, Integrated-Gradients-in-wavelet")
+and a timm-zoo counterpart (`src/helpers.py:468-479`).
+
+Pre-norm encoder, learned position embeddings, class token. Sizes are
+constructor fields so tests can instantiate tiny variants.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+__all__ = ["ViT", "vit_b16", "vit_tiny_test"]
+
+
+class MlpBlock(nn.Module):
+    hidden: int
+
+    @nn.compact
+    def __call__(self, x):
+        d = x.shape[-1]
+        x = nn.gelu(nn.Dense(self.hidden, name="fc1")(x))
+        return nn.Dense(d, name="fc2")(x)
+
+
+class EncoderBlock(nn.Module):
+    heads: int
+    mlp_hidden: int
+
+    @nn.compact
+    def __call__(self, x):
+        y = nn.LayerNorm(name="ln1")(x)
+        y = nn.MultiHeadDotProductAttention(num_heads=self.heads, name="attn")(y, y)
+        x = x + y
+        y = nn.LayerNorm(name="ln2")(x)
+        return x + MlpBlock(self.mlp_hidden, name="mlp")(y)
+
+
+class ViT(nn.Module):
+    num_classes: int = 1000
+    patch: int = 16
+    dim: int = 768
+    depth: int = 12
+    heads: int = 12
+    mlp_hidden: int = 3072
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        """x: (B, H, W, C) NHWC → logits (B, num_classes)."""
+        B = x.shape[0]
+        x = nn.Conv(self.dim, (self.patch, self.patch), (self.patch, self.patch),
+                    padding="VALID", name="patch_embed")(x)
+        x = x.reshape(B, -1, self.dim)
+        cls = self.param("cls_token", nn.initializers.zeros, (1, 1, self.dim))
+        x = jnp.concatenate([jnp.tile(cls, (B, 1, 1)), x], axis=1)
+        pos = self.param(
+            "pos_embed", nn.initializers.normal(0.02), (1, x.shape[1], self.dim)
+        )
+        x = x + pos
+        for i in range(self.depth):
+            x = EncoderBlock(self.heads, self.mlp_hidden, name=f"block{i}")(x)
+        self.sow("intermediates", "tokens", x)
+        x = nn.LayerNorm(name="ln")(x)
+        return nn.Dense(self.num_classes, name="head")(x[:, 0])
+
+
+vit_b16 = partial(ViT, patch=16, dim=768, depth=12, heads=12, mlp_hidden=3072)
+vit_tiny_test = partial(ViT, patch=8, dim=64, depth=2, heads=4, mlp_hidden=128)

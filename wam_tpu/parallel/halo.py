@@ -1,0 +1,101 @@
+"""Sequence-sharded DWT with ring halo exchange (long-context support).
+
+The 1D DWT is a filter-width-local stencil, so a sequence sharded across
+devices only needs L−2 boundary samples from its ring neighbour per level —
+exchanged with `lax.ppermute` over ICI inside `shard_map` (SURVEY.md §5.7:
+"the ring-attention-shaped pattern, but for convolution"). With the
+periodized transform the ring wrap IS the correct boundary condition, so the
+sharded result is bit-compatible with the single-device `dwt_per`.
+
+This is the scaling story for sequences far beyond one core's memory
+(the reference processes its longest input, a 220k-sample waveform, whole —
+`src/dataloader.py:83-97`; this path removes that ceiling).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax import shard_map
+
+from wam_tpu.wavelets.filters import build_wavelet
+from wam_tpu.wavelets.periodized import dwt_per
+
+__all__ = ["sharded_dwt_per", "sharded_wavedec_per"]
+
+
+def _local_dwt_with_halo(x_local: jax.Array, wavelet: str, axis_name: str):
+    """Per-shard kernel: fetch L−2 left-halo samples from the ring
+    predecessor, then run the strided correlation locally."""
+    wav = build_wavelet(wavelet)
+    L = wav.filt_len
+    n_shards = lax.axis_size(axis_name)
+    if L > 2:
+        tail = x_local[..., -(L - 2):]
+        # ring shift: shard i receives the tail of shard i-1 (circular)
+        halo = lax.ppermute(
+            tail, axis_name, perm=[(i, (i + 1) % n_shards) for i in range(n_shards)]
+        )
+        ext = jnp.concatenate([halo, x_local], axis=-1)
+    else:
+        ext = x_local
+    import numpy as np
+
+    kernel = jnp.asarray(
+        np.stack([np.asarray(wav.dec_lo[::-1]), np.asarray(wav.dec_hi[::-1])])[:, None],
+        dtype=x_local.dtype,
+    )
+    batch_shape = ext.shape[:-1]
+    xb = ext.reshape(-1, 1, ext.shape[-1])
+    out = lax.conv_general_dilated(
+        xb, kernel, window_strides=(2,), padding=[(0, 0)],
+        dimension_numbers=lax.conv_dimension_numbers((1, 1, 1), (1, 1, 1), ("NCH", "OIH", "NCH")),
+    )
+    out = out.reshape(batch_shape + (2, x_local.shape[-1] // 2))
+    return out[..., 0, :], out[..., 1, :]
+
+
+def sharded_dwt_per(mesh: Mesh, wavelet: str, seq_axis: str = "data"):
+    """Build a jitted `(x,) -> (cA, cD)` single-level sharded DWT: x (..., N)
+    sharded over ``seq_axis`` on its last dimension; outputs keep the same
+    sharding. Matches `dwt_per` exactly."""
+
+    @jax.jit
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=P(None, seq_axis),
+        out_specs=(P(None, seq_axis), P(None, seq_axis)),
+    )
+    def run(x_local):
+        return _local_dwt_with_halo(x_local, wavelet, seq_axis)
+
+    return run
+
+
+def sharded_wavedec_per(mesh: Mesh, wavelet: str, level: int, seq_axis: str = "data"):
+    """Multi-level sharded decomposition: [cA_J, cD_J, ..., cD_1], each leaf
+    sharded over ``seq_axis``. Requires the local shard length to stay even
+    at every level (N divisible by shards·2^level)."""
+
+    @jax.jit
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=P(None, seq_axis),
+        out_specs=P(None, seq_axis),
+    )
+    def run(x_local):
+        coeffs = []
+        a = x_local
+        for _ in range(level):
+            a, d = _local_dwt_with_halo(a, wavelet, seq_axis)
+            coeffs.append(d)
+        coeffs.append(a)
+        return coeffs[::-1]
+
+    return run

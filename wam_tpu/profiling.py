@@ -1,0 +1,67 @@
+"""Tracing/profiling utilities (SURVEY.md §5.1): named trace annotations
+that show up in `jax.profiler` timelines, plus a wall-clock stage timer for
+the benchmark harness. The reference has no instrumentation at all.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from collections import defaultdict
+
+import jax
+
+__all__ = ["trace", "StageTimer", "start_server", "profile_to"]
+
+
+@contextlib.contextmanager
+def trace(name: str):
+    """Annotate a region in device traces (XLA op names) AND host timelines."""
+    with jax.profiler.TraceAnnotation(name), jax.profiler.StepTraceAnnotation(name):
+        yield
+
+
+class StageTimer:
+    """Accumulating wall-clock timer: `with timer.stage("dwt"): ...`;
+    blocks on device results when given an output to ready-wait."""
+
+    def __init__(self):
+        self.totals: dict[str, float] = defaultdict(float)
+        self.counts: dict[str, int] = defaultdict(int)
+
+    @contextlib.contextmanager
+    def stage(self, name: str):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.totals[name] += time.perf_counter() - t0
+            self.counts[name] += 1
+
+    def timed(self, name: str, fn, *args, **kwargs):
+        with self.stage(name):
+            out = fn(*args, **kwargs)
+            jax.block_until_ready(out)
+        return out
+
+    def summary(self) -> dict[str, dict[str, float]]:
+        return {
+            k: {"total_s": self.totals[k], "calls": self.counts[k],
+                "mean_s": self.totals[k] / max(self.counts[k], 1)}
+            for k in self.totals
+        }
+
+
+def start_server(port: int = 9999):
+    """Expose the live profiler (for `tensorboard --logdir` capture)."""
+    return jax.profiler.start_server(port)
+
+
+@contextlib.contextmanager
+def profile_to(logdir: str):
+    """Write a full device trace for one region."""
+    jax.profiler.start_trace(logdir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()

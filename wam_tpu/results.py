@@ -1,0 +1,77 @@
+"""Results module — JSONL/CSV metric persistence (SURVEY.md §5.5): the
+explicit replacement for the reference's notebook-side CSV writes
+(`compare_iou_models.ipynb` cell 6) and instance-attribute stashing
+(`self.insertion_curves` etc., `src/evaluators.py:239-245`). Long sweeps
+append row-by-row so they are resumable (SURVEY.md §5.3).
+"""
+
+from __future__ import annotations
+
+import csv
+import dataclasses
+import json
+import os
+import time
+from dataclasses import dataclass, field
+from typing import Any
+
+__all__ = ["MetricRecord", "JsonlWriter", "CsvWriter", "read_jsonl"]
+
+
+@dataclass
+class MetricRecord:
+    metric: str
+    value: float
+    unit: str = ""
+    config: dict = field(default_factory=dict)
+    timestamp: float = field(default_factory=time.time)
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+class JsonlWriter:
+    """Append-only JSONL sink; each `write` is flushed so an interrupted
+    sweep keeps every finished row."""
+
+    def __init__(self, path: str):
+        self.path = path
+        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+
+    def write(self, record: MetricRecord | dict) -> None:
+        row = record.to_dict() if isinstance(record, MetricRecord) else record
+        with open(self.path, "a") as f:
+            f.write(json.dumps(row) + "\n")
+            f.flush()
+
+    def done_keys(self, key: str = "metric") -> set:
+        """Keys already written — skip these on resume."""
+        if not os.path.exists(self.path):
+            return set()
+        return {row.get(key) for row in read_jsonl(self.path)}
+
+
+def read_jsonl(path: str) -> list[dict]:
+    out = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                out.append(json.loads(line))
+    return out
+
+
+class CsvWriter:
+    """Row-wise CSV writer with a fixed header (the results/*.csv shape)."""
+
+    def __init__(self, path: str, fieldnames: list[str]):
+        self.path = path
+        self.fieldnames = fieldnames
+        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+        if not os.path.exists(path):
+            with open(path, "w", newline="") as f:
+                csv.DictWriter(f, fieldnames=fieldnames).writeheader()
+
+    def write(self, row: dict) -> None:
+        with open(self.path, "a", newline="") as f:
+            csv.DictWriter(f, fieldnames=self.fieldnames).writerow(row)

@@ -1,0 +1,87 @@
+"""Periodized (orthogonal) DWT — the distributed/long-context variant.
+
+With circular boundary handling the DWT is an exactly orthogonal N → N map
+(N/2 + N/2 coefficients, no boundary redundancy), which makes it the right
+form for sequence-sharded execution: each shard only needs a ring halo of
+L−2 neighbour samples (wam_tpu.parallel.halo), the collective pattern
+SURVEY.md §5.7 prescribes for long sequences.
+
+The inverse is obtained with `jax.linear_transpose` of the forward — for an
+orthogonal transform the adjoint IS the inverse, so reconstruction is exact
+by construction.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from wam_tpu.wavelets.filters import Wavelet, build_wavelet
+
+__all__ = ["dwt_per", "idwt_per", "wavedec_per", "waverec_per"]
+
+
+def _resolve(wavelet) -> Wavelet:
+    return wavelet if isinstance(wavelet, Wavelet) else build_wavelet(wavelet)
+
+
+def _corr_kernel(wav: Wavelet, dtype):
+    import numpy as np
+
+    k = np.stack([np.asarray(wav.dec_lo[::-1]), np.asarray(wav.dec_hi[::-1])])[:, None]
+    return jnp.asarray(k, dtype=dtype)
+
+
+def dwt_per(x: jax.Array, wavelet) -> tuple[jax.Array, jax.Array]:
+    """Single-level periodized DWT along the last axis (even length N).
+
+    out[k] = Σ_j f[j] · x[(2k − L + 2 + j) mod N], k < N/2 — the same
+    alignment as the zero-padded transform, with circular wrap.
+    """
+    wav = _resolve(wavelet)
+    L = wav.filt_len
+    N = x.shape[-1]
+    if N % 2:
+        raise ValueError("periodized DWT requires even length")
+    batch_shape = x.shape[:-1]
+    xb = x.reshape(-1, 1, N)
+    if L > 2:
+        xb = jnp.concatenate([xb[..., -(L - 2):], xb], axis=-1)
+    out = lax.conv_general_dilated(
+        xb,
+        _corr_kernel(wav, x.dtype),
+        window_strides=(2,),
+        padding=[(0, 0)],
+        dimension_numbers=lax.conv_dimension_numbers((1, 1, 1), (1, 1, 1), ("NCH", "OIH", "NCH")),
+    )
+    out = out.reshape(batch_shape + (2, N // 2))
+    return out[..., 0, :], out[..., 1, :]
+
+
+def idwt_per(cA: jax.Array, cD: jax.Array, wavelet) -> jax.Array:
+    """Exact inverse via the adjoint (orthogonal transform)."""
+    wav = _resolve(wavelet)
+    N = 2 * cA.shape[-1]
+    x_spec = jax.ShapeDtypeStruct(cA.shape[:-1] + (N,), cA.dtype)
+    transpose = jax.linear_transpose(lambda v: dwt_per(v, wav), x_spec)
+    (x,) = transpose((cA, cD))
+    return x
+
+
+def wavedec_per(x: jax.Array, wavelet, level: int):
+    """Multi-level periodized decomposition [cA_J, cD_J, ..., cD_1]."""
+    coeffs = []
+    a = x
+    for _ in range(level):
+        a, d = dwt_per(a, wavelet)
+        coeffs.append(d)
+    coeffs.append(a)
+    return coeffs[::-1]
+
+
+def waverec_per(coeffs, wavelet):
+    a = coeffs[0]
+    for d in coeffs[1:]:
+        a = idwt_per(a, d, wavelet)
+    return a
