@@ -24,13 +24,13 @@ QUICK = "--quick" in sys.argv
 
 
 def tpu_throughput() -> float:
-    import jax
+    from wam_tpu.config import ensure_usable_backend
 
-    try:  # fall back to CPU if the TPU tunnel is unavailable
-        jax.devices()
-    except RuntimeError as e:
-        print(f"# tpu backend unavailable ({e}); benching on CPU", file=sys.stderr)
-        jax.config.update("jax_platforms", "cpu")
+    platform = ensure_usable_backend(timeout_s=180.0)
+    if platform == "cpu":
+        print("# accelerator unavailable; benching on CPU", file=sys.stderr)
+
+    import jax
     import jax.numpy as jnp
 
     from wam_tpu.core.engine import WamEngine

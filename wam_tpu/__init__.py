@@ -4,24 +4,69 @@ A ground-up JAX/XLA re-design of the capabilities of the reference
 `michalpiasecki0/wam` repository (Wavelet Attribution Method, ICML 2025):
 differentiable multi-level wavelet transforms (1D/2D/3D), gradient-based
 attribution in the wavelet domain, SmoothGrad / Integrated-Gradients
-estimators, a faithfulness-evaluation suite, scale analyzers, and
-visualization for audio / image / volume modalities.
+estimators, a faithfulness-evaluation suite, scale analyzers, baselines,
+model zoo, data loaders, and visualization for audio / image / volume
+modalities.
 
 Everything in the compute path is pure-functional JAX: transforms are
-jit-able, vmap-able, and shardable over a `jax.sharding.Mesh`.
+jit-able, vmap-able, and shardable over a `jax.sharding.Mesh`
+(wam_tpu.parallel). Host-side IO has a native C++ fast path
+(wam_tpu.native).
 """
 
 from wam_tpu.wavelets import (
+    Detail2D,
     Wavelet,
     build_wavelet,
     dwt,
+    dwt2,
+    dwt3,
     idwt,
+    idwt2,
+    idwt3,
     wavedec,
-    waverec,
     wavedec2,
-    waverec2,
     wavedec3,
+    waverec,
+    waverec2,
     waverec3,
 )
+from wam_tpu.core import WamEngine, integrated_path, smoothgrad, target_loss
+
+# Modality front-ends (the reference's lib/wam_{1,2,3}D.py surface)
+from wam_tpu.wam1d import BaseWAM1D, VisualizerWAM1D, WaveletAttribution1D
+from wam_tpu.wam2d import BaseWAM2D, WaveletAttribution2D
+from wam_tpu.wam3d import BaseWAM3D, WaveletAttribution3D
+from wam_tpu.analyzers import WAMAnalyzer2D
 
 __version__ = "0.1.0"
+
+__all__ = [
+    "Wavelet",
+    "build_wavelet",
+    "Detail2D",
+    "dwt",
+    "idwt",
+    "dwt2",
+    "idwt2",
+    "dwt3",
+    "idwt3",
+    "wavedec",
+    "waverec",
+    "wavedec2",
+    "waverec2",
+    "wavedec3",
+    "waverec3",
+    "WamEngine",
+    "target_loss",
+    "smoothgrad",
+    "integrated_path",
+    "BaseWAM1D",
+    "WaveletAttribution1D",
+    "VisualizerWAM1D",
+    "BaseWAM2D",
+    "WaveletAttribution2D",
+    "BaseWAM3D",
+    "WaveletAttribution3D",
+    "WAMAnalyzer2D",
+]

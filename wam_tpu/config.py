@@ -26,6 +26,43 @@ __all__ = [
 ]
 
 
+def probe_accelerator(timeout_s: float = 180.0) -> bool:
+    """Check in a SUBPROCESS whether the accelerator backend can initialize.
+
+    The axon TPU plugin can block indefinitely inside client creation when
+    its pool is unreachable, so a simple try/except in-process would hang;
+    a throwaway subprocess with a hard timeout is the only safe probe.
+    """
+    import subprocess
+    import sys
+
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", "import jax; jax.devices()"],
+            timeout=timeout_s,
+            capture_output=True,
+        )
+        return proc.returncode == 0
+    except subprocess.TimeoutExpired:
+        return False
+
+
+def ensure_usable_backend(timeout_s: float = 180.0) -> str:
+    """Fall back to CPU (before any backend init) when the accelerator is
+    unreachable. Returns the platform that will be used."""
+    import os
+
+    import jax
+
+    want = os.environ.get("JAX_PLATFORMS", "")
+    if want == "cpu":
+        return "cpu"
+    if probe_accelerator(timeout_s):
+        return want or "auto"
+    jax.config.update("jax_platforms", "cpu")
+    return "cpu"
+
+
 def select_backend(device: str | None) -> None:
     """Pick the JAX platform ('tpu'/'cpu'/None=auto). Must run before the
     first backend use."""

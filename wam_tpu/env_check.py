@@ -1,0 +1,74 @@
+"""Environment verification — the `test_environment.py` role (C15): import
+smoke-test of the dependency stack, accelerator probe, and a tiny end-to-end
+attribution. Run as `python -m wam_tpu.env_check`."""
+
+from __future__ import annotations
+
+import sys
+
+CORE_DEPS = ["jax", "flax", "numpy", "scipy", "matplotlib", "PIL", "einops", "h5py", "pandas"]
+
+
+def check_imports() -> list[str]:
+    failed = []
+    for mod in CORE_DEPS:
+        try:
+            __import__(mod)
+        except Exception:
+            failed.append(mod)
+    return failed
+
+
+def check_devices() -> str:
+    import jax
+
+    from wam_tpu.config import ensure_usable_backend
+
+    platform = ensure_usable_backend(timeout_s=60.0)
+    devs = jax.devices()
+    note = " (accelerator unavailable; CPU fallback)" if platform == "cpu" else ""
+    return f"{len(devs)} × {devs[0].platform}{note}"
+
+
+def check_wam() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from wam_tpu import WaveletAttribution2D, wavedec2, waverec2
+
+    x = jax.random.normal(jax.random.PRNGKey(0), (1, 3, 32, 32))
+    rec = waverec2(wavedec2(x, "db2", 2), "db2")[..., :32, :32]
+    assert float(jnp.abs(rec - x).max()) < 1e-3, "DWT round-trip failed"
+
+    import flax.linen as nn
+
+    class M(nn.Module):
+        @nn.compact
+        def __call__(self, v):
+            v = jnp.transpose(v, (0, 2, 3, 1))
+            return nn.Dense(4)(nn.relu(nn.Conv(4, (3, 3))(v)).mean(axis=(1, 2)))
+
+    m = M()
+    p = m.init(jax.random.PRNGKey(0), x)
+    expl = WaveletAttribution2D(lambda v: m.apply(p, v), J=2, n_samples=2)
+    out = expl(x, jnp.array([1]))
+    assert out.shape[0] == 1
+
+
+def main() -> int:
+    failed = check_imports()
+    if failed:
+        print(f"FAIL: missing imports: {failed}")
+        return 1
+    print(f"devices: {check_devices()}")
+    try:
+        check_wam()
+    except Exception as e:
+        print(f"FAIL: end-to-end attribution: {e}")
+        return 1
+    print("OK: imports, devices, DWT round-trip, end-to-end attribution")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
