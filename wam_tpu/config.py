@@ -26,25 +26,34 @@ __all__ = [
 ]
 
 
+_probe_result: bool | None = None
+
+
 def probe_accelerator(timeout_s: float = 180.0) -> bool:
     """Check in a SUBPROCESS whether the accelerator backend can initialize.
 
     The axon TPU plugin can block indefinitely inside client creation when
     its pool is unreachable, so a simple try/except in-process would hang;
     a throwaway subprocess with a hard timeout is the only safe probe.
+    The answer cannot change within a process, so it is cached after the
+    first call.
     """
     import subprocess
     import sys
 
+    global _probe_result
+    if _probe_result is not None:
+        return _probe_result
     try:
         proc = subprocess.run(
             [sys.executable, "-c", "import jax; jax.devices()"],
             timeout=timeout_s,
             capture_output=True,
         )
-        return proc.returncode == 0
+        _probe_result = proc.returncode == 0
     except subprocess.TimeoutExpired:
-        return False
+        _probe_result = False
+    return _probe_result
 
 
 def ensure_usable_backend(timeout_s: float = 180.0) -> str:

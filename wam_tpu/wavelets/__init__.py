@@ -8,6 +8,8 @@ because JAX transforms are differentiable by construction.
 from wam_tpu.wavelets.filters import Wavelet, build_wavelet, qmf
 from wam_tpu.wavelets.transform import (
     DETAIL3D_KEYS,
+    get_dwt2_impl,
+    set_dwt2_impl,
     Detail2D,
     dwt,
     dwt2,
@@ -26,6 +28,8 @@ from wam_tpu.wavelets.transform import (
 
 __all__ = [
     "Wavelet",
+    "set_dwt2_impl",
+    "get_dwt2_impl",
     "build_wavelet",
     "qmf",
     "Detail2D",

@@ -1,0 +1,245 @@
+"""DWT as banded matmuls on the MXU, with a fused Pallas TPU kernel.
+
+The conv-form transforms in `wam_tpu.wavelets.transform` express one analysis
+level as a strided `lax.conv_general_dilated`. This module provides the
+matmul form of the same linear map: boundary padding (reflect / symmetric /
+zero / edge / periodic — the pywt semantics the reference relies on, e.g.
+``mode="reflect"`` at `lib/wam_2D.py:56`) is folded into a dense per-axis
+analysis matrix, so one full 2D level becomes
+
+    [[aa, ad], [da, dd]] = [A_lo; A_hi] @ X @ [B_lo; B_hi]^T
+
+— two matrix products that tile directly onto the 128x128 systolic array.
+The Pallas kernel `dwt2_pallas` fuses both products and the subband split
+into a single VMEM-resident kernel per image (custom VJP: the exact adjoint
+matmuls). The plain-XLA `analysis2_mm` / `synthesis2_mm` forms are used as
+the backward pass and as the CPU fallback, and are differentiable by
+construction.
+
+Matrices depend only on (length, wavelet, mode) — static under jit — and are
+cached host-side.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from wam_tpu.wavelets.filters import Wavelet, build_wavelet
+
+__all__ = [
+    "analysis_matrices",
+    "synthesis_matrices",
+    "analysis2_mm",
+    "synthesis2_mm",
+    "dwt2_pallas",
+]
+
+
+def _source_index(p: int, n: int, mode: str) -> int:
+    """Map an (possibly out-of-range) padded position to an index in [0, n),
+    or -1 when the contribution is zero (mode='zero'). Follows pywt/jnp.pad
+    semantics: 'reflect' = whole-sample, 'symmetric' = half-sample,
+    'constant' = edge-replicate (pywt naming), 'periodic' = wrap."""
+    if 0 <= p < n:
+        return p
+    if mode == "zero":
+        return -1
+    if mode == "constant":  # pywt 'constant' replicates the edge value
+        return 0 if p < 0 else n - 1
+    if mode == "periodic":
+        return p % n
+    if mode == "reflect":
+        if n == 1:
+            return 0
+        period = 2 * n - 2
+        m = p % period
+        return m if m < n else period - m
+    if mode == "symmetric":
+        period = 2 * n
+        m = p % period
+        return m if m < n else period - 1 - m
+    raise ValueError(f"Unsupported mode {mode!r}")
+
+
+@functools.lru_cache(maxsize=256)
+def _analysis_np(n: int, dec_lo: tuple, dec_hi: tuple, mode: str) -> np.ndarray:
+    """Stacked analysis matrix [A_lo; A_hi] of shape (2*n_out, n): row i of
+    A_f computes coefficient i of the f-subband, boundary handling folded in.
+    Matches the conv path exactly: out[i] = sum_k f_rev[k] * xp[2i + k] with
+    xp = pad(x, L-1)[1:]  (transform._analysis). Cached on the actual filter
+    taps, not the wavelet name, so custom Wavelet objects are honored."""
+    L = len(dec_lo)
+    n_out = (n + L - 1) // 2
+    mats = []
+    for filt in (dec_lo, dec_hi):
+        f_rev = np.asarray(filt[::-1], dtype=np.float64)
+        A = np.zeros((n_out, n))
+        for i in range(n_out):
+            for k in range(L):
+                s = _source_index(2 * i + k - L + 2, n, mode)
+                if s >= 0:
+                    A[i, s] += f_rev[k]
+        mats.append(A)
+    return np.concatenate(mats, axis=0)
+
+
+@functools.lru_cache(maxsize=256)
+def _synthesis_np(n_out: int, rec_lo: tuple, rec_hi: tuple) -> np.ndarray:
+    """Stacked synthesis matrix [S_lo | S_hi] of shape (full, 2*n_out) with
+    full = 2*n_out - L + 2: the zero-stuffed true convolution with the rec
+    filters, trimmed by L-2 per side (transform._synthesis)."""
+    L = len(rec_lo)
+    full = 2 * n_out - L + 2
+    mats = []
+    for filt in (rec_lo, rec_hi):
+        f = np.asarray(filt, dtype=np.float64)
+        S = np.zeros((full, n_out))
+        for i in range(n_out):
+            for k in range(L):
+                t = 2 * i + k - (L - 2)
+                if 0 <= t < full:
+                    S[t, i] += f[k]
+        mats.append(S)
+    return np.concatenate(mats, axis=1)
+
+
+def _wav(wavelet) -> Wavelet:
+    return wavelet if isinstance(wavelet, Wavelet) else build_wavelet(str(wavelet))
+
+
+def analysis_matrices(n: int, wavelet, mode: str, dtype=jnp.float32) -> jax.Array:
+    """(2*n_out, n) stacked [A_lo; A_hi] analysis matrix for one axis."""
+    w = _wav(wavelet)
+    return jnp.asarray(
+        _analysis_np(n, tuple(w.dec_lo), tuple(w.dec_hi), mode), dtype=dtype
+    )
+
+
+def synthesis_matrices(n_out: int, wavelet, dtype=jnp.float32) -> jax.Array:
+    """(2*n_out - L + 2, 2*n_out) stacked [S_lo | S_hi] synthesis matrix."""
+    w = _wav(wavelet)
+    return jnp.asarray(
+        _synthesis_np(n_out, tuple(w.rec_lo), tuple(w.rec_hi)), dtype=dtype
+    )
+
+
+def _split_quadrants(y: jax.Array, h_out: int, w_out: int) -> jax.Array:
+    """(..., 2*h_out, 2*w_out) block matrix -> (..., 4, h_out, w_out) in the
+    conv path's channel order (row, col): 0=aa, 1=ad, 2=da, 3=dd."""
+    aa = y[..., :h_out, :w_out]
+    ad = y[..., :h_out, w_out:]
+    da = y[..., h_out:, :w_out]
+    dd = y[..., h_out:, w_out:]
+    return jnp.stack([aa, ad, da, dd], axis=-3)
+
+
+def analysis2_mm(x: jax.Array, wavelet, mode: str) -> jax.Array:
+    """One 2D analysis level as two matmuls. x: (..., H, W) ->
+    (..., 4, H', W') matching `transform._analysis(x, wav, mode, 2)`."""
+    h, w = x.shape[-2:]
+    A = analysis_matrices(h, wavelet, mode, x.dtype)
+    B = analysis_matrices(w, wavelet, mode, x.dtype)
+    y = jnp.matmul(jnp.matmul(A, x, precision=lax.Precision.HIGHEST), B.T,
+                   precision=lax.Precision.HIGHEST)
+    return _split_quadrants(y, A.shape[0] // 2, B.shape[0] // 2)
+
+
+def synthesis2_mm(subbands: jax.Array, wavelet, out_shape) -> jax.Array:
+    """Inverse of one 2D level as two matmuls. subbands: (..., 4, h, w) ->
+    (..., out_shape), trimmed like `transform._synthesis`."""
+    h, w = subbands.shape[-2:]
+    S_r = synthesis_matrices(h, wavelet, subbands.dtype)
+    S_c = synthesis_matrices(w, wavelet, subbands.dtype)
+    aa, ad, da, dd = (subbands[..., i, :, :] for i in range(4))
+    top = jnp.concatenate([aa, ad], axis=-1)
+    bot = jnp.concatenate([da, dd], axis=-1)
+    y = jnp.concatenate([top, bot], axis=-2)  # (..., 2h, 2w) block matrix
+    out = jnp.matmul(jnp.matmul(S_r, y, precision=lax.Precision.HIGHEST), S_c.T,
+                     precision=lax.Precision.HIGHEST)
+    return out[..., : out_shape[0], : out_shape[1]]
+
+
+# ---------------------------------------------------------------------------
+# Fused Pallas kernel: both matmuls + subband split in one VMEM-resident pass
+# ---------------------------------------------------------------------------
+
+
+def _fused_kernel(a_ref, bt_ref, x_ref, out_ref):
+    t = jnp.dot(a_ref[:], x_ref[0], preferred_element_type=jnp.float32,
+                precision=lax.Precision.HIGHEST)
+    y = jnp.dot(t, bt_ref[:], preferred_element_type=jnp.float32,
+                precision=lax.Precision.HIGHEST)
+    h2, w2 = y.shape
+    h_out, w_out = h2 // 2, w2 // 2
+    out_ref[0, 0] = y[:h_out, :w_out]
+    out_ref[0, 1] = y[:h_out, w_out:]
+    out_ref[0, 2] = y[h_out:, :w_out]
+    out_ref[0, 3] = y[h_out:, w_out:]
+
+
+def _pallas_forward(x3: jax.Array, A: jax.Array, Bt: jax.Array) -> jax.Array:
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    n, h, w = x3.shape
+    h2, w2 = A.shape[0], Bt.shape[1]
+    h_out, w_out = h2 // 2, w2 // 2
+    interpret = jax.default_backend() != "tpu"
+    return pl.pallas_call(
+        _fused_kernel,
+        grid=(n,),
+        in_specs=[
+            pl.BlockSpec((h2, h), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((w, w2), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, h, w), lambda i: (i, 0, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, 4, h_out, w_out), lambda i: (i, 0, 0, 0), memory_space=pltpu.VMEM
+        ),
+        out_shape=jax.ShapeDtypeStruct((n, 4, h_out, w_out), jnp.float32),
+        interpret=interpret,
+    )(A, Bt, x3)
+
+
+@jax.custom_vjp
+def _dwt2_pallas_core(x3: jax.Array, A: jax.Array, Bt: jax.Array) -> jax.Array:
+    return _pallas_forward(x3, A, Bt)
+
+
+def _core_fwd(x3, A, Bt):
+    return _pallas_forward(x3, A, Bt), (A, Bt)
+
+
+def _core_bwd(res, g):
+    A, Bt = res
+    h_out, w_out = g.shape[-2:]
+    top = jnp.concatenate([g[:, 0], g[:, 1]], axis=-1)
+    bot = jnp.concatenate([g[:, 2], g[:, 3]], axis=-1)
+    gy = jnp.concatenate([top, bot], axis=-2)  # (n, 2h', 2w')
+    dx = jnp.matmul(jnp.matmul(A.T, gy, precision=lax.Precision.HIGHEST), Bt.T,
+                    precision=lax.Precision.HIGHEST)  # adjoint of y = A x B^T
+    return dx.astype(g.dtype), jnp.zeros_like(A), jnp.zeros_like(Bt)
+
+
+_dwt2_pallas_core.defvjp(_core_fwd, _core_bwd)
+
+
+def dwt2_pallas(x: jax.Array, wavelet, mode: str) -> jax.Array:
+    """One 2D analysis level via the fused Pallas kernel (interpreted off-TPU).
+
+    x: (..., H, W) -> (..., 4, H', W'), identical layout/values to
+    `transform._analysis(x, wav, mode, 2)`; differentiable (custom VJP is the
+    exact adjoint matmul pair)."""
+    h, w = x.shape[-2:]
+    A = analysis_matrices(h, wavelet, mode, jnp.float32)
+    B = analysis_matrices(w, wavelet, mode, jnp.float32)
+    batch_shape = x.shape[:-2]
+    x3 = x.reshape((-1, h, w)).astype(jnp.float32)
+    out = _dwt2_pallas_core(x3, A, B.T)
+    out = out.astype(x.dtype)
+    return out.reshape(batch_shape + out.shape[1:])
