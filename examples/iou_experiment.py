@@ -1,0 +1,107 @@
+"""Cross-wavelet IoU experiment — the fork's `compare_iou_models.ipynb`
+(cells 4-6): for each top-p fraction, explain each image with WAM-IG under
+several wavelets, take the top-p% masks of the mean reprojection map, and
+record the mean pairwise IoU across wavelet pairs. Writes `iou.csv` with the
+same layout as the reference's `results/iou.csv`.
+
+Runs without downloads (synthetic images + random-init ConvNeXt-Tiny by
+default); point --images at a directory (e.g. the reference's data/weasel)
+and --checkpoint at a torch state dict for the real experiment.
+
+    python examples/iou_experiment.py --out iou.csv --quick
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np
+
+
+def synthetic_images(n: int, size: int) -> list:
+    rng = np.random.default_rng(0)
+    out = []
+    for i in range(n):
+        yy, xx = np.mgrid[0:size, 0:size] / size
+        base = np.sin((8 + i) * xx) * np.cos((5 + i) * yy)
+        img = np.stack([base] * 3) + 0.1 * rng.standard_normal((3, size, size))
+        out.append(img.astype(np.float32))
+    return out
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--images", default=None, help="directory of images")
+    parser.add_argument("--checkpoint", default=None)
+    parser.add_argument("--model", default="convnext_tiny")
+    parser.add_argument("--wavelets", nargs="+", default=["haar", "db4", "sym4", "sym8"])
+    parser.add_argument("--levels", type=int, default=3)
+    parser.add_argument("--ps", nargs="+", type=float,
+                        default=[0.05, 0.1, 0.15, 0.2, 0.25, 0.3, 0.35, 0.4, 0.45, 0.5])
+    parser.add_argument("--samples", type=int, default=25, help="IG path steps")
+    parser.add_argument("--size", type=int, default=224)
+    parser.add_argument("--device", default="auto")
+    parser.add_argument("--out", default="iou.csv")
+    parser.add_argument("--quick", action="store_true", help="tiny shapes, 2 images")
+    args = parser.parse_args()
+
+    from wam_tpu.config import ensure_usable_backend, select_backend
+
+    select_backend(args.device)
+    if args.device == "auto":
+        ensure_usable_backend(timeout_s=120.0)
+
+    import jax.numpy as jnp
+
+    from wam_tpu import WaveletAttribution2D
+    from wam_tpu.analysis import cross_wavelet_iou
+    from wam_tpu.data import build_vision_model, preprocess_image
+
+    if args.quick:
+        args.size, args.samples, args.ps = 64, 4, args.ps[:3]
+
+    if args.images:
+        from PIL import Image
+
+        paths = sorted(
+            os.path.join(args.images, f)
+            for f in os.listdir(args.images)
+            if f.lower().endswith((".jpg", ".jpeg", ".png"))
+        )
+        images = [np.asarray(preprocess_image(Image.open(p))) for p in paths]
+    else:
+        images = synthetic_images(2 if args.quick else 5, args.size)
+
+    _, _, model_fn = build_vision_model(
+        args.model, checkpoint_path=args.checkpoint, image_size=args.size
+    )
+
+    def make_explainer(wavelet: str):
+        return WaveletAttribution2D(
+            model_fn, wavelet=wavelet, J=args.levels,
+            method="integratedgrad", n_samples=args.samples,
+        )
+
+    rows = []
+    for p in args.ps:
+        ious = [
+            cross_wavelet_iou(
+                img, make_explainer, args.wavelets, p, model_fn,
+                preprocess=lambda im: jnp.asarray(im)[None], J=args.levels,
+            )
+            for img in images
+        ]
+        rows.append((p, float(np.mean(ious))))
+        print(f"p={p:.2f}  mean IoU={rows[-1][1]:.3f}")
+
+    with open(args.out, "w") as f:
+        f.write(",iou\n")
+        for p, v in rows:
+            f.write(f"{p},{v}\n")
+    print(f"wrote {args.out}")
+
+
+if __name__ == "__main__":
+    main()

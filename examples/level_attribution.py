@@ -1,0 +1,99 @@
+"""Per-level attribution shares across models/wavelets — the fork's
+variance experiment (`utils.py:112-151` + `plot_utils.py:79-114` →
+`results/results_variance.csv` and `results/plots_mean_grads/*.png`):
+normalized per-level |gradient| mass for each (model, wavelet), plus the
+grouped bar plot.
+
+    python examples/level_attribution.py --quick --out levels
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--models", nargs="+", default=["resnet18", "convnext_tiny"])
+    parser.add_argument("--wavelet", default="haar")
+    parser.add_argument("--levels", type=int, default=3)
+    parser.add_argument("--n-images", type=int, default=4)
+    parser.add_argument("--samples", type=int, default=25)
+    parser.add_argument("--size", type=int, default=224)
+    parser.add_argument("--device", default="auto")
+    parser.add_argument("--out", default="levels")
+    parser.add_argument("--quick", action="store_true")
+    args = parser.parse_args()
+
+    from wam_tpu.config import ensure_usable_backend, select_backend
+
+    select_backend(args.device)
+    if args.device == "auto":
+        ensure_usable_backend(timeout_s=120.0)
+
+    import jax.numpy as jnp
+    import matplotlib
+
+    matplotlib.use("Agg")
+
+    from wam_tpu import WaveletAttribution2D
+    from wam_tpu.analysis import (
+        get_gradients_attribution_on_levels,
+        get_mean_across_images,
+        rank_images,
+    )
+    from wam_tpu.data import build_vision_model
+    from wam_tpu.viz import visualize_gradients_at_levels
+
+    if args.quick:
+        args.size, args.samples, args.n_images = 64, 4, 2
+
+    rng = np.random.default_rng(0)
+    images = [
+        rng.standard_normal((3, args.size, args.size)).astype(np.float32)
+        for _ in range(args.n_images)
+    ]
+
+    per_model = []
+    for name in args.models:
+        _, _, model_fn = build_vision_model(name, image_size=args.size)
+        explainer = WaveletAttribution2D(
+            model_fn, wavelet=args.wavelet, J=args.levels,
+            method="smooth", n_samples=args.samples,
+        )
+        explanations = []
+        for img in images:
+            x = jnp.asarray(img)[None]
+            y = int(np.asarray(model_fn(x)).argmax())
+            explanations.append(np.asarray(explainer(x, jnp.array([y]))[0]))
+        shares = get_gradients_attribution_on_levels(explanations, args.levels)
+        per_model.append(shares)
+        ranked = rank_images(explanations, args.levels)
+        print(f"{name}: per-level shares mean={np.mean(shares, axis=0)}, "
+              f"variance ranking={ranked}")
+
+    means = get_mean_across_images(per_model)
+    stds = [np.asarray(g).std(axis=0) for g in per_model]
+    with open(f"{args.out}_variance.csv", "w") as f:
+        header = ",".join(
+            f"level_{j}_mean,level_{j}_std" for j in range(args.levels + 1)
+        )
+        f.write(f"model,{header}\n")
+        for name, mean, std in zip(args.models, means, stds):
+            cells = ",".join(f"{m},{s}" for m, s in zip(mean, std))
+            f.write(f"{name},{cells}\n")
+
+    fig = visualize_gradients_at_levels(
+        means, title=f"Per-level attribution ({args.wavelet})",
+        names=args.models,
+    )
+    fig.savefig(f"{args.out}_mean_grads.png", dpi=120)
+    print(f"wrote {args.out}_variance.csv and {args.out}_mean_grads.png")
+
+
+if __name__ == "__main__":
+    main()

@@ -149,6 +149,50 @@ def test_gradcam_resnet():
         assert np.all(np.isfinite(np.asarray(cam)))
 
 
+def test_guided_relu_backward_rule():
+    """Backward passes g only where input>0 AND g>0."""
+    from wam_tpu.evalsuite.baselines import guided_relu
+
+    x = jnp.array([-1.0, 2.0, 3.0, 0.5])
+    w = jnp.array([1.0, -1.0, 2.0, 0.5])  # cotangents via dot
+    g = jax.grad(lambda v: jnp.sum(guided_relu(v) * w))(x)
+    # x=-1: input<0 -> 0; x=2: g=-1<0 -> 0; x=3: g=2>0 -> 2; x=0.5: g=0.5>0
+    np.testing.assert_allclose(np.asarray(g), [0.0, 0.0, 2.0, 0.5])
+
+
+def test_guided_backprop_resnet():
+    from wam_tpu.evalsuite.baselines import guided_backprop, saliency
+    from wam_tpu.models import bind_inference, resnet18
+
+    model = resnet18(num_classes=5)
+    variables = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 32, 32, 3)))
+    x = jnp.asarray(np.random.default_rng(8).standard_normal((2, 3, 32, 32)), dtype=jnp.float32)
+    y = jnp.array([1, 3])
+    gb = guided_backprop(model, variables, x, y)
+    assert gb.shape == (2, 32, 32)
+    assert np.all(np.asarray(gb) >= 0) and np.all(np.isfinite(np.asarray(gb)))
+    # the guided rule must actually change the map vs plain saliency
+    sal = saliency(bind_inference(model, variables, nchw=True), x, y)
+    assert not np.allclose(np.asarray(gb), np.asarray(sal), atol=1e-6)
+
+
+def test_lrp_linear_biasfree_equals_gradxinput():
+    """On a bias-free linear model the ε→0 LRP identity is exact."""
+    from wam_tpu.evalsuite.baselines import gradient_x_input, lrp
+
+    rng = np.random.default_rng(9)
+    W = jnp.asarray(rng.standard_normal((3 * 16 * 16, 4)), dtype=jnp.float32)
+    x = jnp.asarray(rng.standard_normal((1, 3, 16, 16)), dtype=jnp.float32)
+    y = jnp.array([2])
+    r = lrp(_linear_model(W), x, y)
+    gxi = gradient_x_input(_linear_model(W), x, y)
+    np.testing.assert_allclose(np.asarray(r), np.asarray(gxi), atol=1e-6)
+    # completeness on the bias-free linear model: channel-mean relevance sums
+    # to logit / C (batch of 1, diag-mean loss = the logit itself)
+    logit = float((x.reshape(1, -1) @ W)[0, 2])
+    np.testing.assert_allclose(float(np.asarray(r).sum() * 3), logit, rtol=1e-4)
+
+
 # -- end-to-end evaluators -------------------------------------------------
 
 

@@ -148,10 +148,15 @@ def cross_wavelet_iou(
     J: int,
 ) -> float:
     """Mean pairwise IoU of top-p% reprojection masks across wavelets
-    (`get_iou_between_wavelets`, notebook cell 5)."""
+    (`get_iou_between_wavelets`, notebook cell 5). Reprojection maps are
+    cropped to the input resolution before masking — longer filters grow the
+    mosaic past the image size by boundary extension (the reference instead
+    hard-crops to 224, `lib/wam_2D.py:448`)."""
+    hw = np.asarray(preprocess(image)).shape[-2:]  # (1, C, H, W) contract
     masks = []
     for wave in wavelets:
         explainer = make_explainer(wave)
         expl = get_explanation_for_image(image, model_fn, explainer, preprocess)
-        masks.append(top_percentage_mask(reprojection_map(expl, J), p))
+        rmap = reprojection_map(expl, J)[: hw[0], : hw[1]]
+        masks.append(top_percentage_mask(rmap, p))
     return mean_pairwise_iou(masks)

@@ -26,7 +26,18 @@ import jax.numpy as jnp
 from wam_tpu.core.engine import target_loss
 from wam_tpu.core.estimators import noise_sigma
 
-__all__ = ["saliency", "integrated_gradients", "smoothgrad_pixel", "gradcam", "gradcam_pp", "layercam"]
+__all__ = [
+    "saliency",
+    "integrated_gradients",
+    "smoothgrad_pixel",
+    "gradcam",
+    "gradcam_pp",
+    "layercam",
+    "guided_relu",
+    "guided_backprop",
+    "gradient_x_input",
+    "lrp",
+]
 
 
 def _input_grads(model_fn: Callable, x: jax.Array, y) -> jax.Array:
@@ -122,3 +133,68 @@ def layercam(model, variables, x, y, layer: str = "stage3", nchw: bool = True) -
     acts, grads = _acts_and_grads(model, variables, x, y, layer, nchw)
     cam = jax.nn.relu((jax.nn.relu(grads) * acts).sum(axis=-1))
     return _resize_to(cam, x.shape[-2:])
+
+
+@jax.custom_vjp
+def guided_relu(x: jax.Array) -> jax.Array:
+    """ReLU whose backward passes only positive gradients at positive inputs
+    (Springenberg et al. 2014) — the modified-backward primitive behind
+    guided backprop (reference registry entry 'guided_backprop',
+    `src/evaluators.py:851-902`)."""
+    return jnp.maximum(x, 0.0)
+
+
+def _guided_relu_fwd(x):
+    return jnp.maximum(x, 0.0), x
+
+
+def _guided_relu_bwd(x, g):
+    return (jnp.where((x > 0) & (g > 0), g, 0.0),)
+
+
+guided_relu.defvjp(_guided_relu_fwd, _guided_relu_bwd)
+
+
+def guided_backprop(model, variables, x: jax.Array, y, nchw: bool = True) -> jax.Array:
+    """Guided backprop: input gradients through a clone of the model whose
+    activations are `guided_relu` (same params — the activation carries no
+    state). Requires a ReLU model exposing an `act` attribute (the ResNet
+    and voxel zoos do; GELU models like ConvNeXt/ViT are out of scope for
+    the guided rule); channel-averaged |grad| → (B, H, W)."""
+    if not hasattr(model, "act"):
+        raise ValueError(
+            f"guided_backprop needs a model with a swappable `act` attribute; "
+            f"{type(model).__name__} has none (use a ReLU model such as the "
+            "ResNet or voxel zoo, or add an `act` field to the module)"
+        )
+    guided = model.clone(act=guided_relu)
+
+    def model_fn(v):
+        inp = jnp.transpose(v, (0, 2, 3, 1)) if nchw else v
+        out = guided.apply(variables, inp)
+        return out[0] if isinstance(out, tuple) else out
+
+    return jnp.abs(_input_grads(model_fn, x, y)).mean(axis=1)
+
+
+def gradient_x_input(model_fn: Callable, x: jax.Array, y) -> jax.Array:
+    """x ⊙ ∂logit_y/∂x, channel-averaged → (B, H, W)."""
+    return (x * _input_grads(model_fn, x, y)).mean(axis=1)
+
+
+def lrp(model_fn: Callable, x: jax.Array, y, n_steps: int = 0) -> jax.Array:
+    """ε→0 layer-wise relevance propagation for piecewise-linear nets.
+
+    For ReLU networks with bias-free linear layers, LRP-0/LRP-ε relevance at
+    the input equals gradient x input (Shrikumar et al. 2016; Ancona et al.
+    2018) — that identity is used here rather than a per-layer rule pass.
+    The reference's 'lrp' registry entry (zennit EpsilonPlusFlat +
+    ResNetCanonizer, `src/evaluators.py:885-899`) applies per-layer ε-rules,
+    so values agree in rank structure but are not bitwise-matched where
+    biases/BatchNorm shift relevance. n_steps>0 averages the identity along
+    the zero→x path (closer to ε-rule smoothing on biased nets)."""
+    if n_steps and n_steps > 1:
+        alphas = jnp.linspace(1.0 / n_steps, 1.0, n_steps, dtype=x.dtype)
+        grads = jax.lax.map(lambda a: _input_grads(model_fn, x * a, y), alphas)
+        return (x * grads.mean(axis=0)).mean(axis=1)
+    return gradient_x_input(model_fn, x, y)

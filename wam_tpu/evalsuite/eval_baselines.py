@@ -21,7 +21,17 @@ from wam_tpu.ops.filters import gaussian_filter2d, superpixel_sum, upsample_near
 
 __all__ = ["EvalImageBaselines", "EvalAudioBaselines", "IMAGE_METHODS", "AUDIO_METHODS"]
 
-IMAGE_METHODS = ("saliency", "integratedgrad", "smoothgrad", "gradcam", "gradcampp", "layercam")
+IMAGE_METHODS = (
+    "saliency",
+    "integratedgrad",
+    "smoothgrad",
+    "gradcam",
+    "gradcampp",
+    "layercam",
+    "guided_backprop",
+    "gradxinput",
+    "lrp",
+)
 AUDIO_METHODS = ("saliency", "integratedgrad", "smoothgrad", "gradcam")
 
 
@@ -76,6 +86,14 @@ class _BaseEvalBaselines:
             return B.gradcam_pp(self.model, self.variables, x, y, layer=self.cam_layer, nchw=self.nchw)
         if m == "layercam":
             return B.layercam(self.model, self.variables, x, y, layer=self.cam_layer, nchw=self.nchw)
+        if m == "guided_backprop":
+            return B.guided_backprop(self.model, self.variables, x, y, nchw=self.nchw)
+        if m == "gradxinput":
+            return B.gradient_x_input(self.model_fn, x, y)
+        if m == "lrp":
+            # n_steps=0: the ε→0 identity — keeps 'lrp' distinct from
+            # 'integratedgrad' (whose path average n_steps>1 would duplicate)
+            return B.lrp(self.model_fn, x, y, n_steps=0)
         raise AssertionError(m)
 
     def precompute(self, x, y):

@@ -33,6 +33,7 @@ class BasicBlock(nn.Module):
     features: int
     strides: int = 1
     norm: ModuleDef = nn.BatchNorm
+    act: Callable = nn.relu
 
     @nn.compact
     def __call__(self, x):
@@ -40,14 +41,14 @@ class BasicBlock(nn.Module):
         y = nn.Conv(self.features, (3, 3), (self.strides, self.strides), padding=1,
                     use_bias=False, name="conv1")(x)
         y = self.norm(name="bn1")(y)
-        y = nn.relu(y)
+        y = self.act(y)
         y = nn.Conv(self.features, (3, 3), padding=1, use_bias=False, name="conv2")(y)
         y = self.norm(name="bn2")(y)
         if residual.shape != y.shape:
             residual = nn.Conv(self.features, (1, 1), (self.strides, self.strides),
                                use_bias=False, name="downsample_conv")(x)
             residual = self.norm(name="downsample_bn")(residual)
-        return nn.relu(y + residual)
+        return self.act(y + residual)
 
 
 class Bottleneck(nn.Module):
@@ -55,17 +56,18 @@ class Bottleneck(nn.Module):
     strides: int = 1
     norm: ModuleDef = nn.BatchNorm
     expansion: int = 4
+    act: Callable = nn.relu
 
     @nn.compact
     def __call__(self, x):
         residual = x
         y = nn.Conv(self.features, (1, 1), use_bias=False, name="conv1")(x)
         y = self.norm(name="bn1")(y)
-        y = nn.relu(y)
+        y = self.act(y)
         y = nn.Conv(self.features, (3, 3), (self.strides, self.strides), padding=1,
                     use_bias=False, name="conv2")(y)
         y = self.norm(name="bn2")(y)
-        y = nn.relu(y)
+        y = self.act(y)
         y = nn.Conv(self.features * self.expansion, (1, 1), use_bias=False, name="conv3")(y)
         y = self.norm(name="bn3")(y)
         if residual.shape != y.shape:
@@ -73,13 +75,16 @@ class Bottleneck(nn.Module):
                                (self.strides, self.strides), use_bias=False,
                                name="downsample_conv")(x)
             residual = self.norm(name="downsample_bn")(residual)
-        return nn.relu(y + residual)
+        return self.act(y + residual)
 
 
 class ResNet(nn.Module):
     stage_sizes: Sequence[int]
     block_cls: ModuleDef
     num_classes: int = 1000
+    # Activation is an attribute so baselines can swap in a modified-backward
+    # ReLU (guided backprop) on a clone that reuses the same params.
+    act: Callable = nn.relu
 
     @nn.compact
     def __call__(self, x, train: bool = False):
@@ -87,13 +92,13 @@ class ResNet(nn.Module):
         norm = partial(nn.BatchNorm, use_running_average=not train, momentum=0.9, epsilon=1e-5)
         x = nn.Conv(64, (7, 7), (2, 2), padding=3, use_bias=False, name="conv1")(x)
         x = norm(name="bn1")(x)
-        x = nn.relu(x)
+        x = self.act(x)
         x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))
         for stage, n_blocks in enumerate(self.stage_sizes):
             for i in range(n_blocks):
                 strides = 2 if stage > 0 and i == 0 else 1
                 x = self.block_cls(64 * 2**stage, strides=strides, norm=norm,
-                                   name=f"layer{stage + 1}_{i}")(x)
+                                   act=self.act, name=f"layer{stage + 1}_{i}")(x)
             self.sow("intermediates", f"stage{stage + 1}", x)
             # Gradient tap for the GradCAM-family baselines: no-op unless a
             # 'perturbations' collection is passed (wam_tpu.evalsuite.baselines).

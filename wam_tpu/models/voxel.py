@@ -7,6 +7,8 @@ Input layout: (B, 1, D, H, W) like the reference; NDHWC internally.
 
 from __future__ import annotations
 
+from typing import Callable
+
 import flax.linen as nn
 import jax.numpy as jnp
 
@@ -15,15 +17,17 @@ __all__ = ["VoxelModel"]
 
 class VoxelModel(nn.Module):
     num_classes: int = 10
+    # swappable so guided backprop can substitute its modified-backward ReLU
+    act: Callable = nn.relu
 
     @nn.compact
     def __call__(self, x, train: bool = False):
         x = jnp.transpose(x, (0, 2, 3, 4, 1))  # (B, D, H, W, C)
-        x = nn.relu(nn.Conv(32, (3, 3, 3), padding="VALID", name="conv1")(x))
+        x = self.act(nn.Conv(32, (3, 3, 3), padding="VALID", name="conv1")(x))
         x = nn.max_pool(x, (2, 2, 2), (2, 2, 2))
-        x = nn.relu(nn.Conv(128, (3, 3, 3), padding="VALID", name="conv2")(x))
+        x = self.act(nn.Conv(128, (3, 3, 3), padding="VALID", name="conv2")(x))
         x = nn.max_pool(x, (2, 2, 2), (2, 2, 2))
         self.sow("intermediates", "features", x)
         x = x.reshape(x.shape[0], -1)
-        x = nn.relu(nn.Dense(256, name="fc1")(x))
+        x = self.act(nn.Dense(256, name="fc1")(x))
         return nn.Dense(self.num_classes, name="fc2")(x)
