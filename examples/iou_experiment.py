@@ -56,7 +56,10 @@ def main():
     import jax.numpy as jnp
 
     from wam_tpu import WaveletAttribution2D
-    from wam_tpu.analysis import cross_wavelet_iou
+    from wam_tpu.analysis import (
+        cross_wavelet_reprojection_maps,
+        iou_from_reprojection_maps,
+    )
     from wam_tpu.data import build_vision_model, preprocess_image
 
     if args.quick:
@@ -70,7 +73,17 @@ def main():
             for f in os.listdir(args.images)
             if f.lower().endswith((".jpg", ".jpeg", ".png"))
         )
-        images = [np.asarray(preprocess_image(Image.open(p))) for p in paths]
+        # keep the reference's 256-resize/224-crop ratio at whatever --size
+        images = [
+            np.asarray(
+                preprocess_image(
+                    Image.open(p),
+                    resize=round(args.size * 256 / 224),
+                    crop=args.size,
+                )
+            )
+            for p in paths
+        ]
     else:
         images = synthetic_images(2 if args.quick else 5, args.size)
 
@@ -84,15 +97,18 @@ def main():
             method="integratedgrad", n_samples=args.samples,
         )
 
+    # explanations are independent of p: compute one map set per image,
+    # then sweep the top-p threshold over the cached maps
+    map_sets = [
+        cross_wavelet_reprojection_maps(
+            img, make_explainer, args.wavelets, model_fn,
+            preprocess=lambda im: jnp.asarray(im)[None], J=args.levels,
+        )
+        for img in images
+    ]
     rows = []
     for p in args.ps:
-        ious = [
-            cross_wavelet_iou(
-                img, make_explainer, args.wavelets, p, model_fn,
-                preprocess=lambda im: jnp.asarray(im)[None], J=args.levels,
-            )
-            for img in images
-        ]
+        ious = [iou_from_reprojection_maps(maps, p) for maps in map_sets]
         rows.append((p, float(np.mean(ious))))
         print(f"p={p:.2f}  mean IoU={rows[-1][1]:.3f}")
 

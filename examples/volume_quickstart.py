@@ -2,7 +2,7 @@
 flow: per-volume 3D DWT → IDWT → 3D CNN → gradients → dyadic cube), plus the
 `y=None` representation mode and per-level visualization. Runs without
 downloads — a synthetic sphere-ish blob and a random-init VoxelModel; pass
---h5 at a 3D-MNIST file / --checkpoint for real data.
+--h5 at a 3D-MNIST dataset root / --checkpoint for real data.
 
     python examples/volume_quickstart.py --quick --out volume.png
 """
@@ -25,7 +25,8 @@ def synthetic_blob(s: int) -> np.ndarray:
 
 def main():
     parser = argparse.ArgumentParser()
-    parser.add_argument("--h5", default=None, help="3D-MNIST h5 path")
+    parser.add_argument("--h5", default=None,
+                        help="dataset root containing 3DMNIST/full_dataset_vectors.h5")
     parser.add_argument("--checkpoint", default=None)
     parser.add_argument("--wavelet", default="haar")
     parser.add_argument("--levels", type=int, default=2)
@@ -57,12 +58,14 @@ def main():
     if args.h5:
         from wam_tpu.data.mnist3d import load_3dvoxel_mnist
 
-        vols, labels = load_3dvoxel_mnist(args.h5, count=1)
-        vol = np.asarray(vols[0])
+        (vols_test, _), _ = load_3dvoxel_mnist(args.h5)
+        vol = np.asarray(vols_test[0])
     else:
         vol = synthetic_blob(args.size)
 
-    model, variables, model_fn = load_3dvoxel_model(args.checkpoint, num_classes=10)
+    model, variables, model_fn = load_3dvoxel_model(
+        args.checkpoint, num_classes=10, size=vol.shape[-1]
+    )
     x = jnp.asarray(vol)[None, None]  # (B, 1, S, S, S)
     y = int(np.asarray(model_fn(x)).argmax())
     print(f"explaining class {y}")

@@ -188,3 +188,23 @@ def test_viz3d_render():
     plt.close(fig)
     fig = voxel_superpose(vol, rng.random((8, 8, 8)), heat_threshold=0.8)
     plt.close(fig)
+
+
+def test_plot_wavelet_regions_reference_shape():
+    """Reference-shaped (h, v) dicts (`src/viewers.py:39-63`): level 0 spans
+    the full mosaic at size/2; each subsequent level halves the coordinates."""
+    from wam_tpu.viz.viewers import plot_wavelet_regions
+
+    h, v = plot_wavelet_regions(64, 3)
+    assert set(h) == set(v) == {0, 1, 2}
+    np.testing.assert_array_equal(h[0], [[0, 32], [64, 32]])
+    np.testing.assert_array_equal(v[0], [[32, 64], [32, 0]])
+    np.testing.assert_array_equal(h[1], h[0] // 2)
+    np.testing.assert_array_equal(v[2], v[0] // 4)
+
+
+def test_srd_exclusion_is_explicit():
+    from wam_tpu.evalsuite.eval_baselines import EvalImageBaselines
+
+    with pytest.raises(NotImplementedError, match="lib.srd"):
+        EvalImageBaselines(None, {}, method="srd")

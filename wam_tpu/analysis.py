@@ -24,6 +24,8 @@ __all__ = [
     "iou",
     "mean_pairwise_iou",
     "cross_wavelet_iou",
+    "cross_wavelet_reprojection_maps",
+    "iou_from_reprojection_maps",
     "reprojection_map",
 ]
 
@@ -138,6 +140,35 @@ def reprojection_map(explanation: np.ndarray, J: int) -> np.ndarray:
     return np.asarray(maps.mean(axis=1)[0])
 
 
+def cross_wavelet_reprojection_maps(
+    image,
+    make_explainer: Callable[[str], Callable],
+    wavelets: Sequence[str],
+    model_fn,
+    preprocess,
+    J: int,
+) -> list[np.ndarray]:
+    """One reprojection pixel map per wavelet for `image` — the expensive,
+    p-independent half of the cross-wavelet IoU experiment. Maps are cropped
+    to the input resolution — longer filters grow the mosaic past the image
+    size by boundary extension (the reference instead hard-crops to 224,
+    `lib/wam_2D.py:448`)."""
+    x = preprocess(image)  # (1, C, H, W) contract
+    hw = np.asarray(x).shape[-2:]
+    y = int(np.asarray(model_fn(x)).argmax())  # class is wavelet-independent
+    maps = []
+    for wave in wavelets:
+        expl = np.asarray(make_explainer(wave)(x, [y])).squeeze()
+        maps.append(reprojection_map(expl, J)[: hw[0], : hw[1]])
+    return maps
+
+
+def iou_from_reprojection_maps(maps: Sequence[np.ndarray], p: float) -> float:
+    """Mean pairwise IoU of top-p% masks of precomputed reprojection maps —
+    the cheap half; sweep `p` over the same maps without re-explaining."""
+    return mean_pairwise_iou([top_percentage_mask(m, p) for m in maps])
+
+
 def cross_wavelet_iou(
     image,
     make_explainer: Callable[[str], Callable],
@@ -148,15 +179,8 @@ def cross_wavelet_iou(
     J: int,
 ) -> float:
     """Mean pairwise IoU of top-p% reprojection masks across wavelets
-    (`get_iou_between_wavelets`, notebook cell 5). Reprojection maps are
-    cropped to the input resolution before masking — longer filters grow the
-    mosaic past the image size by boundary extension (the reference instead
-    hard-crops to 224, `lib/wam_2D.py:448`)."""
-    hw = np.asarray(preprocess(image)).shape[-2:]  # (1, C, H, W) contract
-    masks = []
-    for wave in wavelets:
-        explainer = make_explainer(wave)
-        expl = get_explanation_for_image(image, model_fn, explainer, preprocess)
-        rmap = reprojection_map(expl, J)[: hw[0], : hw[1]]
-        masks.append(top_percentage_mask(rmap, p))
-    return mean_pairwise_iou(masks)
+    (`get_iou_between_wavelets`, notebook cell 5)."""
+    maps = cross_wavelet_reprojection_maps(
+        image, make_explainer, wavelets, model_fn, preprocess, J
+    )
+    return iou_from_reprojection_maps(maps, p)

@@ -83,12 +83,14 @@ def load_3d_model(checkpoint_path: str | None, num_classes: int, feature_transfo
     return model, variables, lambda x: model.apply(variables, x)[0]
 
 
-def load_3dvoxel_model(checkpoint_path: str | None, num_classes: int = 10):
-    """Voxel CNN (`src/helpers.py:100-114`)."""
+def load_3dvoxel_model(checkpoint_path: str | None, num_classes: int = 10,
+                       size: int = 16):
+    """Voxel CNN (`src/helpers.py:100-114`). The flatten→Dense layer binds
+    the parameter shapes to ``size``³ inputs at init."""
     from wam_tpu.models.voxel import VoxelModel
 
     model = VoxelModel(num_classes=num_classes)
-    variables = _init(model, jnp.zeros((1, 1, 16, 16, 16)))
+    variables = _init(model, jnp.zeros((1, 1, size, size, size)))
     if checkpoint_path:
         variables = load_variables(checkpoint_path, variables)
     return model, variables, lambda x: model.apply(variables, x)

@@ -41,6 +41,13 @@ class _BaseEvalBaselines:
     def __init__(self, model, variables, method: str, batch_size: int, random_seed: int,
                  n_samples: int, stdev_spread: float, cam_layer: str, nchw: bool,
                  methods: tuple[str, ...]):
+        if method == "srd":
+            raise NotImplementedError(
+                "'srd' is excluded by design: the reference imports it from a "
+                "`lib.srd` package that does not exist in the repository "
+                "(src/evaluators.py:33-34), so its semantics cannot be "
+                "reproduced faithfully. Use 'guided_backprop'/'lrp' instead."
+            )
         if method not in methods:
             raise ValueError(f"Unknown method {method!r}; expected one of {methods}")
         self.model = model

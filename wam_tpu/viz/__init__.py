@@ -2,6 +2,7 @@ from wam_tpu.viz.viewers import (
     add_lines,
     plot_diagonal,
     plot_wam,
+    plot_wavelet_regions,
     visualize_explanations_basic,
     visualize_gradients_at_levels,
     wavelet_region_lines,
@@ -20,6 +21,7 @@ __all__ = [
     "plot_wam",
     "add_lines",
     "wavelet_region_lines",
+    "plot_wavelet_regions",
     "plot_diagonal",
     "visualize_explanations_basic",
     "visualize_gradients_at_levels",

@@ -10,6 +10,7 @@ import numpy as np
 __all__ = [
     "plot_wam",
     "wavelet_region_lines",
+    "plot_wavelet_regions",
     "add_lines",
     "plot_diagonal",
     "visualize_explanations_basic",
@@ -27,6 +28,16 @@ def wavelet_region_lines(size: int, levels: int):
         mid = size // (2 ** (lev + 1))
         lines.append((((0, mid), (span, mid)), ((mid, span), (mid, 0))))
     return lines
+
+
+def plot_wavelet_regions(size: int, levels: int):
+    """Reference-shaped variant of `wavelet_region_lines`
+    (`src/viewers.py:39-63`): dicts `h[k]`, `v[k]` of (2, 2) endpoint arrays
+    per level, halving each level."""
+    lines = wavelet_region_lines(size, levels)
+    h = {i: np.array(hline) for i, (hline, _) in enumerate(lines)}
+    v = {i: np.array(vline) for i, (_, vline) in enumerate(lines)}
+    return h, v
 
 
 def add_lines(size: int, levels: int, ax) -> None:
