@@ -41,6 +41,25 @@ def test_bind_inference_nchw():
     assert out.shape == (2, 4)
 
 
+def test_bind_inference_compute_dtype_bf16():
+    model = resnet18(num_classes=4)
+    variables = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 32, 32, 3)))
+    x = jax.random.normal(jax.random.PRNGKey(1), (2, 3, 32, 32), jnp.float32)
+    ref = bind_inference(model, variables, nchw=True)(x)
+    out = bind_inference(model, variables, nchw=True, compute_dtype=jnp.bfloat16)(x)
+    assert out.dtype == jnp.float32
+    assert out.shape == ref.shape
+    # bf16 fwd tracks the f32 logits to bf16 resolution
+    scale = float(jnp.abs(ref).max())
+    assert float(jnp.abs(out - ref).max()) < 0.1 * max(scale, 1.0)
+    # gradients flow through the cast boundary
+    g = jax.grad(lambda xx: bind_inference(
+        model, variables, nchw=True, compute_dtype=jnp.bfloat16
+    )(xx).sum())(x)
+    assert g.dtype == jnp.float32
+    assert bool(jnp.isfinite(g).all())
+
+
 def test_torch_ingestion_logit_parity():
     """Random-init torch ResNet-18 → converted Flax weights must reproduce
     torch logits to float32 tolerance on random input."""

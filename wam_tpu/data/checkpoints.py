@@ -22,10 +22,14 @@ def _init(model, example):
 
 
 def build_vision_model(model_key: str = "resnet18", num_classes: int = 1000,
-                       checkpoint_path: str | None = None, image_size: int = 224):
+                       checkpoint_path: str | None = None, image_size: int = 224,
+                       compute_dtype: Any | None = None):
     """Build a vision model by key; optionally load a torchvision-style
     checkpoint. Returns (model, variables, model_fn) with model_fn taking
-    NCHW input like the reference tensors."""
+    NCHW input like the reference tensors.
+
+    compute_dtype=jnp.bfloat16 runs the forward/VJP at the MXU's native
+    precision (see wam_tpu.models.bind_inference)."""
     from wam_tpu.models import bind_inference, resnet18, resnet34, resnet50, resnet101
     from wam_tpu.models.ingest import torch_resnet_to_flax
 
@@ -68,7 +72,9 @@ def build_vision_model(model_key: str = "resnet18", num_classes: int = 1000,
                 )
         else:
             variables = load_variables(checkpoint_path, variables)
-    return model, variables, bind_inference(model, variables, nchw=True)
+    return model, variables, bind_inference(
+        model, variables, nchw=True, compute_dtype=compute_dtype
+    )
 
 
 def load_3d_model(checkpoint_path: str | None, num_classes: int, feature_transform: bool,
