@@ -1,0 +1,147 @@
+"""Benchmark matrix: all five BASELINE.json canonical workloads.
+
+`bench.py` reports the single north-star metric for the driver; this script
+times the full config matrix (SURVEY.md §7.1 step 8) on the current backend
+and prints one JSON line per config, optionally appending to a JSONL file:
+
+  1. wam_2D ResNet-50, single image, haar, J=3, base pass (no smoothing)
+  2. wam_2D ResNet-50, batch 32, db4, SmoothGrad n=25   (= bench.py)
+  3. wam_1D audio CNN (ESC-50 waveform length), db6, J=5, SmoothGrad n=50
+  4. wam_3D 3D-ResNet-18, 32^3 volumes, haar, J=2, SmoothGrad n=25
+  5. wam_2D ViT-B/16, Integrated Gradients, 64-step path
+
+Usage: python bench_matrix.py [--quick] [--f32] [--out results/matrix.jsonl]
+"""
+
+import argparse
+import json
+import time
+
+
+def _timed(run, *args, repeats=3):
+    import jax
+
+    jax.block_until_ready(run(*args))  # compile + warm
+    times = []
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        jax.block_until_ready(run(*args))
+        times.append(time.perf_counter() - t0)
+    return min(times)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true", help="tiny shapes, smoke only")
+    ap.add_argument("--f32", action="store_true", help="disable bf16 model compute")
+    ap.add_argument("--out", default=None, help="append JSONL records here")
+    args = ap.parse_args()
+
+    from wam_tpu.config import ensure_usable_backend
+
+    platform = ensure_usable_backend(timeout_s=180.0)
+
+    import jax
+    import jax.numpy as jnp
+
+    from wam_tpu import WaveletAttribution1D, WaveletAttribution2D, WaveletAttribution3D
+    from wam_tpu.models import bind_inference, resnet3d_18, resnet50
+    from wam_tpu.models.audio import AudioCNN, bind_audio_inference
+    from wam_tpu.models.vit import vit_b16
+    from wam_tpu.wam2d import BaseWAM2D
+
+    q = args.quick
+    on_accel = platform != "cpu"
+    dtype = None if args.f32 else jnp.bfloat16
+    records = []
+
+    def record(name, n_items, seconds, unit="items/s"):
+        rec = {
+            "metric": name,
+            "value": round(n_items / seconds, 3),
+            "unit": unit,
+            "seconds": round(seconds, 4),
+            "platform": platform,
+            "dtype": "float32" if args.f32 else "bfloat16",
+        }
+        records.append(rec)
+        print(json.dumps(rec), flush=True)
+
+    def vision_fn(ctor, image, num_classes=1000):
+        model = ctor(num_classes=num_classes)
+        variables = model.init(jax.random.PRNGKey(0), jnp.zeros((1, image, image, 3)))
+        return bind_inference(model, variables, nchw=True, compute_dtype=dtype)
+
+    # 1. base single-image pass ------------------------------------------------
+    image = 64 if q else 224
+    fn50 = vision_fn(resnet50, image)
+    base = BaseWAM2D(fn50, wavelet="haar", J=3, mode="reflect")
+    x1 = jax.random.normal(jax.random.PRNGKey(1), (1, 3, image, image), jnp.float32)
+    y1 = jnp.zeros((1,), jnp.int32)
+    record("wam2d_base_resnet50_single_haar_J3", 1, _timed(lambda: base(x1, y1)))
+
+    # 2. flagship SmoothGrad ---------------------------------------------------
+    batch, n = (4, 3) if q else (32, 25)
+    ex2 = WaveletAttribution2D(
+        fn50, wavelet="db4", J=3, method="smooth", n_samples=n,
+        sample_batch_size=n if on_accel else 1,
+    )
+    x2 = jax.random.normal(jax.random.PRNGKey(2), (batch, 3, image, image), jnp.float32)
+    y2 = jnp.arange(batch, dtype=jnp.int32) % 1000
+    record(f"wam2d_smoothgrad_resnet50_b{batch}_db4_n{n}", batch,
+           _timed(lambda: ex2(x2, y2)), "images/s")
+
+    # 3. audio SmoothGrad ------------------------------------------------------
+    # quick: shortest length whose melspec (hop 512, 129 frames) survives
+    # AudioCNN's six pooling stages + VALID conv; full: 5 s at 44.1 kHz (ESC-50)
+    wave_len = 65536 if q else 220500
+    ab, an = (2, 4) if q else (8, 50)
+    amodel = AudioCNN(num_classes=50)
+    mel_t = wave_len // 512 + 1
+    avars = amodel.init(jax.random.PRNGKey(0), jnp.zeros((1, 1, mel_t, 128)))
+    afn = bind_audio_inference(amodel, avars)
+    ex3 = WaveletAttribution1D(
+        afn, wavelet="db6", J=5, method="smooth", n_samples=an,
+        stdev_spread=0.001, sample_batch_size=an if on_accel else 1,
+    )
+    x3 = jax.random.normal(jax.random.PRNGKey(3), (ab, wave_len), jnp.float32)
+    y3 = jnp.arange(ab, dtype=jnp.int32) % 50
+    record(f"wam1d_smoothgrad_audiocnn_b{ab}_db6_J5_n{an}", ab,
+           _timed(lambda: ex3(x3, y3)), "waveforms/s")
+
+    # 4. 3D SmoothGrad ---------------------------------------------------------
+    size = 16 if q else 32
+    vb, vn = (2, 3) if q else (8, 25)
+    vmodel = resnet3d_18(num_classes=10)
+    vvars = vmodel.init(jax.random.PRNGKey(0), jnp.zeros((1, 1, size, size, size)))
+    vfn = lambda v: vmodel.apply(vvars, v)
+    ex4 = WaveletAttribution3D(
+        vfn, wavelet="haar", J=2, method="smooth", n_samples=vn,
+        sample_batch_size=vn if on_accel else 1,
+    )
+    x4 = jax.random.normal(jax.random.PRNGKey(4), (vb, 1, size, size, size), jnp.float32)
+    y4 = jnp.arange(vb, dtype=jnp.int32) % 10
+    record(f"wam3d_smoothgrad_resnet3d18_b{vb}_{size}cube_haar_J2_n{vn}", vb,
+           _timed(lambda: ex4(x4, y4)), "volumes/s")
+
+    # 5. ViT IG path -----------------------------------------------------------
+    steps = 4 if q else 64
+    vitfn = vision_fn(vit_b16, image)
+    ex5 = WaveletAttribution2D(
+        vitfn, wavelet="haar", J=3, method="integratedgrad", n_samples=steps,
+        sample_batch_size=(8 if on_accel else 1) if not q else steps,
+    )
+    x5 = jax.random.normal(jax.random.PRNGKey(5), (1, 3, image, image), jnp.float32)
+    y5 = jnp.zeros((1,), jnp.int32)
+    record(f"wam2d_ig_vitb16_path{steps}", 1, _timed(lambda: ex5(x5, y5)))
+
+    if args.out:
+        from wam_tpu.results import JsonlWriter
+
+        writer = JsonlWriter(args.out)
+        for rec in records:
+            writer.write(rec)
+
+
+if __name__ == "__main__":
+    main()

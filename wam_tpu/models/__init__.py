@@ -7,6 +7,7 @@ from wam_tpu.models.resnet import (
     resnet101,
 )
 from wam_tpu.models.ingest import strip_module_prefix, torch_resnet_to_flax
+from wam_tpu.models.resnet3d import ResNet3D, resnet3d_10, resnet3d_18
 
 __all__ = [
     "ResNet",
@@ -14,6 +15,9 @@ __all__ = [
     "resnet34",
     "resnet50",
     "resnet101",
+    "ResNet3D",
+    "resnet3d_10",
+    "resnet3d_18",
     "bind_inference",
     "strip_module_prefix",
     "torch_resnet_to_flax",

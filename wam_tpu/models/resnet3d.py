@@ -1,0 +1,77 @@
+"""Flax 3D ResNet — volumetric classifier for the BASELINE.json
+"wam_3D: 3D-ResNet on MRI/ShapeNet volumes" benchmark config. The reference
+model zoo has no 3D ResNet (its volume model is the two-stage `VoxelModel`,
+`src/network_architectures.py:190-215`); this fills the canonical-workload
+gap with the same structure as `wam_tpu.models.resnet` lifted to 3D convs.
+
+Input layout: (B, 1, D, H, W) like the reference volume tensors; NDHWC
+internally for the TPU conv path.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+__all__ = ["ResNet3D", "resnet3d_10", "resnet3d_18"]
+
+ModuleDef = Any
+
+
+class BasicBlock3D(nn.Module):
+    features: int
+    strides: int = 1
+    norm: ModuleDef = nn.BatchNorm
+    act: Callable = nn.relu
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        s = (self.strides,) * 3
+        y = nn.Conv(self.features, (3, 3, 3), s, padding=1, use_bias=False,
+                    name="conv1")(x)
+        y = self.norm(name="bn1")(y)
+        y = self.act(y)
+        y = nn.Conv(self.features, (3, 3, 3), padding=1, use_bias=False,
+                    name="conv2")(y)
+        y = self.norm(name="bn2")(y)
+        if residual.shape != y.shape:
+            residual = nn.Conv(self.features, (1, 1, 1), s, use_bias=False,
+                               name="downsample_conv")(x)
+            residual = self.norm(name="downsample_bn")(residual)
+        return self.act(y + residual)
+
+
+class ResNet3D(nn.Module):
+    stage_sizes: Sequence[int]
+    num_classes: int = 10
+    width: int = 16
+    act: Callable = nn.relu
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        """x: (B, 1, D, H, W). Returns logits (B, num_classes)."""
+        norm = partial(nn.BatchNorm, use_running_average=not train,
+                       momentum=0.9, epsilon=1e-5)
+        x = jnp.transpose(x, (0, 2, 3, 4, 1))  # NDHWC
+        x = nn.Conv(self.width, (3, 3, 3), padding=1, use_bias=False,
+                    name="conv1")(x)
+        x = norm(name="bn1")(x)
+        x = self.act(x)
+        for stage, n_blocks in enumerate(self.stage_sizes):
+            for i in range(n_blocks):
+                strides = 2 if stage > 0 and i == 0 else 1
+                x = BasicBlock3D(self.width * 2**stage, strides=strides,
+                                 norm=norm, act=self.act,
+                                 name=f"layer{stage + 1}_{i}")(x)
+            self.sow("intermediates", f"stage{stage + 1}", x)
+            x = self.perturb(f"stage{stage + 1}", x)
+        x = x.mean(axis=(1, 2, 3))
+        return nn.Dense(self.num_classes, name="fc")(x)
+
+
+resnet3d_10 = partial(ResNet3D, stage_sizes=(1, 1, 1, 1))
+resnet3d_18 = partial(ResNet3D, stage_sizes=(2, 2, 2, 2))

@@ -181,6 +181,12 @@ class WaveletAttribution1D(BaseWAM1D):
         self.stdev_spread = stdev_spread
         self.random_seed = random_seed
         self.sample_batch_size = sample_batch_size
+        # jit once per instance so repeated calls reuse the compiled graph.
+        # Estimator config (n_samples, stdev_spread, ...) is frozen at first
+        # trace; build a new instance to change it (constructor-kwargs config
+        # surface, SURVEY.md §5.6).
+        self._jit_smooth = jax.jit(self._smooth_impl)
+        self._jit_ig = jax.jit(self._ig_impl)
 
     def _tap_grads(self, x, y):
         """(mel grads, coeff grads) for one (possibly perturbed) batch."""
@@ -201,50 +207,46 @@ class WaveletAttribution1D(BaseWAM1D):
         )
         return g_mel[:, 0, :, :], g_coeffs
 
+    def _smooth_impl(self, x, y, key):
+        return smoothgrad(
+            lambda noisy: self._tap_grads(noisy, y),
+            x,
+            key,
+            n_samples=self.n_samples,
+            stdev_spread=self.stdev_spread,
+            batch_size=self.sample_batch_size,
+        )
+
     def smooth_wam(self, x, y):
         x = normalize_waveforms(x)
         y = jnp.asarray(y)
         key = jax.random.PRNGKey(self.random_seed)
-
-        @jax.jit
-        def run(x, key):
-            return smoothgrad(
-                lambda noisy: self._tap_grads(noisy, y),
-                x,
-                key,
-                n_samples=self.n_samples,
-                stdev_spread=self.stdev_spread,
-                batch_size=self.sample_batch_size,
-            )
-
-        mel_avg, grad_avg = run(x, key)
+        mel_avg, grad_avg = self._jit_smooth(x, y, key)
         self.melspecs = mel_avg
         self.grad_coeffs = grad_avg
         return mel_avg, grad_avg
+
+    def _ig_impl(self, x, y):
+        coeffs = self.engine.decompose(x)
+        baseline_mel = self.compute_melspec(x)[:, 0]
+        alphas = jnp.linspace(0.0, 1.0, self.n_samples, dtype=x.dtype)
+
+        def one(alpha):
+            scaled = jax.tree_util.tree_map(lambda c: c * alpha, coeffs)
+            return self._tap_grads_from_coeffs(scaled, y, x.shape[-1])
+
+        path = jax.lax.map(one, alphas, batch_size=self.sample_batch_size)
+        integ = jax.tree_util.tree_map(trapezoid, path)
+        mel_attr = baseline_mel * integ[0]
+        coeff_attr = [c * g for c, g in zip(coeffs, integ[1])]
+        return mel_attr, coeff_attr
 
     def integrated_wam(self, x, y):
         """Path integral per tap, each multiplied by its baseline: melspec ×
         ∫ mel-grads, coeffs × ∫ coeff-grads (`lib/wam_1D.py:353-421`)."""
         x = normalize_waveforms(x)
         y = jnp.asarray(y)
-
-        @jax.jit
-        def run(x):
-            coeffs = self.engine.decompose(x)
-            baseline_mel = self.compute_melspec(x)[:, 0]
-            alphas = jnp.linspace(0.0, 1.0, self.n_samples, dtype=x.dtype)
-
-            def one(alpha):
-                scaled = jax.tree_util.tree_map(lambda c: c * alpha, coeffs)
-                return self._tap_grads_from_coeffs(scaled, y, x.shape[-1])
-
-            path = jax.lax.map(one, alphas, batch_size=self.sample_batch_size)
-            integ = jax.tree_util.tree_map(trapezoid, path)
-            mel_attr = baseline_mel * integ[0]
-            coeff_attr = [c * g for c, g in zip(coeffs, integ[1])]
-            return mel_attr, coeff_attr
-
-        mel_attr, coeff_attr = run(x)
+        mel_attr, coeff_attr = self._jit_ig(x, y)
         self.melspecs = mel_attr
         self.grad_coeffs = coeff_attr
         return mel_attr, coeff_attr

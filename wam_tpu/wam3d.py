@@ -19,6 +19,7 @@ Design deltas from the reference (intended-behavior fixes, SURVEY.md §2.11):
 
 from __future__ import annotations
 
+import functools
 from typing import Callable
 
 import jax
@@ -216,6 +217,12 @@ class WaveletAttribution3D(BaseWAM3D):
         self.stdev_spread = stdev_spread
         self.random_seed = random_seed
         self.sample_batch_size = sample_batch_size
+        # Per-instance jit caches (estimator config is frozen at first trace;
+        # build a new instance to change n_samples etc., as in the reference's
+        # constructor-kwargs config surface, SURVEY.md §5.6). Instance-attribute
+        # caches die with the instance — no process-global registry.
+        self._jit_smooth = functools.cache(self._build_smooth)
+        self._jit_ig = functools.cache(self._build_ig)
 
     def _cube_step(self, vol, y):
         coeffs = self.engine.decompose(vol)
@@ -227,28 +234,55 @@ class WaveletAttribution3D(BaseWAM3D):
 
         return cube3d(jax.grad(loss)(coeffs))
 
+    def _smooth_impl(self, vol, y, key):
+        return smoothgrad(
+            lambda noisy: self._cube_step(noisy, y),
+            vol,
+            key,
+            n_samples=self.n_samples,
+            stdev_spread=self.stdev_spread,
+            batch_size=self.sample_batch_size,
+        )
+
+    def _build_smooth(self, has_label: bool):
+        if has_label:
+            return jax.jit(self._smooth_impl)
+        return jax.jit(lambda vol, key: self._smooth_impl(vol, None, key))
+
     def smooth(self, x, y=None):
         """Mean gradient cube over noisy samples — divide-once semantics
         (fixes `lib/wam_3D.py:585-587`)."""
         x = jnp.asarray(x)
         self.input_size = x.shape[-1]
         vol = x[:, 0]
-        y = None if y is None else jnp.asarray(y)
         key = jax.random.PRNGKey(self.random_seed)
-
-        @jax.jit
-        def run(v, key):
-            return smoothgrad(
-                lambda noisy: self._cube_step(noisy, y),
-                v,
-                key,
-                n_samples=self.n_samples,
-                stdev_spread=self.stdev_spread,
-                batch_size=self.sample_batch_size,
-            )
-
-        self.grads = run(vol, key)
+        if y is None:
+            self.grads = self._jit_smooth(False)(vol, key)
+        else:
+            self.grads = self._jit_smooth(True)(vol, jnp.asarray(y), key)
         return self.grads
+
+    def _ig_impl(self, v, y):
+        coeffs = self.engine.decompose(v)
+        baseline = cube3d(coeffs)
+        alphas = jnp.linspace(0.0, 1.0, self.n_samples, dtype=v.dtype)
+
+        def one(alpha):
+            scaled = jax.tree_util.tree_map(lambda c: c * alpha, coeffs)
+
+            def loss(cs):
+                rec = self.engine.reconstruct(cs, v.shape[-3:])
+                return target_loss(self.model_fn(rec[:, None]), y)
+
+            return cube3d(jax.grad(loss)(scaled))
+
+        path = jax.lax.map(one, alphas, batch_size=self.sample_batch_size)
+        return baseline * trapezoid(path)
+
+    def _build_ig(self, has_label: bool):
+        if has_label:
+            return jax.jit(self._ig_impl)
+        return jax.jit(lambda vol: self._ig_impl(vol, None))
 
     def integrated_wam(self, x, y=None):
         """baseline cube × trapezoidal path integral of gradient cubes
@@ -256,27 +290,10 @@ class WaveletAttribution3D(BaseWAM3D):
         x = jnp.asarray(x)
         self.input_size = x.shape[-1]
         vol = x[:, 0]
-        y = None if y is None else jnp.asarray(y)
-
-        @jax.jit
-        def run(v):
-            coeffs = self.engine.decompose(v)
-            baseline = cube3d(coeffs)
-            alphas = jnp.linspace(0.0, 1.0, self.n_samples, dtype=v.dtype)
-
-            def one(alpha):
-                scaled = jax.tree_util.tree_map(lambda c: c * alpha, coeffs)
-
-                def loss(cs):
-                    rec = self.engine.reconstruct(cs, v.shape[-3:])
-                    return target_loss(self.model_fn(rec[:, None]), y)
-
-                return cube3d(jax.grad(loss)(scaled))
-
-            path = jax.lax.map(one, alphas, batch_size=self.sample_batch_size)
-            return baseline * trapezoid(path)
-
-        self.grads = run(vol)
+        if y is None:
+            self.grads = self._jit_ig(False)(vol)
+        else:
+            self.grads = self._jit_ig(True)(vol, jnp.asarray(y))
         return self.grads
 
     intergrated_wam = integrated_wam  # reference spelling (lib/wam_3D.py:614)
