@@ -72,14 +72,10 @@ def tpu_throughput() -> float:
             step, x, key, n_samples=n_samples, stdev_spread=0.25, batch_size=chunk
         )
 
+    from wam_tpu.profiling import bench_time
+
     key = jax.random.PRNGKey(42)
-    jax.block_until_ready(run(x, key))  # compile + warm
-    times = []
-    for _ in range(2 if QUICK else 3):
-        t0 = time.perf_counter()
-        jax.block_until_ready(run(x, key))
-        times.append(time.perf_counter() - t0)
-    t = min(times)
+    t = bench_time(run, x, key, repeats=2 if QUICK else 3)
     return batch / t
 
 

@@ -15,19 +15,12 @@ Usage: python bench_matrix.py [--quick] [--f32] [--out results/matrix.jsonl]
 
 import argparse
 import json
-import time
 
 
 def _timed(run, *args, repeats=3):
-    import jax
+    from wam_tpu.profiling import bench_time
 
-    jax.block_until_ready(run(*args))  # compile + warm
-    times = []
-    for _ in range(repeats):
-        t0 = time.perf_counter()
-        jax.block_until_ready(run(*args))
-        times.append(time.perf_counter() - t0)
-    return min(times)
+    return bench_time(run, *args, repeats=repeats)
 
 
 def main():
@@ -53,7 +46,12 @@ def main():
     q = args.quick
     on_accel = platform != "cpu"
     dtype = None if args.f32 else jnp.bfloat16
-    records = []
+
+    writer = None
+    if args.out:
+        from wam_tpu.results import JsonlWriter
+
+        writer = JsonlWriter(args.out)
 
     def record(name, n_items, seconds, unit="items/s"):
         rec = {
@@ -64,8 +62,10 @@ def main():
             "platform": platform,
             "dtype": "float32" if args.f32 else "bfloat16",
         }
-        records.append(rec)
         print(json.dumps(rec), flush=True)
+        if writer is not None:
+            # written per row so an interrupted sweep keeps finished results
+            writer.write(rec)
 
     def vision_fn(ctor, image, num_classes=1000):
         model = ctor(num_classes=num_classes)
@@ -134,13 +134,6 @@ def main():
     x5 = jax.random.normal(jax.random.PRNGKey(5), (1, 3, image, image), jnp.float32)
     y5 = jnp.zeros((1,), jnp.int32)
     record(f"wam2d_ig_vitb16_path{steps}", 1, _timed(lambda: ex5(x5, y5)))
-
-    if args.out:
-        from wam_tpu.results import JsonlWriter
-
-        writer = JsonlWriter(args.out)
-        for rec in records:
-            writer.write(rec)
 
 
 if __name__ == "__main__":

@@ -83,3 +83,42 @@ def test_sharded_ig_matches_reference():
 
     expected = integrated_path(grad_fn, eng.decompose(x), n_steps=8)
     np.testing.assert_allclose(np.asarray(out), np.asarray(expected), atol=1e-5)
+
+
+def test_init_distributed_single_process():
+    from wam_tpu.parallel import init_distributed
+
+    info = init_distributed()
+    assert info["process_count"] == 1
+    assert info["global_devices"] == len(jax.devices())
+
+
+def test_hybrid_mesh_single_process_equals_make_mesh():
+    _need_devices(8)
+    from wam_tpu.parallel import hybrid_mesh
+
+    mesh = hybrid_mesh({"data": 4, "sample": 2})
+    assert mesh.shape == {"data": 4, "sample": 2}
+    inferred = hybrid_mesh({"data": -1, "sample": 2})
+    assert inferred.shape == {"data": 4, "sample": 2}
+
+
+def test_hybrid_mesh_runs_sharded_smoothgrad():
+    _need_devices(8)
+    from wam_tpu.parallel import hybrid_mesh
+
+    mesh = hybrid_mesh({"data": 2, "sample": 4})
+    x = jax.random.normal(jax.random.PRNGKey(0), (4, 3, 16, 16))
+    runner = sharded_smoothgrad(
+        lambda noisy: noisy.mean(axis=(1, 2, 3)), mesh, n_samples=8, stdev_spread=0.1
+    )
+    out = runner(x, jax.random.PRNGKey(1))
+    assert out.shape == (4,)
+    assert bool(jnp.isfinite(out).all())
+
+
+def test_process_local_batch_single_process():
+    from wam_tpu.parallel import process_local_batch
+
+    # one process owns the whole batch
+    assert process_local_batch(32) == 32

@@ -1,4 +1,5 @@
 from wam_tpu.parallel.mesh import P, data_sample_mesh, make_mesh
+from wam_tpu.parallel.multihost import hybrid_mesh, init_distributed, process_local_batch
 from wam_tpu.parallel.sharded import sharded_integrated_path, sharded_smoothgrad
 
 __all__ = [
@@ -7,4 +8,7 @@ __all__ = [
     "P",
     "sharded_smoothgrad",
     "sharded_integrated_path",
+    "init_distributed",
+    "hybrid_mesh",
+    "process_local_batch",
 ]

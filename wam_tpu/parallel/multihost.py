@@ -1,0 +1,123 @@
+"""Multi-host execution: jax.distributed bootstrap + DCN-aware hybrid meshes.
+
+The reference has no distributed backend at all (SURVEY.md §2.10/§5.8); the
+TPU-native counterpart runs one Python process per host, connects them with
+`jax.distributed.initialize`, and lays out a hybrid mesh whose outer axis
+maps to DCN (slice-to-slice network) and inner axes to ICI — so the
+bandwidth-hungry collectives (the SmoothGrad sample psum, mosaic all_gather)
+stay on ICI within each slice, and only the small data-parallel reductions
+cross DCN.
+
+Single-process usage is unchanged: every helper degrades to the local
+device mesh when there is one process.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+__all__ = ["init_distributed", "hybrid_mesh", "process_local_batch"]
+
+
+def init_distributed(
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+) -> dict:
+    """Connect this process to the multi-host runtime.
+
+    On TPU pods the arguments are discovered from the environment, so a bare
+    ``init_distributed()`` works under standard launchers; explicit arguments
+    support manual bring-up. Safe to call in a single process with no
+    cluster environment (no-op). Returns {"process_index", "process_count",
+    "local_devices", "global_devices"}.
+    """
+    import os
+
+    if coordinator_address is not None or num_processes not in (None, 1):
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+    elif any(
+        k in os.environ
+        for k in (
+            "JAX_COORDINATOR_ADDRESS",
+            "COORDINATOR_ADDRESS",
+            "MEGASCALE_COORDINATOR_ADDRESS",
+            "TPU_WORKER_HOSTNAMES",
+        )
+    ):
+        # Cluster launcher detected: let jax auto-discover everything. A bare
+        # initialize() in a genuinely single-process run would hang waiting
+        # for peers, hence the env gate above.
+        jax.distributed.initialize()
+    return {
+        "process_index": jax.process_index(),
+        "process_count": jax.process_count(),
+        "local_devices": jax.local_device_count(),
+        "global_devices": jax.device_count(),
+    }
+
+
+def hybrid_mesh(
+    axis_sizes: dict[str, int],
+    dcn_axis: str | None = None,
+    devices=None,
+) -> Mesh:
+    """Mesh over ALL processes' devices with one axis mapped to DCN.
+
+    ``dcn_axis`` (default: the first axis) is laid out across process
+    granules so that every other axis stays within a slice (ICI). With one
+    process this is exactly ``make_mesh``. Use -1 for one axis size to infer
+    it from the global device count.
+    """
+    from wam_tpu.parallel.mesh import make_mesh
+
+    devices = jax.devices() if devices is None else list(devices)
+    n_proc = jax.process_count()
+    sizes = dict(axis_sizes)
+    unknown = [k for k, v in sizes.items() if v == -1]
+    if unknown:
+        known = math.prod(v for v in sizes.values() if v != -1)
+        if len(unknown) > 1 or len(devices) % known:
+            raise ValueError(f"cannot infer {unknown} from {len(devices)} devices")
+        sizes[unknown[0]] = len(devices) // known
+    if n_proc == 1:
+        return make_mesh(sizes, devices)
+
+    dcn_axis = dcn_axis or next(iter(sizes))
+    if sizes[dcn_axis] % n_proc:
+        raise ValueError(
+            f"DCN axis {dcn_axis!r}={sizes[dcn_axis]} not divisible by "
+            f"{n_proc} processes"
+        )
+    # Topology-aware assignment: per-slice (ICI) shape × per-axis DCN
+    # multiplier. Only dcn_axis spans slice boundaries.
+    from jax.experimental import mesh_utils
+
+    axis_names = tuple(sizes)
+    ici_shape = [sizes[a] // n_proc if a == dcn_axis else sizes[a] for a in axis_names]
+    dcn_shape = [n_proc if a == dcn_axis else 1 for a in axis_names]
+    # process_is_granule matches the n_proc-based shapes above on topologies
+    # where one slice hosts several processes (the default slice granule
+    # would require slices == product(dcn_shape)).
+    arr = mesh_utils.create_hybrid_device_mesh(
+        ici_shape, dcn_shape, devices=np.asarray(devices), process_is_granule=True
+    )
+    return Mesh(arr, axis_names)
+
+
+def process_local_batch(global_batch: int) -> int:
+    """Per-process batch size for a data-parallel input pipeline: each host
+    feeds only its shard (jax.make_array_from_process_local_data assembles
+    the global array)."""
+    n = jax.process_count()
+    if global_batch % n:
+        raise ValueError(f"global batch {global_batch} not divisible by {n} processes")
+    return global_batch // n

@@ -11,7 +11,32 @@ from collections import defaultdict
 
 import jax
 
-__all__ = ["trace", "StageTimer", "start_server", "profile_to"]
+__all__ = ["trace", "StageTimer", "start_server", "profile_to", "device_sync", "bench_time"]
+
+
+def device_sync(out) -> None:
+    """Force completion AND a host round-trip of a reduced scalar per leaf.
+
+    On tunneled/remote TPU platforms `block_until_ready` alone occasionally
+    returns before remote execution finishes, producing bogus ~0s timings;
+    fetching a reduced scalar cannot complete early. Use this (not
+    block_until_ready) to close a timed region in benchmarks.
+    """
+    import jax.numpy as jnp
+
+    jax.device_get(jax.tree_util.tree_map(lambda a: jnp.sum(a), out))
+
+
+def bench_time(fn, *args, repeats: int = 3) -> float:
+    """Min wall-clock seconds of `fn(*args)` over ``repeats`` timed runs,
+    after one untimed compile/warm-up run. Uses `device_sync` throughout."""
+    device_sync(fn(*args))
+    times = []
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        device_sync(fn(*args))
+        times.append(time.perf_counter() - t0)
+    return min(times)
 
 
 @contextlib.contextmanager
