@@ -44,19 +44,27 @@ def init_distributed(
             num_processes=num_processes,
             process_id=process_id,
         )
-    elif any(
-        k in os.environ
-        for k in (
-            "JAX_COORDINATOR_ADDRESS",
-            "COORDINATOR_ADDRESS",
-            "MEGASCALE_COORDINATOR_ADDRESS",
-            "TPU_WORKER_HOSTNAMES",
-        )
-    ):
-        # Cluster launcher detected: let jax auto-discover everything. A bare
+    else:
+        # Auto-init only when a launcher really indicates multiple hosts: a
+        # coordinator address, or a multi-entry worker list. (A bare
         # initialize() in a genuinely single-process run would hang waiting
-        # for peers, hence the env gate above.
-        jax.distributed.initialize()
+        # for peers; single-host TPU VMs also set TPU_WORKER_HOSTNAMES.)
+        multi_host = any(
+            os.environ.get(k)
+            for k in (
+                "JAX_COORDINATOR_ADDRESS",
+                "COORDINATOR_ADDRESS",
+                "MEGASCALE_COORDINATOR_ADDRESS",
+            )
+        ) or ("," in os.environ.get("TPU_WORKER_HOSTNAMES", ""))
+        if multi_host:
+            try:
+                jax.distributed.initialize()
+            except RuntimeError:
+                # already initialized, or the backend is already up (e.g. a
+                # notebook that touched jax.devices() first) — proceed with
+                # whatever process topology jax reports
+                pass
     return {
         "process_index": jax.process_index(),
         "process_count": jax.process_count(),
