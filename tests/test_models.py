@@ -93,3 +93,46 @@ def test_dataparallel_prefix_stripping():
     prefixed = {f"module.{k}": v for k, v in tmodel.state_dict().items()}
     variables = torch_resnet_to_flax(prefixed)
     assert "conv1" in variables["params"]
+
+
+def test_torch_vit_ingestion_logit_parity():
+    torch = pytest.importorskip("torch")
+    from tests.torch_ref_models import TorchTinyViT
+    from wam_tpu.models.ingest import torch_vit_to_flax
+    from wam_tpu.models.vit import ViT
+
+    torch.manual_seed(0)
+    tmodel = TorchTinyViT(num_classes=7, img=32, patch=8, dim=64, depth=2, heads=4, mlp=128).eval()
+    variables = jax.tree_util.tree_map(
+        jnp.asarray, torch_vit_to_flax(tmodel.state_dict(), num_heads=4)
+    )
+    model = ViT(num_classes=7, patch=8, dim=64, depth=2, heads=4, mlp_hidden=128)
+    x = np.random.default_rng(1).standard_normal((2, 3, 32, 32)).astype(np.float32)
+    with torch.no_grad():
+        t_out = tmodel(torch.from_numpy(x)).numpy()
+    f_out = model.apply(variables, jnp.transpose(jnp.asarray(x), (0, 2, 3, 1)))
+    np.testing.assert_allclose(np.asarray(f_out), t_out, atol=2e-4, rtol=2e-4)
+
+
+def test_torch_convnext_ingestion_logit_parity():
+    torch = pytest.importorskip("torch")
+    from tests.torch_ref_models import TorchTinyConvNeXt
+    from wam_tpu.models.convnext import ConvNeXt
+    from wam_tpu.models.ingest import torch_convnext_to_flax
+
+    torch.manual_seed(0)
+    tmodel = TorchTinyConvNeXt(num_classes=5, depths=(1, 1), dims=(16, 32)).eval()
+    # randomize layer scales so the gamma path is actually exercised
+    with torch.no_grad():
+        for m in tmodel.modules():
+            if hasattr(m, "layer_scale"):
+                m.layer_scale.uniform_(0.5, 1.5)
+    variables = jax.tree_util.tree_map(
+        jnp.asarray, torch_convnext_to_flax(tmodel.state_dict())
+    )
+    model = ConvNeXt(num_classes=5, depths=(1, 1), dims=(16, 32))
+    x = np.random.default_rng(2).standard_normal((2, 3, 32, 32)).astype(np.float32)
+    with torch.no_grad():
+        t_out = tmodel(torch.from_numpy(x)).numpy()
+    f_out = model.apply(variables, jnp.transpose(jnp.asarray(x), (0, 2, 3, 1)))
+    np.testing.assert_allclose(np.asarray(f_out), t_out, atol=2e-4, rtol=2e-4)

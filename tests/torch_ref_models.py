@@ -54,3 +54,103 @@ class TorchResNet18(nn.Module):
         x = self.layer4(self.layer3(self.layer2(self.layer1(x))))
         x = self.avgpool(x).flatten(1)
         return self.fc(x)
+
+
+class TorchTinyViT(nn.Module):
+    """timm-style naming (cls_token, pos_embed, patch_embed.proj, blocks.{i},
+    norm, head) — oracle for torch_vit_to_flax."""
+
+    def __init__(self, num_classes=10, img=32, patch=8, dim=64, depth=2, heads=4, mlp=128):
+        super().__init__()
+        self.heads = heads
+        n_patches = (img // patch) ** 2
+        self.cls_token = nn.Parameter(torch.zeros(1, 1, dim))
+        self.pos_embed = nn.Parameter(torch.randn(1, n_patches + 1, dim) * 0.02)
+        self.patch_embed = nn.Module()
+        self.patch_embed.proj = nn.Conv2d(3, dim, patch, patch)
+        self.blocks = nn.ModuleList()
+        for _ in range(depth):
+            blk = nn.Module()
+            blk.norm1 = nn.LayerNorm(dim, eps=1e-6)
+            blk.attn = nn.Module()
+            blk.attn.qkv = nn.Linear(dim, 3 * dim)
+            blk.attn.proj = nn.Linear(dim, dim)
+            blk.norm2 = nn.LayerNorm(dim, eps=1e-6)
+            blk.mlp = nn.Module()
+            blk.mlp.fc1 = nn.Linear(dim, mlp)
+            blk.mlp.fc2 = nn.Linear(mlp, dim)
+            self.blocks.append(blk)
+        self.norm = nn.LayerNorm(dim, eps=1e-6)
+        self.head = nn.Linear(dim, num_classes)
+
+    def forward(self, x):
+        B = x.shape[0]
+        x = self.patch_embed.proj(x).flatten(2).transpose(1, 2)  # (B, N, D)
+        x = torch.cat([self.cls_token.expand(B, -1, -1), x], dim=1) + self.pos_embed
+        for blk in self.blocks:
+            y = blk.norm1(x)
+            B_, N, D = y.shape
+            qkv = blk.attn.qkv(y).reshape(B_, N, 3, self.heads, D // self.heads)
+            q, k, v = qkv.permute(2, 0, 3, 1, 4)
+            att = (q @ k.transpose(-2, -1)) / (D // self.heads) ** 0.5
+            att = att.softmax(dim=-1)
+            y = (att @ v).transpose(1, 2).reshape(B_, N, D)
+            x = x + blk.attn.proj(y)
+            y = blk.norm2(x)
+            x = x + blk.mlp.fc2(torch.nn.functional.gelu(blk.mlp.fc1(y)))
+        x = self.norm(x)
+        return self.head(x[:, 0])
+
+
+class _TorchLayerNorm2d(nn.LayerNorm):
+    def forward(self, x):  # (B, C, H, W): normalize over C
+        x = x.permute(0, 2, 3, 1)
+        x = super().forward(x)
+        return x.permute(0, 3, 1, 2)
+
+
+class TorchTinyConvNeXt(nn.Module):
+    """torchvision-style naming (features.0 stem, features.{2s} downsample,
+    features.{2s+1}.{i}.block.{0,2,3,5} + layer_scale, classifier.{0,2}) —
+    oracle for torch_convnext_to_flax."""
+
+    def __init__(self, num_classes=10, depths=(1, 1), dims=(16, 32)):
+        super().__init__()
+        feats = []
+        feats.append(nn.Sequential(nn.Conv2d(3, dims[0], 4, 4), _TorchLayerNorm2d(dims[0], eps=1e-6)))
+        for s, (depth, dim) in enumerate(zip(depths, dims)):
+            if s > 0:
+                feats.append(nn.Sequential(
+                    _TorchLayerNorm2d(dims[s - 1], eps=1e-6), nn.Conv2d(dims[s - 1], dim, 2, 2)))
+            blocks = []
+            for _ in range(depth):
+                blocks.append(_TorchCNBlock(dim))
+            feats.append(nn.Sequential(*blocks))
+        self.features = nn.Sequential(*feats)
+        self.classifier = nn.Sequential(
+            nn.LayerNorm(dims[-1], eps=1e-6), nn.Flatten(1), nn.Linear(dims[-1], num_classes))
+
+    def forward(self, x):
+        x = self.features(x)
+        x = x.mean(dim=(2, 3))
+        return self.classifier(x)
+
+
+class _TorchCNBlock(nn.Module):
+    def __init__(self, dim):
+        super().__init__()
+        self.block = nn.Sequential(
+            nn.Conv2d(dim, dim, 7, padding=3, groups=dim),
+            nn.Identity(),  # index placeholder (torchvision uses Permute here)
+            nn.LayerNorm(dim, eps=1e-6),
+            nn.Linear(dim, 4 * dim),
+            nn.GELU(),
+            nn.Linear(4 * dim, dim),
+        )
+        self.layer_scale = nn.Parameter(torch.full((dim, 1, 1), 1e-6))
+
+    def forward(self, x):
+        y = self.block[0](x).permute(0, 2, 3, 1)
+        y = self.block[5](self.block[4](self.block[3](self.block[2](y))))
+        y = y.permute(0, 3, 1, 2)
+        return x + self.layer_scale * y

@@ -64,12 +64,20 @@ def build_vision_model(model_key: str = "resnet18", num_classes: int = 1000,
             state = torch.load(checkpoint_path, map_location="cpu", weights_only=True)
             if model_key.startswith("resnet"):
                 loaded = torch_resnet_to_flax(state)
-                loaded = jax.tree_util.tree_map(jnp.asarray, loaded)
-                variables = {**variables, **loaded}
+            elif model_key.startswith("vit"):
+                from wam_tpu.models.ingest import torch_vit_to_flax
+
+                loaded = torch_vit_to_flax(state, num_heads=model.heads)
+            elif model_key.startswith("convnext"):
+                from wam_tpu.models.ingest import torch_convnext_to_flax
+
+                loaded = torch_convnext_to_flax(state)
             else:
                 raise NotImplementedError(
                     f"torch checkpoint ingestion for {model_key} not wired yet"
                 )
+            loaded = jax.tree_util.tree_map(jnp.asarray, loaded)
+            variables = {**variables, **loaded}
         else:
             variables = load_variables(checkpoint_path, variables)
     return model, variables, bind_inference(

@@ -26,7 +26,8 @@ class ConvNeXtBlock(nn.Module):
     def __call__(self, x):
         y = nn.Conv(self.dim, (7, 7), padding=3, feature_group_count=self.dim, name="dwconv")(x)
         y = nn.LayerNorm(name="ln")(y)
-        y = nn.gelu(nn.Dense(4 * self.dim, name="pw1")(y))
+        # exact GELU for torchvision checkpoint parity
+        y = nn.gelu(nn.Dense(4 * self.dim, name="pw1")(y), approximate=False)
         y = nn.Dense(self.dim, name="pw2")(y)
         gamma = self.param("gamma", nn.initializers.constant(self.ls_init), (self.dim,))
         return x + gamma * y

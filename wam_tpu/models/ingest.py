@@ -20,7 +20,12 @@ from typing import Mapping
 
 import numpy as np
 
-__all__ = ["strip_module_prefix", "torch_resnet_to_flax"]
+__all__ = [
+    "strip_module_prefix",
+    "torch_resnet_to_flax",
+    "torch_vit_to_flax",
+    "torch_convnext_to_flax",
+]
 
 
 def strip_module_prefix(state: Mapping[str, np.ndarray]) -> dict[str, np.ndarray]:
@@ -39,6 +44,19 @@ def _conv(w) -> np.ndarray:
     return _np(w).transpose(2, 3, 1, 0)
 
 
+def _put(tree: dict, path: tuple[str, ...], value: np.ndarray) -> None:
+    node = tree
+    for p in path[:-1]:
+        node = node.setdefault(p, {})
+    node[path[-1]] = value
+
+
+def _ln(state: Mapping[str, np.ndarray], tree: dict, prefix: str, path: tuple[str, ...]) -> None:
+    """LayerNorm weight/bias -> flax scale/bias at `path`."""
+    _put(tree, path + ("scale",), _np(state[prefix + ".weight"]))
+    _put(tree, path + ("bias",), _np(state[prefix + ".bias"]))
+
+
 def torch_resnet_to_flax(state: Mapping[str, np.ndarray]) -> dict:
     """Convert a torchvision ResNet state dict to this package's
     {'params': ..., 'batch_stats': ...} tree."""
@@ -46,11 +64,7 @@ def torch_resnet_to_flax(state: Mapping[str, np.ndarray]) -> dict:
     params: dict = {}
     stats: dict = {}
 
-    def put(tree: dict, path: tuple[str, ...], value: np.ndarray):
-        node = tree
-        for p in path[:-1]:
-            node = node.setdefault(p, {})
-        node[path[-1]] = value
+    put = _put
 
     def take_bn(prefix: str, flax_name: tuple[str, ...]):
         put(params, flax_name + ("scale",), _np(state[prefix + ".weight"]))
@@ -77,3 +91,105 @@ def torch_resnet_to_flax(state: Mapping[str, np.ndarray]) -> dict:
     put(params, ("fc", "kernel"), _np(state["fc.weight"]).T)
     put(params, ("fc", "bias"), _np(state["fc.bias"]))
     return {"params": params, "batch_stats": stats}
+
+
+def torch_vit_to_flax(state: Mapping[str, np.ndarray], num_heads: int = 12) -> dict:
+    """Convert a timm-style ViT state dict (`vit_base_patch16_224` naming:
+    cls_token, pos_embed, patch_embed.proj, blocks.{i}.{norm1,attn.qkv,
+    attn.proj,norm2,mlp.fc1,mlp.fc2}, norm, head) to the `wam_tpu.models.vit`
+    variable tree. Fused qkv weights are split into flax's per-projection
+    (embed, heads, head_dim) kernels."""
+    state = strip_module_prefix(state)
+    params: dict = {}
+
+    def put(path, value):
+        _put(params, path, value)
+
+    def ln(prefix, path):
+        _ln(state, params, prefix, path)
+
+    put(("cls_token",), _np(state["cls_token"]))
+    put(("pos_embed",), _np(state["pos_embed"]))
+    put(("patch_embed", "kernel"), _conv(state["patch_embed.proj.weight"]))
+    put(("patch_embed", "bias"), _np(state["patch_embed.proj.bias"]))
+
+    depth = 1 + max(
+        int(k.split(".")[1]) for k in state if k.startswith("blocks.")
+    )
+    for i in range(depth):
+        p, b = f"blocks.{i}", f"block{i}"
+        ln(f"{p}.norm1", (b, "ln1"))
+        ln(f"{p}.norm2", (b, "ln2"))
+
+        qkv_w = _np(state[f"{p}.attn.qkv.weight"])  # (3*dim, dim)
+        qkv_b = _np(state[f"{p}.attn.qkv.bias"])
+        dim = qkv_w.shape[1]
+        head_dim = dim // num_heads
+        for j, proj in enumerate(("query", "key", "value")):
+            w = qkv_w[j * dim : (j + 1) * dim]  # (dim, dim), row-major out
+            put((b, "attn", proj, "kernel"), w.T.reshape(dim, num_heads, head_dim))
+            put((b, "attn", proj, "bias"),
+                qkv_b[j * dim : (j + 1) * dim].reshape(num_heads, head_dim))
+        ow = _np(state[f"{p}.attn.proj.weight"])  # (dim, dim)
+        put((b, "attn", "out", "kernel"), ow.T.reshape(num_heads, head_dim, dim))
+        put((b, "attn", "out", "bias"), _np(state[f"{p}.attn.proj.bias"]))
+
+        for t, f in (("mlp.fc1", "fc1"), ("mlp.fc2", "fc2")):
+            put((b, "mlp", f, "kernel"), _np(state[f"{p}.{t}.weight"]).T)
+            put((b, "mlp", f, "bias"), _np(state[f"{p}.{t}.bias"]))
+
+    ln("norm", ("ln",))
+    put(("head", "kernel"), _np(state["head.weight"]).T)
+    put(("head", "bias"), _np(state["head.bias"]))
+    return {"params": params}
+
+
+def torch_convnext_to_flax(state: Mapping[str, np.ndarray]) -> dict:
+    """Convert a torchvision ConvNeXt state dict (`convnext_tiny` naming —
+    the fork's IoU-experiment model, `compare_iou_models.ipynb` cell 3:
+    features.0 stem, features.{2s} downsample, features.{2s+1} blocks with
+    block.{0,2,3,5} + layer_scale, classifier.{0,2}) to the
+    `wam_tpu.models.convnext` variable tree."""
+    state = strip_module_prefix(state)
+    params: dict = {}
+
+    def put(path, value):
+        _put(params, path, value)
+
+    def ln(prefix, path):
+        _ln(state, params, prefix, path)
+
+    put(("stem_conv", "kernel"), _conv(state["features.0.0.weight"]))
+    put(("stem_conv", "bias"), _np(state["features.0.0.bias"]))
+    ln("features.0.1", ("stem_ln",))
+
+    n_stages = (
+        1 + max(int(k.split(".")[1]) for k in state if k.startswith("features."))
+    ) // 2
+    for s in range(n_stages):
+        if s > 0:
+            ln(f"features.{2 * s}.0", (f"down{s}_ln",))
+            put((f"down{s}_conv", "kernel"), _conv(state[f"features.{2 * s}.1.weight"]))
+            put((f"down{s}_conv", "bias"), _np(state[f"features.{2 * s}.1.bias"]))
+        stage_prefix = f"features.{2 * s + 1}"
+        depth = 1 + max(
+            int(k.split(".")[2]) for k in state if k.startswith(stage_prefix + ".")
+        )
+        for i in range(depth):
+            p, b = f"{stage_prefix}.{i}", f"stage{s}_block{i}"
+            # torchvision CNBlock: block.0 dwconv, block.2 LN, block.3 fc1,
+            # block.5 fc2, layer_scale (dim,1,1). Depthwise torch weights
+            # (dim, 1, kh, kw) transpose to flax grouped-conv (kh, kw, 1, dim).
+            put((b, "dwconv", "kernel"), _conv(state[f"{p}.block.0.weight"]))
+            put((b, "dwconv", "bias"), _np(state[f"{p}.block.0.bias"]))
+            ln(f"{p}.block.2", (b, "ln"))
+            put((b, "pw1", "kernel"), _np(state[f"{p}.block.3.weight"]).T)
+            put((b, "pw1", "bias"), _np(state[f"{p}.block.3.bias"]))
+            put((b, "pw2", "kernel"), _np(state[f"{p}.block.5.weight"]).T)
+            put((b, "pw2", "bias"), _np(state[f"{p}.block.5.bias"]))
+            put((b, "gamma"), _np(state[f"{p}.layer_scale"]).reshape(-1))
+
+    ln("classifier.0", ("head_ln",))
+    put(("head", "kernel"), _np(state["classifier.2.weight"]).T)
+    put(("head", "bias"), _np(state["classifier.2.bias"]))
+    return {"params": params}

@@ -22,7 +22,9 @@ class MlpBlock(nn.Module):
     @nn.compact
     def __call__(self, x):
         d = x.shape[-1]
-        x = nn.gelu(nn.Dense(self.hidden, name="fc1")(x))
+        # exact (erf) GELU — what timm/torchvision ViTs use; the tanh
+        # approximation breaks checkpoint logit parity
+        x = nn.gelu(nn.Dense(self.hidden, name="fc1")(x), approximate=False)
         return nn.Dense(d, name="fc2")(x)
 
 
