@@ -29,9 +29,10 @@ F32 = "--f32" in sys.argv
 
 
 def tpu_throughput() -> float:
-    from wam_tpu.config import ensure_usable_backend
+    from wam_tpu.config import enable_compilation_cache, ensure_usable_backend
 
     platform = ensure_usable_backend(timeout_s=180.0)
+    enable_compilation_cache()
     if platform == "cpu":
         print("# accelerator unavailable; benching on CPU", file=sys.stderr)
 

@@ -30,9 +30,10 @@ def main():
     ap.add_argument("--out", default=None, help="append JSONL records here")
     args = ap.parse_args()
 
-    from wam_tpu.config import ensure_usable_backend
+    from wam_tpu.config import enable_compilation_cache, ensure_usable_backend
 
     platform = ensure_usable_backend(timeout_s=180.0)
+    enable_compilation_cache()
 
     import jax
     import jax.numpy as jnp

@@ -15,7 +15,16 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
-__all__ = ["STN", "PointNetFeat", "PointNetCls", "PointNetDenseCls", "feature_transform_regularizer"]
+__all__ = [
+    "STN",
+    "STN3d",
+    "STNkd",
+    "PointNetFeat",
+    "PointNetfeat",
+    "PointNetCls",
+    "PointNetDenseCls",
+    "feature_transform_regularizer",
+]
 
 
 class STN(nn.Module):
@@ -110,3 +119,11 @@ def feature_transform_regularizer(trans: jax.Array) -> jax.Array:
     eye = jnp.eye(d, dtype=trans.dtype)
     diff = jnp.einsum("bij,bkj->bik", trans, trans) - eye
     return jnp.linalg.norm(diff, axis=(1, 2)).mean()
+
+
+# Reference-shaped aliases (`src/network_architectures.py:15-131`) with the
+# reference's defaults: STN3d is k=3, STNkd defaults to k=64
+# (`src/network_architectures.py:53-54`); PointNetfeat spells feat lowercase.
+STN3d = partial(STN, k=3)
+STNkd = partial(STN, k=64)
+PointNetfeat = PointNetFeat
