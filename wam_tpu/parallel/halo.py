@@ -25,21 +25,37 @@ from jax import shard_map
 from wam_tpu.wavelets.filters import build_wavelet
 from wam_tpu.wavelets.periodized import dwt_per
 
-__all__ = ["sharded_dwt_per", "sharded_wavedec_per"]
+__all__ = ["sharded_dwt_per", "sharded_wavedec_per", "sharded_wavedec2_per"]
 
 
 def _local_dwt_with_halo(x_local: jax.Array, wavelet: str, axis_name: str):
     """Per-shard kernel: fetch L−2 left-halo samples from the ring
-    predecessor, then run the strided correlation locally."""
+    predecessor chain, then run the strided correlation locally. When the
+    halo exceeds one shard's length (long filters at deep levels), blocks
+    from further predecessors are pulled with additional ppermute hops —
+    hop count is static, derived from shapes."""
     wav = build_wavelet(wavelet)
     L = wav.filt_len
     n_shards = lax.axis_size(axis_name)
     if L > 2:
-        tail = x_local[..., -(L - 2):]
-        # ring shift: shard i receives the tail of shard i-1 (circular)
-        halo = lax.ppermute(
-            tail, axis_name, perm=[(i, (i + 1) % n_shards) for i in range(n_shards)]
-        )
+        need = L - 2
+        local_len = x_local.shape[-1]
+        perm = [(i, (i + 1) % n_shards) for i in range(n_shards)]
+        if need <= local_len:
+            # common case: one hop carrying only the L−2-sample tail
+            halo = lax.ppermute(x_local[..., -need:], axis_name, perm=perm)
+        else:
+            # halo spans several shards (long filter, deep level): pull full
+            # predecessor blocks hop by hop — every block but the farthest is
+            # fully consumed, so full-block traffic is necessary here
+            hops = -(-need // local_len)  # ceil
+            blocks = []
+            prev = x_local
+            for _ in range(hops):
+                # after k hops `prev` holds shard i-k's block
+                prev = lax.ppermute(prev, axis_name, perm=perm)
+                blocks.insert(0, prev)
+            halo = jnp.concatenate(blocks, axis=-1)[..., -need:]
         ext = jnp.concatenate([halo, x_local], axis=-1)
     else:
         ext = x_local
@@ -99,3 +115,50 @@ def sharded_wavedec_per(mesh: Mesh, wavelet: str, level: int, seq_axis: str = "d
         return coeffs[::-1]
 
     return run
+
+
+def _local_dwt2_with_halo(x_local: jax.Array, wavelet: str, axis_name: str):
+    """Per-shard 2D kernel: W (last axis) is local so use the plain
+    periodized transform; H is sharded so its 1D transform exchanges a ring
+    halo. Assembly shared with the single-device transform via
+    `separable_dwt2`."""
+    from wam_tpu.wavelets.periodized import separable_dwt2
+
+    return separable_dwt2(
+        x_local,
+        dwt1_w=lambda t: dwt_per(t, wavelet),
+        dwt1_h=lambda t: _local_dwt_with_halo(t, wavelet, axis_name),
+    )
+
+
+def sharded_wavedec2_per(mesh: Mesh, wavelet: str, level: int, seq_axis: str = "data"):
+    """Multi-level 2D sharded decomposition for images/feature maps whose
+    row axis exceeds one core's memory: x (..., H, W) — any leading dims —
+    with H sharded over ``seq_axis``; every output leaf keeps that sharding.
+    Bit-compatible with `wam_tpu.wavelets.periodized.wavedec2_per`. Requires
+    H divisible by shards·2^level and W divisible by 2^level."""
+
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=P(None, seq_axis, None),
+        out_specs=P(None, seq_axis, None),
+    )
+    def run(x_local):
+        coeffs = []
+        a = x_local
+        for _ in range(level):
+            a, det = _local_dwt2_with_halo(a, wavelet, seq_axis)
+            coeffs.append(det)
+        coeffs.append(a)
+        return coeffs[::-1]
+
+    @jax.jit
+    def apply(x):
+        lead = x.shape[:-2]
+        out = run(x.reshape((-1,) + x.shape[-2:]))
+        return jax.tree_util.tree_map(
+            lambda a: a.reshape(lead + a.shape[1:]), out
+        )
+
+    return apply

@@ -19,7 +19,7 @@ from jax import lax
 
 from wam_tpu.wavelets.filters import Wavelet, build_wavelet
 
-__all__ = ["dwt_per", "idwt_per", "wavedec_per", "waverec_per"]
+__all__ = ["dwt_per", "idwt_per", "wavedec_per", "waverec_per", "separable_dwt2", "dwt2_per", "wavedec2_per"]
 
 
 def _resolve(wavelet) -> Wavelet:
@@ -85,3 +85,44 @@ def waverec_per(coeffs, wavelet):
     for d in coeffs[1:]:
         a = idwt_per(a, d, wavelet)
     return a
+
+
+def separable_dwt2(x: jax.Array, dwt1_w, dwt1_h):
+    """Single-level separable 2D DWT from two 1D transforms: ``dwt1_w`` along
+    the last axis (W), ``dwt1_h`` along the second-to-last (H, applied after
+    a swap). Returns (cA, Detail2D) with the subband naming of
+    `wam_tpu.wavelets.transform.dwt2` — shared by the single-device and the
+    halo-sharded 2D transforms so the assembly cannot drift."""
+    from wam_tpu.wavelets.transform import Detail2D
+
+    aW, dW = dwt1_w(x)
+
+    def along_h(t):
+        tt = jnp.swapaxes(t, -1, -2)
+        a, d = dwt1_h(tt)
+        return jnp.swapaxes(a, -1, -2), jnp.swapaxes(d, -1, -2)
+
+    aa, da = along_h(aW)
+    ad, dd = along_h(dW)
+    return aa, Detail2D(horizontal=da, vertical=ad, diagonal=dd)
+
+
+def dwt2_per(x: jax.Array, wavelet):
+    """Single-level separable periodized 2D DWT over the last two axes
+    (both even). Returns (cA, Detail2D) with the same subband naming as
+    `wam_tpu.wavelets.transform.dwt2`."""
+    wav = _resolve(wavelet)
+    one = lambda t: dwt_per(t, wav)
+    return separable_dwt2(x, one, one)
+
+
+def wavedec2_per(x: jax.Array, wavelet, level: int):
+    """Multi-level periodized 2D decomposition [cA_J, Detail2D_J, ...,
+    Detail2D_1]."""
+    coeffs = []
+    a = x
+    for _ in range(level):
+        a, det = dwt2_per(a, wavelet)
+        coeffs.append(det)
+    coeffs.append(a)
+    return coeffs[::-1]
