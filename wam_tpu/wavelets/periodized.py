@@ -19,7 +19,7 @@ from jax import lax
 
 from wam_tpu.wavelets.filters import Wavelet, build_wavelet
 
-__all__ = ["dwt_per", "idwt_per", "wavedec_per", "waverec_per", "separable_dwt2", "dwt2_per", "wavedec2_per"]
+__all__ = ["dwt_per", "idwt_per", "wavedec_per", "waverec_per", "separable_dwt2", "dwt2_per", "wavedec2_per", "idwt2_per", "waverec2_per"]
 
 
 def _resolve(wavelet) -> Wavelet:
@@ -126,3 +126,21 @@ def wavedec2_per(x: jax.Array, wavelet, level: int):
         coeffs.append(det)
     coeffs.append(a)
     return coeffs[::-1]
+
+
+def idwt2_per(cA: jax.Array, detail, wavelet) -> jax.Array:
+    """Exact inverse of `dwt2_per` via the adjoint (orthogonal transform)."""
+    wav = _resolve(wavelet)
+    H, W = 2 * cA.shape[-2], 2 * cA.shape[-1]
+    x_spec = jax.ShapeDtypeStruct(cA.shape[:-2] + (H, W), cA.dtype)
+    transpose = jax.linear_transpose(lambda v: dwt2_per(v, wav), x_spec)
+    (x,) = transpose((cA, detail))
+    return x
+
+
+def waverec2_per(coeffs, wavelet):
+    """Inverse of `wavedec2_per`."""
+    a = coeffs[0]
+    for det in coeffs[1:]:
+        a = idwt2_per(a, det, wavelet)
+    return a
