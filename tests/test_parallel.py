@@ -171,3 +171,22 @@ def test_sharded_wavedec2_arbitrary_leading_dims():
     got2 = run(x2)
     want2 = wavedec2_per(x2, "db2", 1)
     np.testing.assert_allclose(np.asarray(got2[0]), np.asarray(want2[0]), atol=1e-5)
+
+
+def test_eval2d_sharded_inference_matches_single_device():
+    _need_devices(8)
+    from wam_tpu.evalsuite import Eval2DWAM
+
+    rng = np.random.default_rng(4)
+    W = jnp.asarray(rng.standard_normal((3 * 16 * 16, 5)).astype(np.float32) * 0.05)
+    model_fn = lambda x: x.reshape(x.shape[0], -1) @ W
+    explainer = lambda x, y: jnp.ones((x.shape[0], 16, 16))
+    x = jnp.asarray(rng.standard_normal((2, 3, 16, 16)).astype(np.float32))
+    y = np.array([1, 3])
+
+    single = Eval2DWAM(model_fn, explainer, wavelet="haar", J=2)
+    mesh = make_mesh({"data": 8})
+    sharded = Eval2DWAM(model_fn, explainer, wavelet="haar", J=2, mesh=mesh)
+    s_single = single.insertion(x, y, n_iter=16)
+    s_sharded = sharded.insertion(x, y, n_iter=16)
+    np.testing.assert_allclose(s_sharded, s_single, atol=1e-5)
