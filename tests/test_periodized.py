@@ -106,3 +106,34 @@ def test_dwt2_per_energy_preservation():
         + jnp.sum(det.diagonal**2)
     )
     assert abs(e_in - e_out) < 1e-4 * e_in
+
+
+def test_dwt2_per_directional_subband_mapping():
+    """A signal oscillating only along W must put its detail energy in the
+    'vertical' (a-along-H, d-along-W) subband — pins the letter-axis map."""
+    from wam_tpu.wavelets.periodized import dwt2_per
+
+    w = jnp.tile(jnp.array([1.0, -1.0] * 8), (16, 1))  # (H=16, W=16), varies in W only
+    cA, det = dwt2_per(w[None], "haar")
+    e = {k: float(jnp.sum(getattr(det, k) ** 2)) for k in ("horizontal", "vertical", "diagonal")}
+    assert e["vertical"] > 1.0
+    assert e["horizontal"] < 1e-8 and e["diagonal"] < 1e-8
+
+
+def test_dwt3_per_directional_subband_mapping():
+    """Oscillation only along W → all detail energy in 'aad' (a-D, a-H, d-W);
+    only along D → 'daa'. Pins D,H,W letter order against transform.dwt3."""
+    from wam_tpu.wavelets.periodized import dwt3_per
+
+    osc = jnp.array([1.0, -1.0] * 4)
+    vol_w = jnp.broadcast_to(osc, (8, 8, 8))  # varies along W only
+    _, det = dwt3_per(vol_w[None], "haar")
+    for k, v in det.items():
+        e = float(jnp.sum(v**2))
+        assert (e > 1.0) == (k == "aad"), (k, e)
+
+    vol_d = jnp.broadcast_to(osc[:, None, None], (8, 8, 8))  # varies along D only
+    _, det = dwt3_per(vol_d[None], "haar")
+    for k, v in det.items():
+        e = float(jnp.sum(v**2))
+        assert (e > 1.0) == (k == "daa"), (k, e)

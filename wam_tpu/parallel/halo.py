@@ -25,7 +25,7 @@ from jax import shard_map
 from wam_tpu.wavelets.filters import build_wavelet
 from wam_tpu.wavelets.periodized import dwt_per
 
-__all__ = ["sharded_dwt_per", "sharded_wavedec_per", "sharded_wavedec2_per"]
+__all__ = ["sharded_dwt_per", "sharded_wavedec_per", "sharded_wavedec2_per", "sharded_wavedec3_per"]
 
 
 def _local_dwt_with_halo(x_local: jax.Array, wavelet: str, axis_name: str):
@@ -160,5 +160,42 @@ def sharded_wavedec2_per(mesh: Mesh, wavelet: str, level: int, seq_axis: str = "
         return jax.tree_util.tree_map(
             lambda a: a.reshape(lead + a.shape[1:]), out
         )
+
+    return apply
+
+
+def sharded_wavedec3_per(mesh: Mesh, wavelet: str, level: int, seq_axis: str = "data"):
+    """Multi-level 3D sharded decomposition for volumes whose depth axis
+    exceeds one core's memory: x (..., D, H, W) — any leading dims — with D
+    sharded over ``seq_axis``. Bit-compatible with
+    `wam_tpu.wavelets.periodized.wavedec3_per`. Requires D divisible by
+    shards·2^level and H, W divisible by 2^level."""
+    from wam_tpu.wavelets.periodized import separable_dwt3
+
+    def level_fn(x_local):
+        one = lambda t: dwt_per(t, wavelet)
+        halo_d = lambda t: _local_dwt_with_halo(t, wavelet, seq_axis)
+        return separable_dwt3(x_local, one, one, halo_d)
+
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=P(None, seq_axis, None, None),
+        out_specs=P(None, seq_axis, None, None),
+    )
+    def run(x_local):
+        coeffs = []
+        a = x_local
+        for _ in range(level):
+            a, det = level_fn(a)
+            coeffs.append(det)
+        coeffs.append(a)
+        return coeffs[::-1]
+
+    @jax.jit
+    def apply(x):
+        lead = x.shape[:-3]
+        out = run(x.reshape((-1,) + x.shape[-3:]))
+        return jax.tree_util.tree_map(lambda a: a.reshape(lead + a.shape[1:]), out)
 
     return apply

@@ -19,7 +19,7 @@ from jax import lax
 
 from wam_tpu.wavelets.filters import Wavelet, build_wavelet
 
-__all__ = ["dwt_per", "idwt_per", "wavedec_per", "waverec_per", "separable_dwt2", "dwt2_per", "wavedec2_per", "idwt2_per", "waverec2_per"]
+__all__ = ["dwt_per", "idwt_per", "wavedec_per", "waverec_per", "separable_dwt2", "dwt2_per", "wavedec2_per", "idwt2_per", "waverec2_per", "separable_dwt3", "dwt3_per", "wavedec3_per", "idwt3_per", "waverec3_per"]
 
 
 def _resolve(wavelet) -> Wavelet:
@@ -92,18 +92,21 @@ def separable_dwt2(x: jax.Array, dwt1_w, dwt1_h):
     the last axis (W), ``dwt1_h`` along the second-to-last (H, applied after
     a swap). Returns (cA, Detail2D) with the subband naming of
     `wam_tpu.wavelets.transform.dwt2` — shared by the single-device and the
-    halo-sharded 2D transforms so the assembly cannot drift."""
-    from wam_tpu.wavelets.transform import Detail2D
+    halo-sharded 2D transforms so the assembly cannot drift.
 
-    aW, dW = dwt1_w(x)
+    The H transform runs FIRST, on the raw block: in the halo-sharded use
+    that axis carries the ring exchange, so this order issues one collective
+    per level instead of one per W-subband."""
+    from wam_tpu.wavelets.transform import Detail2D
 
     def along_h(t):
         tt = jnp.swapaxes(t, -1, -2)
         a, d = dwt1_h(tt)
         return jnp.swapaxes(a, -1, -2), jnp.swapaxes(d, -1, -2)
 
-    aa, da = along_h(aW)
-    ad, dd = along_h(dW)
+    aH, dH = along_h(x)
+    aa, ad = dwt1_w(aH)
+    da, dd = dwt1_w(dH)
     return aa, Detail2D(horizontal=da, vertical=ad, diagonal=dd)
 
 
@@ -143,4 +146,65 @@ def waverec2_per(coeffs, wavelet):
     a = coeffs[0]
     for det in coeffs[1:]:
         a = idwt2_per(a, det, wavelet)
+    return a
+
+
+def separable_dwt3(x: jax.Array, dwt1_w, dwt1_h, dwt1_d):
+    """Single-level separable 3D DWT over the last three axes (D, H, W) from
+    three 1D transforms (each applied along the last axis after a move).
+    Returns (cA, {key: arr}) with `wam_tpu.wavelets.transform.dwt3` naming:
+    key letters are (D, H, W) order — 'aad' = approx D, approx H, detail W."""
+
+    def along(t, axis, dwt1):
+        tt = jnp.moveaxis(t, axis, -1)
+        a, d = dwt1(tt)
+        return jnp.moveaxis(a, -1, axis), jnp.moveaxis(d, -1, axis)
+
+    # D (the halo-sharded axis in sharded use) runs FIRST, on the raw block:
+    # one collective per level instead of one per (H, W)-subband.
+    out = {}
+    aD, dD = along(x, -3, dwt1_d)
+    for d_letter, d_arr in (("a", aD), ("d", dD)):
+        aH, dH = along(d_arr, -2, dwt1_h)
+        for h_letter, h_arr in (("a", aH), ("d", dH)):
+            aW, dW = dwt1_w(h_arr)
+            out[d_letter + h_letter + "a"] = aW
+            out[d_letter + h_letter + "d"] = dW
+    return out.pop("aaa"), out
+
+
+def dwt3_per(x: jax.Array, wavelet):
+    """Single-level separable periodized 3D DWT (all three sizes even)."""
+    wav = _resolve(wavelet)
+    one = lambda t: dwt_per(t, wav)
+    return separable_dwt3(x, one, one, one)
+
+
+def wavedec3_per(x: jax.Array, wavelet, level: int):
+    """Multi-level periodized 3D decomposition [cA_J, {aad..ddd}_J, ...,
+    {aad..ddd}_1]."""
+    coeffs = []
+    a = x
+    for _ in range(level):
+        a, det = dwt3_per(a, wavelet)
+        coeffs.append(det)
+    coeffs.append(a)
+    return coeffs[::-1]
+
+
+def idwt3_per(cA: jax.Array, details: dict, wavelet) -> jax.Array:
+    """Exact inverse of `dwt3_per` via the adjoint."""
+    wav = _resolve(wavelet)
+    D, H, W = (2 * s for s in cA.shape[-3:])
+    x_spec = jax.ShapeDtypeStruct(cA.shape[:-3] + (D, H, W), cA.dtype)
+    transpose = jax.linear_transpose(lambda v: dwt3_per(v, wav), x_spec)
+    (x,) = transpose((cA, details))
+    return x
+
+
+def waverec3_per(coeffs, wavelet):
+    """Inverse of `wavedec3_per`."""
+    a = coeffs[0]
+    for det in coeffs[1:]:
+        a = idwt3_per(a, det, wavelet)
     return a
