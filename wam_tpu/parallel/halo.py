@@ -23,7 +23,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from jax import shard_map
 
 from wam_tpu.wavelets.filters import build_wavelet
-from wam_tpu.wavelets.periodized import dwt_per
+from wam_tpu.wavelets.periodized import dwt_per, separable_dwt2, separable_dwt3
 
 __all__ = ["sharded_dwt_per", "sharded_wavedec_per", "sharded_wavedec2_per", "sharded_wavedec3_per"]
 
@@ -117,72 +117,13 @@ def sharded_wavedec_per(mesh: Mesh, wavelet: str, level: int, seq_axis: str = "d
     return run
 
 
-def _local_dwt2_with_halo(x_local: jax.Array, wavelet: str, axis_name: str):
-    """Per-shard 2D kernel: W (last axis) is local so use the plain
-    periodized transform; H is sharded so its 1D transform exchanges a ring
-    halo. Assembly shared with the single-device transform via
-    `separable_dwt2`."""
-    from wam_tpu.wavelets.periodized import separable_dwt2
+def _sharded_wavedec_nd(mesh: Mesh, level: int, seq_axis: str, ndim: int, level_fn):
+    """Shared multi-level builder for the 2D/3D sharded decompositions:
+    shard_map over the sharded spatial axis (first of the trailing ``ndim``),
+    loop ``level_fn`` per level, flatten/restore arbitrary leading dims."""
+    spec = P(*((None, seq_axis) + (None,) * (ndim - 1)))
 
-    return separable_dwt2(
-        x_local,
-        dwt1_w=lambda t: dwt_per(t, wavelet),
-        dwt1_h=lambda t: _local_dwt_with_halo(t, wavelet, axis_name),
-    )
-
-
-def sharded_wavedec2_per(mesh: Mesh, wavelet: str, level: int, seq_axis: str = "data"):
-    """Multi-level 2D sharded decomposition for images/feature maps whose
-    row axis exceeds one core's memory: x (..., H, W) — any leading dims —
-    with H sharded over ``seq_axis``; every output leaf keeps that sharding.
-    Bit-compatible with `wam_tpu.wavelets.periodized.wavedec2_per`. Requires
-    H divisible by shards·2^level and W divisible by 2^level."""
-
-    @partial(
-        shard_map,
-        mesh=mesh,
-        in_specs=P(None, seq_axis, None),
-        out_specs=P(None, seq_axis, None),
-    )
-    def run(x_local):
-        coeffs = []
-        a = x_local
-        for _ in range(level):
-            a, det = _local_dwt2_with_halo(a, wavelet, seq_axis)
-            coeffs.append(det)
-        coeffs.append(a)
-        return coeffs[::-1]
-
-    @jax.jit
-    def apply(x):
-        lead = x.shape[:-2]
-        out = run(x.reshape((-1,) + x.shape[-2:]))
-        return jax.tree_util.tree_map(
-            lambda a: a.reshape(lead + a.shape[1:]), out
-        )
-
-    return apply
-
-
-def sharded_wavedec3_per(mesh: Mesh, wavelet: str, level: int, seq_axis: str = "data"):
-    """Multi-level 3D sharded decomposition for volumes whose depth axis
-    exceeds one core's memory: x (..., D, H, W) — any leading dims — with D
-    sharded over ``seq_axis``. Bit-compatible with
-    `wam_tpu.wavelets.periodized.wavedec3_per`. Requires D divisible by
-    shards·2^level and H, W divisible by 2^level."""
-    from wam_tpu.wavelets.periodized import separable_dwt3
-
-    def level_fn(x_local):
-        one = lambda t: dwt_per(t, wavelet)
-        halo_d = lambda t: _local_dwt_with_halo(t, wavelet, seq_axis)
-        return separable_dwt3(x_local, one, one, halo_d)
-
-    @partial(
-        shard_map,
-        mesh=mesh,
-        in_specs=P(None, seq_axis, None, None),
-        out_specs=P(None, seq_axis, None, None),
-    )
+    @partial(shard_map, mesh=mesh, in_specs=spec, out_specs=spec)
     def run(x_local):
         coeffs = []
         a = x_local
@@ -194,8 +135,41 @@ def sharded_wavedec3_per(mesh: Mesh, wavelet: str, level: int, seq_axis: str = "
 
     @jax.jit
     def apply(x):
-        lead = x.shape[:-3]
-        out = run(x.reshape((-1,) + x.shape[-3:]))
+        lead = x.shape[:-ndim]
+        out = run(x.reshape((-1,) + x.shape[-ndim:]))
         return jax.tree_util.tree_map(lambda a: a.reshape(lead + a.shape[1:]), out)
 
     return apply
+
+
+def sharded_wavedec2_per(mesh: Mesh, wavelet: str, level: int, seq_axis: str = "data"):
+    """Multi-level 2D sharded decomposition for images/feature maps whose
+    row axis exceeds one core's memory: x (..., H, W) — any leading dims —
+    with H sharded over ``seq_axis``; every output leaf keeps that sharding.
+    Bit-compatible with `wam_tpu.wavelets.periodized.wavedec2_per`. Requires
+    H divisible by shards·2^level and W divisible by 2^level."""
+
+    def level_fn(x_local):
+        return separable_dwt2(
+            x_local,
+            dwt1_w=lambda t: dwt_per(t, wavelet),
+            dwt1_h=lambda t: _local_dwt_with_halo(t, wavelet, seq_axis),
+        )
+
+    return _sharded_wavedec_nd(mesh, level, seq_axis, 2, level_fn)
+
+
+def sharded_wavedec3_per(mesh: Mesh, wavelet: str, level: int, seq_axis: str = "data"):
+    """Multi-level 3D sharded decomposition for volumes whose depth axis
+    exceeds one core's memory: x (..., D, H, W) — any leading dims — with D
+    sharded over ``seq_axis``. Bit-compatible with
+    `wam_tpu.wavelets.periodized.wavedec3_per`. Requires D divisible by
+    shards·2^level and H, W divisible by 2^level."""
+
+    def level_fn(x_local):
+        one = lambda t: dwt_per(t, wavelet)
+        return separable_dwt3(
+            x_local, one, one, lambda t: _local_dwt_with_halo(t, wavelet, seq_axis)
+        )
+
+    return _sharded_wavedec_nd(mesh, level, seq_axis, 3, level_fn)
