@@ -1,3 +1,9 @@
+from wam_tpu.parallel.halo import (
+    sharded_dwt_per,
+    sharded_wavedec2_per,
+    sharded_wavedec3_per,
+    sharded_wavedec_per,
+)
 from wam_tpu.parallel.mesh import P, data_sample_mesh, make_mesh
 from wam_tpu.parallel.multihost import hybrid_mesh, init_distributed, process_local_batch
 from wam_tpu.parallel.sharded import sharded_integrated_path, sharded_smoothgrad
@@ -11,4 +17,8 @@ __all__ = [
     "init_distributed",
     "hybrid_mesh",
     "process_local_batch",
+    "sharded_dwt_per",
+    "sharded_wavedec_per",
+    "sharded_wavedec2_per",
+    "sharded_wavedec3_per",
 ]

@@ -6,6 +6,20 @@ because JAX transforms are differentiable by construction.
 """
 
 from wam_tpu.wavelets.filters import Wavelet, build_wavelet, qmf
+from wam_tpu.wavelets.periodized import (
+    dwt2_per,
+    dwt3_per,
+    dwt_per,
+    idwt2_per,
+    idwt3_per,
+    idwt_per,
+    wavedec2_per,
+    wavedec3_per,
+    wavedec_per,
+    waverec2_per,
+    waverec3_per,
+    waverec_per,
+)
 from wam_tpu.wavelets.transform import (
     DETAIL3D_KEYS,
     get_dwt2_impl,
@@ -47,4 +61,16 @@ __all__ = [
     "wavedec3",
     "waverec3",
     "dwt_max_level",
+    "dwt_per",
+    "idwt_per",
+    "dwt2_per",
+    "idwt2_per",
+    "dwt3_per",
+    "idwt3_per",
+    "wavedec_per",
+    "waverec_per",
+    "wavedec2_per",
+    "waverec2_per",
+    "wavedec3_per",
+    "waverec3_per",
 ]
