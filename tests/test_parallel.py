@@ -224,3 +224,21 @@ def test_dwt3_per_matches_transform_subband_naming():
     x = jax.random.normal(jax.random.PRNGKey(8), (1, 8, 8, 8))
     _, det = dwt3_per(x, "haar")
     assert sorted(det) == sorted(DETAIL3D_KEYS)
+
+
+def test_eval_baselines_sharded_inference():
+    _need_devices(8)
+    from wam_tpu.evalsuite import EvalImageBaselines
+    from wam_tpu.models import resnet18
+
+    model = resnet18(num_classes=5)
+    variables = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 16, 16, 3)))
+    x = jax.random.normal(jax.random.PRNGKey(1), (2, 3, 16, 16)) * 0.3
+    y = np.array([1, 2])
+    single = EvalImageBaselines(model, variables, method="saliency")
+    sharded = EvalImageBaselines(
+        model, variables, method="saliency", mesh=make_mesh({"data": 8})
+    )
+    s_single = single.insertion(x, y, n_iter=16)
+    s_sharded = sharded.insertion(x, y, n_iter=16)
+    np.testing.assert_allclose(s_sharded, s_single, atol=1e-5)

@@ -16,7 +16,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from wam_tpu.evalsuite.metrics import compute_auc, generate_masks, softmax_probs
+from wam_tpu.evalsuite.metrics import compute_auc, generate_masks, make_probs_fn, softmax_probs
 from wam_tpu.evalsuite.packing import array_to_coeffs1d, coeffs_to_array1d
 from wam_tpu.ops.melspec import melspectrogram
 from wam_tpu.wam1d import normalize_waveforms
@@ -40,7 +40,13 @@ class Eval1DWAM:
         n_fft: int = 1024,
         sample_rate: int = 44100,
         batch_size: int = 128,
+        mesh=None,
+        data_axis: str = "data",
     ):
+        """Constructor args are frozen config (the reference's
+        constructor-kwargs surface, SURVEY.md §5.6) — build a new evaluator
+        to change them. ``mesh``: shard every metric's perturbation-inference
+        batch over ``data_axis`` (SURVEY.md §2.10 evaluation fan-out)."""
         self.model_fn = model_fn
         self.explainer = explainer
         self.wavelet = wavelet
@@ -50,6 +56,7 @@ class Eval1DWAM:
         self.n_fft = n_fft
         self.sample_rate = sample_rate
         self.batch_size = batch_size
+        self._probs_fn = make_probs_fn(model_fn, batch_size, mesh, data_axis)
         self.grad_wams = None
         self.insertion_curves = []
         self.deletion_curves = []
@@ -69,11 +76,7 @@ class Eval1DWAM:
         return mel[:, None, :, :]  # (B, 1, T, M)
 
     def _probs_for(self, inputs: jax.Array, label: int) -> jax.Array:
-        chunks = []
-        for i in range(0, inputs.shape[0], self.batch_size):
-            logits = self.model_fn(inputs[i : i + self.batch_size])
-            chunks.append(softmax_probs(logits)[:, label])
-        return jnp.concatenate(chunks)
+        return self._probs_fn(inputs, label)
 
     # -- perturbation families --------------------------------------------
 

@@ -22,7 +22,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from wam_tpu.evalsuite.metrics import compute_auc, generate_masks, softmax_probs, spearman
+from wam_tpu.evalsuite.metrics import compute_auc, generate_masks, make_probs_fn, softmax_probs, spearman
 from wam_tpu.evalsuite.packing import array_to_coeffs2d, coeffs_to_array2d
 from wam_tpu.ops.filters import gaussian_filter2d, superpixel_sum, upsample_nearest
 from wam_tpu.wavelets import wavedec2, waverec2
@@ -76,10 +76,13 @@ class Eval2DWAM:
         mesh=None,
         data_axis: str = "data",
     ):
-        """``mesh``: optional `jax.sharding.Mesh` — when given, every metric's
-        perturbation-inference batch (the 65-reconstruction insertion fan,
-        μ-fidelity subsets, ...) is sharded over ``data_axis`` instead of
-        chunked on one device (the SURVEY.md §2.10 evaluation fan-out)."""
+        """Constructor args are frozen config (the reference's
+        constructor-kwargs surface, SURVEY.md §5.6) — build a new evaluator
+        to change them. ``mesh``: optional `jax.sharding.Mesh` — when given,
+        every metric's perturbation-inference batch (the 65-reconstruction
+        insertion fan, μ-fidelity subsets, ...) is sharded over ``data_axis``
+        instead of chunked on one device (the SURVEY.md §2.10 evaluation
+        fan-out)."""
         self.model_fn = model_fn
         self.explainer = explainer
         self.wavelet = wavelet
@@ -91,7 +94,7 @@ class Eval2DWAM:
         self.random_seed = random_seed
         self.mesh = mesh
         self.data_axis = data_axis
-        self._jit_sharded_probs = None
+        self._probs_fn = make_probs_fn(model_fn, batch_size, mesh, data_axis)
         self.grad_wams = None
         self.insertion_curves = []
         self.deletion_curves = []
@@ -130,39 +133,7 @@ class Eval2DWAM:
         return self.preprocess_fn(_minmax01(recon))
 
     def _probs_for(self, inputs: jax.Array, label) -> jax.Array:
-        if self.mesh is not None:
-            return self._probs_for_sharded(inputs, label)
-        chunks = []
-        for i in range(0, inputs.shape[0], self.batch_size):
-            logits = self.model_fn(inputs[i : i + self.batch_size])
-            chunks.append(softmax_probs(logits)[:, label])
-        return jnp.concatenate(chunks)
-
-    def _probs_for_sharded(self, inputs: jax.Array, label) -> jax.Array:
-        """Inference fan-out over the mesh: the whole perturbation batch is
-        placed P(data_axis) and run as one sharded forward."""
-        from jax.sharding import NamedSharding, PartitionSpec
-
-        if self._jit_sharded_probs is None:
-
-            @jax.jit
-            def run(padded, lab):
-                probs = softmax_probs(self.model_fn(padded))
-                return jnp.take(probs, lab, axis=1)
-
-            self._jit_sharded_probs = run
-
-        n = self.mesh.shape[self.data_axis]
-        m = inputs.shape[0]
-        pad = (-m) % n
-        if pad:
-            # cyclic tiling handles pad > m (mesh wider than the batch);
-            # the result is sliced back to m below
-            inputs = jnp.resize(inputs, (m + pad,) + inputs.shape[1:])
-        inputs = jax.device_put(
-            inputs, NamedSharding(self.mesh, PartitionSpec(self.data_axis))
-        )
-        return self._jit_sharded_probs(inputs, jnp.asarray(label))[:m]
+        return self._probs_fn(inputs, label)
 
     # -- insertion / deletion ---------------------------------------------
 

@@ -16,7 +16,7 @@ import numpy as np
 
 from wam_tpu.evalsuite import baselines as B
 from wam_tpu.evalsuite.eval2d import _minmax01, imagenet_denormalize, imagenet_preprocess
-from wam_tpu.evalsuite.metrics import compute_auc, generate_masks, softmax_probs, spearman
+from wam_tpu.evalsuite.metrics import compute_auc, generate_masks, make_probs_fn, softmax_probs, spearman
 from wam_tpu.ops.filters import gaussian_filter2d, superpixel_sum, upsample_nearest
 
 __all__ = ["EvalImageBaselines", "EvalAudioBaselines", "IMAGE_METHODS", "AUDIO_METHODS"]
@@ -36,11 +36,15 @@ AUDIO_METHODS = ("saliency", "integratedgrad", "smoothgrad", "gradcam")
 
 
 class _BaseEvalBaselines:
-    """Shared machinery: method registry + cached explanations + AUC loop."""
+    """Shared machinery: method registry + cached explanations + AUC loop.
+
+    Constructor args are frozen config (SURVEY.md §5.6) — build a new
+    evaluator to change them. ``mesh`` shards every metric's
+    perturbation-inference batch over ``data_axis`` (§2.10)."""
 
     def __init__(self, model, variables, method: str, batch_size: int, random_seed: int,
                  n_samples: int, stdev_spread: float, cam_layer: str, nchw: bool,
-                 methods: tuple[str, ...]):
+                 methods: tuple[str, ...], mesh=None, data_axis: str = "data"):
         if method == "srd":
             raise NotImplementedError(
                 "'srd' is excluded by design: the reference imports it from a "
@@ -59,6 +63,8 @@ class _BaseEvalBaselines:
         self.stdev_spread = stdev_spread
         self.cam_layer = cam_layer
         self.nchw = nchw
+        self.mesh = mesh
+        self.data_axis = data_axis
         self.explanations = None
         self.insertion_curves = []
         self.deletion_curves = []
@@ -71,6 +77,7 @@ class _BaseEvalBaselines:
             return out[0] if isinstance(out, tuple) else out
 
         self.model_fn = model_fn
+        self._probs_fn = make_probs_fn(model_fn, batch_size, mesh, data_axis)
 
     def compute_explanations(self, x, y) -> jax.Array:
         """(B, H, W) maps in the perturbation domain
@@ -112,11 +119,7 @@ class _BaseEvalBaselines:
         self.explanations = None
 
     def _probs_for(self, inputs, label: int):
-        chunks = []
-        for i in range(0, inputs.shape[0], self.batch_size):
-            logits = self.model_fn(inputs[i : i + self.batch_size])
-            chunks.append(softmax_probs(logits)[:, label])
-        return jnp.concatenate(chunks)
+        return self._probs_fn(inputs, label)
 
     def _perturb(self, x_s: jax.Array, masks: jax.Array) -> jax.Array:
         raise NotImplementedError
@@ -165,10 +168,12 @@ class EvalImageBaselines(_BaseEvalBaselines):
         denormalize_fn: Callable = imagenet_denormalize,
         preprocess_fn: Callable = imagenet_preprocess,
         nchw: bool = True,
+        mesh=None,
+        data_axis: str = "data",
     ):
         super().__init__(model, variables, method, batch_size, random_seed,
                          n_samples, stdev_spread, cam_layer, nchw=nchw,
-                         methods=IMAGE_METHODS)
+                         methods=IMAGE_METHODS, mesh=mesh, data_axis=data_axis)
         self.denormalize_fn = denormalize_fn
         self.preprocess_fn = preprocess_fn
 
@@ -225,10 +230,12 @@ class EvalAudioBaselines(_BaseEvalBaselines):
         n_samples: int = 25,
         stdev_spread: float = 0.001,
         cam_layer: str = "out3",
+        mesh=None,
+        data_axis: str = "data",
     ):
         super().__init__(model, variables, method, batch_size, random_seed,
                          n_samples, stdev_spread, cam_layer, nchw=False,
-                         methods=AUDIO_METHODS)
+                         methods=AUDIO_METHODS, mesh=mesh, data_axis=data_axis)
 
     def _perturb(self, x_s, masks):
         # x_s: (1, T, M); masks: (n_iter+1, T, M) -> (n_iter+1, 1, T, M)

@@ -12,7 +12,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-__all__ = ["softmax_probs", "compute_auc", "generate_masks", "minmax_normalize", "spearman"]
+__all__ = ["softmax_probs", "compute_auc", "generate_masks", "minmax_normalize", "spearman", "make_probs_fn"]
 
 
 def softmax_probs(logits: jax.Array) -> jax.Array:
@@ -75,3 +75,42 @@ def spearman(a: jax.Array, b: jax.Array) -> jax.Array:
     rb = rb - rb.mean()
     denom = jnp.sqrt((ra**2).sum() * (rb**2).sum())
     return (ra * rb).sum() / jnp.where(denom == 0, 1.0, denom)
+
+
+def make_probs_fn(model_fn, batch_size: int = 128, mesh=None, data_axis: str = "data"):
+    """Build a `probs(inputs, label) -> (M,)` class-probability extractor.
+
+    Without a mesh: single-device, chunked by ``batch_size``. With a mesh:
+    the whole perturbation batch runs as ONE forward sharded over
+    ``data_axis`` (the SURVEY.md §2.10 evaluation fan-out), cyclically
+    padded to the axis multiple and sliced back.
+    """
+    if mesh is None:
+
+        def probs_fn(inputs, label):
+            chunks = []
+            for i in range(0, inputs.shape[0], batch_size):
+                logits = model_fn(inputs[i : i + batch_size])
+                chunks.append(softmax_probs(logits)[:, label])
+            return jnp.concatenate(chunks)
+
+        return probs_fn
+
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    @jax.jit
+    def run(padded, lab):
+        return jnp.take(softmax_probs(model_fn(padded)), lab, axis=1)
+
+    n = mesh.shape[data_axis]
+
+    def probs_fn(inputs, label):
+        m = inputs.shape[0]
+        pad = (-m) % n
+        if pad:
+            # cyclic tiling handles pad > m (mesh wider than the batch)
+            inputs = jnp.resize(inputs, (m + pad,) + inputs.shape[1:])
+        inputs = jax.device_put(inputs, NamedSharding(mesh, PartitionSpec(data_axis)))
+        return run(inputs, jnp.asarray(label))[:m]
+
+    return probs_fn
