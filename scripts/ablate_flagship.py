@@ -1,0 +1,106 @@
+"""Flagship-step ablation harness (VERDICT.md round-1 weak #2: 18% MFU).
+
+Measures the north-star workload (WAM-2D SmoothGrad, ResNet-50, b32, db4 J=3,
+n=25) under one configuration per invocation and prints a JSON line. Drive it
+from a shell loop with different XLA_FLAGS / args to build the ablation table
+in BASELINE.md.
+
+Reference workload spec: lib/wam_2D.py:343-356 + BASELINE.json north star.
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--batch", type=int, default=32)
+    p.add_argument("--n-samples", type=int, default=25)
+    p.add_argument("--image", type=int, default=224)
+    p.add_argument("--chunk", type=int, default=0,
+                   help="lax.map batch_size over samples; 0 = full vmap")
+    p.add_argument("--dtype", choices=["bf16", "f32"], default="bf16")
+    p.add_argument("--dwt-impl", choices=["auto", "conv", "matmul", "pallas"],
+                   default="auto")
+    p.add_argument("--remat", action="store_true",
+                   help="jax.checkpoint the per-sample step")
+    p.add_argument("--fold-bn", action="store_true")
+    p.add_argument("--s2d", action="store_true")
+    p.add_argument("--dwt-bf16", action="store_true",
+                   help="cast the noisy input to bf16 before the DWT")
+    p.add_argument("--wavelet", default="db4")
+    p.add_argument("--level", type=int, default=3)
+    p.add_argument("--repeats", type=int, default=3)
+    p.add_argument("--laps", type=int, default=4,
+                   help="dispatches per timed region (amortizes tunnel RTT)")
+    args = p.parse_args()
+
+    from wam_tpu.config import enable_compilation_cache, ensure_usable_backend
+
+    platform = ensure_usable_backend(timeout_s=180.0)
+    enable_compilation_cache()
+
+    import jax
+    import jax.numpy as jnp
+
+    from wam_tpu.core.engine import WamEngine
+    from wam_tpu.core.estimators import smoothgrad
+    from wam_tpu.models import bind_inference, resnet50
+    from wam_tpu.ops.packing2d import mosaic2d
+    from wam_tpu.profiling import bench_time
+    from wam_tpu.wavelets import set_dwt2_impl
+
+    set_dwt2_impl(args.dwt_impl)
+
+    model = resnet50(num_classes=1000, stem_s2d=args.s2d)
+    variables = model.init(jax.random.PRNGKey(0), jnp.zeros((1, args.image, args.image, 3)))
+    model_fn = bind_inference(
+        model, variables, nchw=True,
+        compute_dtype=jnp.bfloat16 if args.dtype == "bf16" else None,
+        fold_bn=args.fold_bn,
+    )
+    engine = WamEngine(model_fn, ndim=2, wavelet=args.wavelet, level=args.level,
+                       mode="reflect")
+
+    x = jax.random.normal(jax.random.PRNGKey(1), (args.batch, 3, args.image, args.image),
+                          jnp.float32)
+    y = jnp.arange(args.batch, dtype=jnp.int32) % 1000
+    chunk = args.chunk or args.n_samples
+
+    def step(noisy):
+        _, grads = engine.attribute(noisy, y)
+        return mosaic2d(grads, True)
+
+    if args.remat:
+        step = jax.checkpoint(step)
+
+    def run(x, key):
+        if args.dwt_bf16:
+            x = x.astype(jnp.bfloat16)
+        return smoothgrad(step, x, key, n_samples=args.n_samples,
+                          stdev_spread=0.25, batch_size=chunk)
+
+    run = jax.jit(run)
+
+    key = jax.random.PRNGKey(42)
+    t0 = time.perf_counter()
+    t = bench_time(run, x, key, repeats=args.repeats, laps=args.laps)
+    wall = time.perf_counter() - t0
+    print(json.dumps({
+        "platform": platform,
+        "batch": args.batch, "n_samples": args.n_samples, "image": args.image,
+        "chunk": chunk, "dtype": args.dtype, "dwt_impl": args.dwt_impl,
+        "remat": args.remat, "fold_bn": args.fold_bn, "s2d": args.s2d,
+        "step_s": round(t, 4),
+        "images_per_s": round(args.batch / t, 2),
+        "total_wall_s": round(wall, 1),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,87 @@
+"""Stage-level breakdown of the flagship step (round-2 MFU hunt).
+
+Times, at the same effective batch as the flagship's chunked sample loop:
+  1. model forward only (bf16)
+  2. model forward + input-gradient backward
+  3. DWT+IDWT round trip + mosaic (transform side)
+  4. full attribute step (engine)
+and derives achieved TFLOP/s for the conv stack from analytic per-image
+FLOPs (ResNet-50 fwd ~4.1 GF/img at 224^2, input-only bwd ~= fwd).
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--eff-batch", type=int, default=160,
+                   help="effective model batch (flagship: b32 x chunk5)")
+    p.add_argument("--image", type=int, default=224)
+    p.add_argument("--dtype", choices=["bf16", "f32"], default="bf16")
+    p.add_argument("--repeats", type=int, default=3)
+    args = p.parse_args()
+
+    from wam_tpu.config import ensure_usable_backend
+
+    ensure_usable_backend(timeout_s=180.0)
+
+    import jax
+    import jax.numpy as jnp
+
+    from wam_tpu.core.engine import WamEngine, target_loss
+    from wam_tpu.models import bind_inference, resnet50
+    from wam_tpu.ops.packing2d import mosaic2d
+    from wam_tpu.profiling import bench_time
+
+    B, S = args.eff_batch, args.image
+    model = resnet50(num_classes=1000)
+    variables = model.init(jax.random.PRNGKey(0), jnp.zeros((1, S, S, 3)))
+    model_fn = bind_inference(
+        model, variables, nchw=True,
+        compute_dtype=jnp.bfloat16 if args.dtype == "bf16" else None,
+    )
+    engine = WamEngine(model_fn, ndim=2, wavelet="db4", level=3, mode="reflect")
+
+    x = jax.random.normal(jax.random.PRNGKey(1), (B, 3, S, S), jnp.float32)
+    y = jnp.arange(B, dtype=jnp.int32) % 1000
+
+    fwd = jax.jit(lambda x: model_fn(x))
+
+    @jax.jit
+    def fwd_bwd(x, y):
+        return jax.grad(lambda xx: target_loss(model_fn(xx), y))(x)
+
+    @jax.jit
+    def dwt_roundtrip(x):
+        coeffs = engine.decompose(x)
+        rec = engine.reconstruct(coeffs, x.shape[-2:])
+        return rec.sum() + mosaic2d(jax.tree.map(jnp.asarray, coeffs), True).sum()
+
+    @jax.jit
+    def full(x, y):
+        _, grads = engine.attribute(x, y)
+        return mosaic2d(grads, True)
+
+    res = {}
+    res["fwd_s"] = bench_time(fwd, x, repeats=args.repeats, laps=8)
+    res["fwd_bwd_s"] = bench_time(fwd_bwd, x, y, repeats=args.repeats, laps=8)
+    res["dwt_roundtrip_s"] = bench_time(dwt_roundtrip, x, repeats=args.repeats, laps=8)
+    res["full_step_s"] = bench_time(full, x, y, repeats=args.repeats, laps=8)
+
+    gflop_img_fwd = 4.1 if S == 224 else 4.1 * (S / 224) ** 2
+    res["fwd_tflops"] = round(gflop_img_fwd * B / res["fwd_s"] / 1e3, 1)
+    res["fwd_bwd_tflops"] = round(2 * gflop_img_fwd * B / res["fwd_bwd_s"] / 1e3, 1)
+    res["fwd_mfu_pct_of_197"] = round(100 * res["fwd_tflops"] / 197, 1)
+    res["fwd_bwd_mfu_pct_of_197"] = round(100 * res["fwd_bwd_tflops"] / 197, 1)
+    res = {k: (round(v, 4) if isinstance(v, float) else v) for k, v in res.items()}
+    res.update(eff_batch=B, image=S, dtype=args.dtype)
+    print(json.dumps(res))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,48 @@
+"""Aggregate per-op device time from a JAX profiler xplane.pb capture.
+
+Usage: python scripts/xplane_ops.py /tmp/trace_fwd [top_n]
+
+Parses the TPU device plane and sums XEvent durations by (deduplicated) HLO
+op name, printing the top offenders — the op_profile view we can't get from
+the mismatched tensorboard-plugin-profile in this image.
+"""
+
+import collections
+import glob
+import sys
+
+from tensorflow.tsl.profiler.protobuf import xplane_pb2
+
+
+def main():
+    logdir, top_n = sys.argv[1], int(sys.argv[2]) if len(sys.argv) > 2 else 30
+    paths = glob.glob(f"{logdir}/plugins/profile/*/*.xplane.pb")
+    if not paths:
+        sys.exit(f"no xplane.pb under {logdir}")
+    space = xplane_pb2.XSpace()
+    space.ParseFromString(open(sorted(paths)[-1], "rb").read())
+
+    for plane in space.planes:
+        if "TPU" not in plane.name and "device" not in plane.name.lower():
+            continue
+        ev_meta = plane.event_metadata
+        print(f"== plane: {plane.name}")
+        for line in plane.lines:
+            totals = collections.defaultdict(float)
+            counts = collections.defaultdict(int)
+            for ev in line.events:
+                name = ev_meta[ev.metadata_id].name
+                totals[name] += ev.duration_ps / 1e12
+                counts[name] += 1
+            if not totals:
+                continue
+            grand = sum(totals.values())
+            print(f"-- line: {line.name}  total {grand*1e3:.1f} ms over "
+                  f"{sum(counts.values())} events")
+            for name, t in sorted(totals.items(), key=lambda kv: -kv[1])[:top_n]:
+                print(f"{t*1e3:9.2f} ms {100*t/max(grand,1e-12):5.1f}% "
+                      f"x{counts[name]:<5} {name[:140]}")
+
+
+if __name__ == "__main__":
+    main()

@@ -136,3 +136,77 @@ def test_torch_convnext_ingestion_logit_parity():
         t_out = tmodel(torch.from_numpy(x)).numpy()
     f_out = model.apply(variables, jnp.transpose(jnp.asarray(x), (0, 2, 3, 1)))
     np.testing.assert_allclose(np.asarray(f_out), t_out, atol=2e-4, rtol=2e-4)
+
+
+def _nontrivial_stats(variables, seed=3):
+    """Mildly perturbed running stats so BN folding / rewrites are exercised
+    with non-identity affines but ReLUs stay alive."""
+    import zlib
+
+    import jax.random as jr
+
+    def perturb(path, a):
+        name = path[-1].key if hasattr(path[-1], "key") else str(path[-1])
+        k = jr.fold_in(jr.PRNGKey(seed), zlib.crc32(str(path).encode()) % 2**31)
+        if name == "mean":
+            return jr.normal(k, a.shape) * 0.05
+        return jr.uniform(k, a.shape) * 0.8 + 0.6
+
+    stats = jax.tree_util.tree_map_with_path(perturb, variables["batch_stats"])
+    return dict(variables, batch_stats=stats)
+
+
+def test_fold_bn_preserves_function_and_gradient():
+    """BN-folded binding (models/resnet.py:_fold_bn_variables) is a pure
+    reparameterization: logits and input gradients match the unfolded model
+    to float rounding."""
+    model = resnet18(num_classes=10)
+    variables = _nontrivial_stats(model.init(jax.random.PRNGKey(0), jnp.zeros((1, 32, 32, 3))))
+    x = jax.random.normal(jax.random.PRNGKey(1), (4, 3, 32, 32))
+    from wam_tpu.models.resnet import _fold_bn_variables
+
+    folded = _fold_bn_variables(variables)
+    # Guard against the fold silently matching nothing (naming drift): the
+    # folded BN scales must all be exactly one and conv kernels must change.
+    assert all(
+        bool(jnp.all(v["scale"] == 1.0))
+        for k, v in folded["params"].items()
+        if k.startswith("bn")
+    )
+    assert not bool(
+        jnp.array_equal(folded["params"]["conv1"]["kernel"], variables["params"]["conv1"]["kernel"])
+    )
+    f0 = bind_inference(model, variables, nchw=True)
+    f1 = bind_inference(model, variables, nchw=True, fold_bn=True)
+    l0, l1 = f0(x), f1(x)
+    assert float(jnp.abs(l0).max()) > 0.1
+    np.testing.assert_allclose(np.asarray(l0), np.asarray(l1), atol=2e-5, rtol=2e-5)
+    g0 = jax.grad(lambda t: f0(t).sum())(x)
+    g1 = jax.grad(lambda t: f1(t).sum())(x)
+    np.testing.assert_allclose(np.asarray(g0), np.asarray(g1), atol=2e-5, rtol=2e-5)
+
+
+def test_stem_s2d_preserves_function_and_gradient():
+    """Space-to-depth stem (models/resnet.py:_StemConv) computes the same
+    function from the same (7,7,C,64) parameters."""
+    from wam_tpu.models.resnet import resnet18 as rn18
+
+    m0 = rn18(num_classes=10)
+    m1 = rn18(num_classes=10, stem_s2d=True)
+    variables = m0.init(jax.random.PRNGKey(0), jnp.zeros((1, 32, 32, 3)))
+    x = jax.random.normal(jax.random.PRNGKey(1), (2, 3, 32, 32))
+    l0 = bind_inference(m0, variables, nchw=True)(x)
+    l1 = bind_inference(m1, variables, nchw=True)(x)
+    np.testing.assert_allclose(np.asarray(l0), np.asarray(l1), atol=2e-5, rtol=2e-5)
+    g0 = jax.grad(lambda t: bind_inference(m0, variables, nchw=True)(t).sum())(x)
+    g1 = jax.grad(lambda t: bind_inference(m1, variables, nchw=True)(t).sum())(x)
+    np.testing.assert_allclose(np.asarray(g0), np.asarray(g1), atol=2e-5, rtol=2e-5)
+
+
+def test_stem_s2d_odd_size_falls_back():
+    from wam_tpu.models.resnet import resnet18 as rn18
+
+    m1 = rn18(num_classes=5, stem_s2d=True)
+    variables = m1.init(jax.random.PRNGKey(0), jnp.zeros((1, 33, 33, 3)))
+    out = bind_inference(m1, variables, nchw=True)(jnp.zeros((2, 3, 33, 33)))
+    assert out.shape == (2, 5)

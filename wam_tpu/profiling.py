@@ -27,15 +27,25 @@ def device_sync(out) -> None:
     jax.device_get(jax.tree_util.tree_map(lambda a: jnp.sum(a), out))
 
 
-def bench_time(fn, *args, repeats: int = 3) -> float:
-    """Min wall-clock seconds of `fn(*args)` over ``repeats`` timed runs,
-    after one untimed compile/warm-up run. Uses `device_sync` throughout."""
+def bench_time(fn, *args, repeats: int = 3, laps: int = 1) -> float:
+    """Min wall-clock seconds per call of `fn(*args)`, after one untimed
+    compile/warm-up run. Uses `device_sync` to close each timed region.
+
+    ``laps`` > 1 enqueues that many calls per timed region and syncs once:
+    TPU executes enqueued programs in order, so the region measures true
+    aggregate device time plus a single host round trip. On tunneled
+    platforms the round trip is ~100 ms (measured v5e-over-axon), which a
+    per-call sync would otherwise add to every lap — the round-1 flagship
+    numbers carried exactly that bias (BASELINE.md round-2 note)."""
     device_sync(fn(*args))
     times = []
     for _ in range(repeats):
         t0 = time.perf_counter()
-        device_sync(fn(*args))
-        times.append(time.perf_counter() - t0)
+        out = None
+        for _ in range(laps):
+            out = fn(*args)
+        device_sync(out)
+        times.append((time.perf_counter() - t0) / laps)
     return min(times)
 
 
