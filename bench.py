@@ -92,9 +92,14 @@ def tpu_throughput() -> float:
     return batch / t
 
 
-def cpu_baseline_throughput() -> float:
-    """Reference-pipeline cost on CPU torch, reduced workload, linear
-    extrapolation to (BATCH, N_SAMPLES)."""
+def cpu_baseline_throughput(full: bool = False) -> float:
+    """Reference-pipeline cost on CPU torch.
+
+    full=False (default): reduced workload (batch 2, ONE SmoothGrad sample),
+    extrapolated linearly to (BATCH, N_SAMPLES) — fast but approximate.
+    full=True: the honest measurement VERDICT.md round-1 asked for — the
+    complete b32 x n25 x 224^2 loop executed end to end, no extrapolation
+    (takes tens of minutes on CPU)."""
     import numpy as np
     import torch
     import torch.nn.functional as F
@@ -144,12 +149,12 @@ def cpu_baseline_throughput() -> float:
         )
     ).eval()
 
-    batch = 1 if QUICK else 2
+    batch = 1 if QUICK else (BATCH if full else 2)
     image = 64 if QUICK else IMAGE
     x = torch.randn(batch, 3, image, image)
 
-    def one_sample():
-        flat = x.reshape(-1, 1, image, image)
+    def one_sample(inp):
+        flat = inp.reshape(-1, 1, image, image)
         coeff_stack = []
         a = flat
         shapes = []
@@ -168,15 +173,51 @@ def cpu_baseline_throughput() -> float:
         loss = out[:, 0].mean()
         loss.backward()
 
-    one_sample()  # warm
+    if full:
+        # The reference's SmoothGrad loop (lib/wam_2D.py:390-406): per-image
+        # sigma noise, n_samples sequential full passes, measured end to end.
+        sigma = 0.25 * (
+            x.amax(dim=(1, 2, 3), keepdim=True) - x.amin(dim=(1, 2, 3), keepdim=True)
+        )
+        one_sample(x)  # warm-up/compile caches outside the timed region
+        t0 = time.perf_counter()
+        for _ in range(N_SAMPLES):
+            one_sample(x + torch.randn_like(x) * sigma)
+        t = time.perf_counter() - t0
+        return batch / t
+
+    one_sample(x)  # warm
     t0 = time.perf_counter()
-    one_sample()
+    one_sample(x)
     t = time.perf_counter() - t0
     # cost scales linearly in samples; per-image throughput:
     return batch / (t * N_SAMPLES)
 
 
 def main():
+    if "--full-baseline" in sys.argv:
+        # Standalone honest-baseline mode: measure ONLY the full CPU
+        # reference pipeline (b32 x n25, no extrapolation) and exit. The
+        # metric name reflects the actual workload so --quick runs can't be
+        # mistaken for the honest number.
+        batch, image = (1, 64) if QUICK else (BATCH, IMAGE)
+        t0 = time.perf_counter()
+        cpu = cpu_baseline_throughput(full=True)
+        print(
+            json.dumps(
+                {
+                    "metric": (
+                        f"cpu_torch_reference_full_b{batch}_n{N_SAMPLES}"
+                        f"_im{image}_images_per_sec"
+                    ),
+                    "value": round(cpu, 5),
+                    "unit": "images/s",
+                    "wall_s": round(time.perf_counter() - t0, 1),
+                    "dtype": "f32",
+                }
+            )
+        )
+        return
     tpu = tpu_throughput()
     try:
         cpu = cpu_baseline_throughput()

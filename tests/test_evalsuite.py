@@ -50,6 +50,51 @@ def test_spearman_perfect_and_reverse():
     np.testing.assert_allclose(spearman(a, -a), -1.0, atol=1e-6)
 
 
+def test_spearman_ties_match_scipy():
+    """Tie-averaged ranks must match scipy.stats.spearmanr to 1e-6 —
+    μ-fidelity Δprobs tie routinely (VERDICT.md round-1 weak #6)."""
+    scipy_stats = pytest.importorskip("scipy.stats")
+    rng = np.random.default_rng(5)
+    # heavy deliberate ties in both vectors
+    a = np.round(rng.standard_normal(200), 1).astype(np.float32)
+    b = np.round(rng.standard_normal(200), 1).astype(np.float32)
+    b[:50] = 0.0
+    a[100:130] = 0.5
+    want = scipy_stats.spearmanr(a, b).statistic
+    got = float(spearman(jnp.asarray(a), jnp.asarray(b)))
+    np.testing.assert_allclose(got, want, atol=1e-6)
+
+
+def test_superpixel_sum_keeps_edges_and_aligns_with_mask_upsample():
+    """Non-divisible maps keep edge mass instead of silently truncating
+    (VERDICT.md round-1 weak #7), and the cell partition matches the
+    `upsample_nearest` mapping that builds the μ-fidelity masks — so each
+    attribution cell sums exactly the pixels its mask cell perturbs."""
+    from wam_tpu.ops.filters import superpixel_sum, upsample_nearest
+
+    img = jnp.ones((2, 30, 30))
+    cells = superpixel_sum(img, 4)
+    assert cells.shape == (2, 4, 4)
+    np.testing.assert_allclose(np.asarray(cells).sum(), 2 * 30 * 30, rtol=1e-6)
+
+    # alignment: summing per cell must equal masking with the upsampled
+    # one-cell mask and summing the surviving pixels, for every cell
+    rng = np.random.default_rng(0)
+    a = jnp.asarray(rng.standard_normal((30, 30)).astype(np.float32))
+    got = np.asarray(superpixel_sum(a, 4))
+    for gi in range(4):
+        for gj in range(4):
+            m = jnp.zeros((4, 4)).at[gi, gj].set(1.0)
+            up = upsample_nearest(m, (30, 30))
+            np.testing.assert_allclose(
+                got[gi, gj], float((a * up).sum()), rtol=1e-5, atol=1e-5
+            )
+    # divisible path unchanged
+    np.testing.assert_allclose(
+        np.asarray(superpixel_sum(jnp.ones((8, 8)), 4)), np.full((4, 4), 4.0)
+    )
+
+
 def test_pack1d_roundtrip():
     x = jnp.asarray(np.random.default_rng(1).standard_normal((2, 64)), dtype=jnp.float32)
     coeffs = wavedec(x, "db2", level=3)
@@ -176,21 +221,120 @@ def test_guided_backprop_resnet():
     assert not np.allclose(np.asarray(gb), np.asarray(sal), atol=1e-6)
 
 
-def test_lrp_linear_biasfree_equals_gradxinput():
-    """On a bias-free linear model the ε→0 LRP identity is exact."""
+class _MiniReLUNet(nn.Module):
+    """Tiny conv-relu-dense net with the `post_linear` hook the real ε-LRP
+    rides on (wam_tpu/evalsuite/baselines.py:lrp)."""
+
+    classes: int = 4
+    use_bias: bool = False
+    post_linear: object = staticmethod(lambda z: z)
+
+    @nn.compact
+    def __call__(self, x):
+        x = nn.Conv(8, (3, 3), use_bias=self.use_bias, name="c1")(x)
+        x = self.post_linear(x)
+        x = nn.relu(x)
+        x = x.reshape((x.shape[0], -1))
+        x = nn.Dense(self.classes, use_bias=self.use_bias, name="d1")(x)
+        return self.post_linear(x)
+
+
+def test_lrp_biasfree_equals_gradxinput_and_conserves():
+    """VERDICT.md round-1 #3 criterion (a): on a bias-free ReLU net, ε→0
+    LRP equals gradient x input exactly, and relevance is conserved
+    (Σ R_in = picked logit). Exercises the non-ResNet `post_linear` tap
+    fallback of `lrp` (→ lrp_eps)."""
     from wam_tpu.evalsuite.baselines import gradient_x_input, lrp
 
-    rng = np.random.default_rng(9)
-    W = jnp.asarray(rng.standard_normal((3 * 16 * 16, 4)), dtype=jnp.float32)
-    x = jnp.asarray(rng.standard_normal((1, 3, 16, 16)), dtype=jnp.float32)
+    model = _MiniReLUNet(use_bias=False)
+    variables = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 12, 12, 3)))
+    x = jnp.asarray(np.random.default_rng(9).standard_normal((1, 3, 12, 12)), dtype=jnp.float32)
     y = jnp.array([2])
-    r = lrp(_linear_model(W), x, y)
-    gxi = gradient_x_input(_linear_model(W), x, y)
-    np.testing.assert_allclose(np.asarray(r), np.asarray(gxi), atol=1e-6)
-    # completeness on the bias-free linear model: channel-mean relevance sums
-    # to logit / C (batch of 1, diag-mean loss = the logit itself)
-    logit = float((x.reshape(1, -1) @ W)[0, 2])
-    np.testing.assert_allclose(float(np.asarray(r).sum() * 3), logit, rtol=1e-4)
+    r = lrp(model, variables, x, y, eps=1e-9)
+
+    def model_fn(v):
+        return model.apply(variables, jnp.transpose(v, (0, 2, 3, 1)))
+
+    # gradient_x_input channel-MEANS and lrp channel-SUMS; batch of 1 so the
+    # diag-mean loss scale matches up to the channel count.
+    gxi = gradient_x_input(model_fn, x, y)
+    np.testing.assert_allclose(np.asarray(r), np.asarray(gxi) * 3, atol=1e-4, rtol=1e-4)
+    logit = float(model_fn(x)[0, 2])
+    np.testing.assert_allclose(float(np.asarray(r).sum()), logit, rtol=1e-4)
+
+
+def test_lrp_bias_absorption_single_layer():
+    """VERDICT.md round-1 #3 criterion (c): per-layer ε-rule conservation —
+    with a biased linear layer, Σ R_in = R_y·(z_y − b_y)/(z_y + ε·sign z_y):
+    the bias absorbs exactly its share of relevance."""
+    from wam_tpu.evalsuite.baselines import lrp
+
+    class OneDense(nn.Module):
+        post_linear: object = staticmethod(lambda z: z)
+
+        @nn.compact
+        def __call__(self, x):
+            x = x.reshape((x.shape[0], -1))
+            return self.post_linear(nn.Dense(4, use_bias=True, name="d")(x))
+
+    model = OneDense()
+    variables = model.init(jax.random.PRNGKey(1), jnp.zeros((1, 6, 6, 3)))
+    # nontrivial bias
+    variables = jax.tree_util.tree_map(lambda a: a, variables)
+    b = jnp.asarray(np.random.default_rng(3).standard_normal(4), dtype=jnp.float32)
+    variables = {"params": {"d": {"kernel": variables["params"]["d"]["kernel"], "bias": b}}}
+    x = jnp.asarray(np.random.default_rng(4).standard_normal((1, 3, 6, 6)), dtype=jnp.float32)
+    y = jnp.array([1])
+    eps = 1e-6
+    r = lrp(model, variables, x, y, eps=eps)
+    z = model.apply(variables, jnp.transpose(x, (0, 2, 3, 1)))[0]
+    zy, by = float(z[1]), float(b[1])
+    expect = zy * (zy - by) / (zy + eps * np.sign(zy))
+    np.testing.assert_allclose(float(np.asarray(r).sum()), expect, rtol=1e-4)
+
+
+def test_lrp_resnet_walker_validates_against_autodiff():
+    """The lrp_resnet walker with composite='epsilon' at ε→0 must reproduce
+    gradient x input exactly (Ancona et al. 2018 identity for ReLU nets) —
+    this validates every stage of the structural walker (stem, blocks,
+    residual splits, pools, fc) against autodiff."""
+    from wam_tpu.evalsuite.baselines import gradient_x_input
+    from wam_tpu.evalsuite.lrp import lrp_resnet
+    from wam_tpu.models import bind_inference, resnet18
+
+    model = resnet18(num_classes=5)
+    variables = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 32, 32, 3)))
+    x = jnp.asarray(np.random.default_rng(11).standard_normal((2, 3, 32, 32)), dtype=jnp.float32)
+    y = jnp.array([1, 3])
+    r = lrp_resnet(model, variables, x, y, eps=1e-9, composite="epsilon")
+    gxi = gradient_x_input(bind_inference(model, variables, nchw=True), x, y)
+    # lrp channel-sums and seeds per-sample logits; gxi channel-means with a
+    # batch-mean loss: scale = C * B
+    np.testing.assert_allclose(np.asarray(r), np.asarray(gxi) * 3 * 2, atol=2e-6)
+
+
+def test_lrp_resnet_epf_conserves_and_differs_from_gradxinput():
+    """VERDICT.md round-1 #3 criteria (b) + (c) on the faithful
+    EpsilonPlusFlat composite: relevance is conserved through every layer
+    (Σ R_in = picked logit on a bias-free net, to ~1e-4) and the map is NOT
+    gradient x input."""
+    from wam_tpu.evalsuite.baselines import gradient_x_input, lrp
+    from wam_tpu.models import bind_inference, resnet18
+
+    model = resnet18(num_classes=5)
+    variables = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 32, 32, 3)))
+    x = jnp.asarray(np.random.default_rng(11).standard_normal((2, 3, 32, 32)), dtype=jnp.float32)
+    y = jnp.array([1, 3])
+    r = lrp(model, variables, x, y)  # ResNet → EpsilonPlusFlat walker
+    assert r.shape == (2, 32, 32)
+    assert np.all(np.isfinite(np.asarray(r)))
+    logits = bind_inference(model, variables, nchw=True)(x)
+    picked = np.take_along_axis(np.asarray(logits), np.asarray(y)[:, None], 1)[:, 0]
+    np.testing.assert_allclose(np.asarray(r.sum(axis=(1, 2))), picked, rtol=1e-4, atol=1e-5)
+    gxi = gradient_x_input(bind_inference(model, variables, nchw=True), x, y)
+    rn = np.asarray(r) / (np.abs(np.asarray(r)).max() + 1e-12)
+    gn = np.asarray(gxi) / (np.abs(np.asarray(gxi)).max() + 1e-12)
+    assert float(np.abs(rn - gn).max()) > 0.1
 
 
 # -- end-to-end evaluators -------------------------------------------------

@@ -81,3 +81,38 @@ def test_mel_inversion_approximate():
     got = mag[0, mag.shape[1] // 2].argmax()
     want = true_mag[0, true_mag.shape[1] // 2].argmax()
     assert abs(int(got) - int(want)) <= 2
+
+
+def test_mel_inversion_nnls_beats_pinv_and_is_nonnegative():
+    """VERDICT.md round-1 #8: NNLS inversion — residual no worse than the
+    clipped-pinv start, strictly non-negative power."""
+    from wam_tpu.ops.melspec import _nnls_projected_gradient, mel_filterbank
+
+    sr, n_fft, n_mels = 8192, 512, 64
+    t = np.arange(sr) / sr
+    x = jnp.asarray(
+        np.sin(2 * np.pi * 440 * t) + 0.3 * np.sin(2 * np.pi * 1500 * t), dtype=jnp.float32
+    )[None]
+    mel = np.asarray(melspectrogram(x, sr, n_fft, n_mels, to_db=False))
+    fb = mel_filterbank(n_fft // 2 + 1, n_mels, sr)
+    B = mel.reshape(-1, n_mels)
+    x0 = np.clip(B @ np.linalg.pinv(fb), 0.0, None)
+    nnls = _nnls_projected_gradient(fb, B, x0)
+    assert np.all(nnls >= 0)
+    r_pinv = float(np.square(x0 @ fb - B).sum())
+    r_nnls = float(np.square(nnls @ fb - B).sum())
+    assert r_nnls <= r_pinv * (1 + 1e-6)
+    assert r_nnls < r_pinv * 0.9  # and it genuinely improves on this signal
+
+
+def test_nnls_closed_form_small_case():
+    """Exact solution recovered when it is feasible (x >= 0): A orthogonal
+    columns, B generated from a known non-negative x."""
+    from wam_tpu.ops.melspec import _nnls_projected_gradient
+
+    rng = np.random.default_rng(3)
+    A = np.abs(rng.standard_normal((5, 8))).astype(np.float64)
+    x_true = np.abs(rng.standard_normal((4, 5)))
+    B = x_true @ A
+    x = _nnls_projected_gradient(A, B, np.zeros_like(x_true), iters=20000, tol=0.0)
+    np.testing.assert_allclose(x @ A, B, atol=1e-5)

@@ -1,0 +1,153 @@
+"""Two-process jax.distributed integration test (VERDICT.md round-1 #9).
+
+Round 1 only exercised `init_distributed`/`hybrid_mesh` in a single process.
+Here a real 2-process × 4-virtual-CPU-device cluster is launched via
+subprocesses, and the full multi-host path runs end to end:
+`init_distributed` (explicit coordinator args) → `hybrid_mesh` with the data
+axis spanning DCN (process granules) → `process_local_batch` feeding
+per-host shards → `jax.make_array_from_process_local_data` → one jitted
+sharded reduction whose collective crosses the process boundary. Each worker
+checks the global result against the analytic value.
+
+SURVEY.md §5.8; runs on CPU only (no TPU needed).
+"""
+
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+from pathlib import Path
+
+_REPO = Path(__file__).resolve().parent.parent
+
+_WORKER = textwrap.dedent(
+    """
+    import os, sys
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=4"
+    ).strip()
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import numpy as np
+
+    sys.path.insert(0, {repo!r})
+    from wam_tpu.parallel.multihost import (
+        hybrid_mesh, init_distributed, process_local_batch,
+    )
+
+    pid = int(sys.argv[1])
+    info = init_distributed(
+        coordinator_address={coord!r}, num_processes=2, process_id=pid
+    )
+    assert info["process_count"] == 2, info
+    assert info["global_devices"] == 8, info
+
+    mesh = hybrid_mesh({{"data": -1, "sample": 2}}, dcn_axis="data")
+    assert mesh.shape["data"] == 4 and mesh.shape["sample"] == 2
+
+    # per-host input pipeline: each process materializes only its shard
+    global_batch = 8
+    local = process_local_batch(global_batch)
+    assert local == 4
+    local_rows = np.arange(local, dtype=np.float32) + pid * local  # 0..3 / 4..7
+    local_data = np.tile(local_rows[:, None], (1, 16))
+
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    sharding = NamedSharding(mesh, PartitionSpec("data", None))
+    garr = jax.make_array_from_process_local_data(sharding, local_data)
+    assert garr.shape == (global_batch, 16)
+
+    @jax.jit
+    def total(a):
+        return (a * 2.0).sum()
+
+    got = float(total(garr))
+    want = 2.0 * 16 * sum(range(global_batch))
+    assert got == want, (got, want)
+    print(f"WORKER{{pid}}_OK", flush=True)
+    """
+)
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_two_process_distributed_end_to_end():
+    coord = f"127.0.0.1:{_free_port()}"
+    code = _WORKER.format(repo=str(_REPO), coord=coord)
+    env = {
+        k: v
+        for k, v in os.environ.items()
+        if k not in ("JAX_PLATFORMS", "XLA_FLAGS")
+    }
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c", code, str(pid)],
+            cwd=str(_REPO),
+            env=env,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+        )
+        for pid in (0, 1)
+    ]
+    outs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=300)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        outs.append(out)
+    for pid, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"worker {pid} failed:\n{out[-4000:]}"
+        assert f"WORKER{pid}_OK" in out, out[-2000:]
+
+
+def test_init_distributed_raises_on_unreachable_coordinator():
+    """ADVICE.md round-1 item 3: a genuine bring-up failure must raise, not
+    silently degrade to single-process."""
+    code = textwrap.dedent(
+        f"""
+        import sys
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        sys.path.insert(0, {str(_REPO)!r})
+        from wam_tpu.parallel.multihost import init_distributed
+        try:
+            init_distributed(
+                coordinator_address="127.0.0.1:1", num_processes=2, process_id=1,
+                initialization_timeout=5,
+            )
+        except Exception as e:
+            print("RAISED", type(e).__name__, flush=True)
+        else:
+            print("SWALLOWED", flush=True)
+        """
+    )
+    env = {k: v for k, v in os.environ.items() if k != "JAX_PLATFORMS"}
+    # worker id 1 connects to the (dead) coordinator and must fail fast
+    proc = subprocess.run(
+        [sys.executable, "-c", code],
+        cwd=str(_REPO),
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=240,
+    )
+    # The coordination client either raises (caught → RAISED) or hard-aborts
+    # the process (absl LOG(FATAL) on RegisterTask deadline). Both are
+    # acceptable; what must NEVER happen is init_distributed returning as if
+    # single-process (SWALLOWED).
+    assert "SWALLOWED" not in proc.stdout, (proc.stdout + proc.stderr)[-3000:]
+    assert "RAISED" in proc.stdout or proc.returncode != 0, (
+        proc.stdout + proc.stderr
+    )[-3000:]

@@ -84,7 +84,9 @@ def select_backend(device: str | None) -> None:
     jax.config.update("jax_platforms", platform)
 
 
-def enable_compilation_cache(cache_dir: str | None = None) -> str:
+def enable_compilation_cache(
+    cache_dir: str | None = None, min_compile_time_secs: float | None = None
+) -> str:
     """Persist compiled XLA executables across processes.
 
     First TPU compiles of the full estimator graph run 20-40s; with the
@@ -101,8 +103,18 @@ def enable_compilation_cache(cache_dir: str | None = None) -> str:
     )
     os.makedirs(cache_dir, exist_ok=True)
     jax.config.update("jax_compilation_cache_dir", cache_dir)
-    # cache everything that took noticeable compile time
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    # Cache everything that took noticeable compile time — but never clobber
+    # a threshold the user already configured via env var or jax.config
+    # (round-1 ADVICE.md item 4).
+    if min_compile_time_secs is not None:
+        jax.config.update(
+            "jax_persistent_cache_min_compile_time_secs", min_compile_time_secs
+        )
+    elif (
+        "JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS" not in os.environ
+        and jax.config.jax_persistent_cache_min_compile_time_secs == 1.0  # stock default
+    ):
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
     return cache_dir
 
 

@@ -36,6 +36,8 @@ __all__ = [
     "guided_relu",
     "guided_backprop",
     "gradient_x_input",
+    "make_eps_tap",
+    "lrp_eps",
     "lrp",
 ]
 
@@ -182,19 +184,78 @@ def gradient_x_input(model_fn: Callable, x: jax.Array, y) -> jax.Array:
     return (x * _input_grads(model_fn, x, y)).mean(axis=1)
 
 
-def lrp(model_fn: Callable, x: jax.Array, y, n_steps: int = 0) -> jax.Array:
-    """ε→0 layer-wise relevance propagation for piecewise-linear nets.
+def make_eps_tap(eps: float) -> Callable:
+    """Identity-forward op whose backward applies the LRP ε-rule cotangent
+    rescale: g → g · z / (z + ε·sign z).
 
-    For ReLU networks with bias-free linear layers, LRP-0/LRP-ε relevance at
-    the input equals gradient x input (Shrikumar et al. 2016; Ancona et al.
-    2018) — that identity is used here rather than a per-layer rule pass.
-    The reference's 'lrp' registry entry (zennit EpsilonPlusFlat +
-    ResNetCanonizer, `src/evaluators.py:885-899`) applies per-layer ε-rules,
-    so values agree in rank structure but are not bitwise-matched where
-    biases/BatchNorm shift relevance. n_steps>0 averages the identity along
-    the zero→x path (closer to ε-rule smoothing on biased nets)."""
-    if n_steps and n_steps > 1:
-        alphas = jnp.linspace(1.0 / n_steps, 1.0, n_steps, dtype=x.dtype)
-        grads = jax.lax.map(lambda a: _input_grads(model_fn, x * a, y), alphas)
-        return (x * grads.mean(axis=0)).mean(axis=1)
-    return gradient_x_input(model_fn, x, y)
+    Inserted after every linear(+bias/BatchNorm) output (the models'
+    ``post_linear`` hook), this turns the standard VJP into exact ε-LRP for
+    ReLU networks: the invariant "cotangent = relevance / activation" is
+    preserved by ReLU (mask), additions (copy — residual relevance splits
+    proportionally when the branch activation multiplies downstream),
+    average pooling (linear spread), and maxpool (winner-take-all routing,
+    the LRP convention). Input relevance is then x ⊙ ∂/∂x."""
+
+    @jax.custom_vjp
+    def tap(z):
+        return z
+
+    def fwd(z):
+        return z, z
+
+    def bwd(z, g):
+        denom = z + eps * jnp.sign(z)
+        safe = jnp.where(denom == 0, 1.0, denom)
+        return (g * z / safe,)
+
+    tap.defvjp(fwd, bwd)
+    return tap
+
+
+def lrp_eps(model, variables, x: jax.Array, y, eps: float = 1e-6,
+            nchw: bool = True) -> jax.Array:
+    """Pure ε-rule LRP via the ``post_linear`` cotangent tap (`make_eps_tap`).
+
+    Per-layer ε-rule through conv/dense with BatchNorm treated jointly with
+    its conv as one linear-plus-bias layer (tap after the BN output), seeded
+    with the picked logit, harvested as x ⊙ grad summed over channels.
+
+    Note the known identity (Ancona et al. 2018): for ReLU networks the
+    ε→0 limit of this rule IS gradient x input — with or without biases —
+    so use a finite ε (or `lrp`'s EpsilonPlusFlat composite, the
+    reference's actual configuration) when a distinct method is wanted.
+    """
+    if not hasattr(model, "post_linear"):
+        raise ValueError(
+            f"lrp_eps needs a model with a `post_linear` hook; "
+            f"{type(model).__name__} has none (the ResNet zoo provides it)"
+        )
+    tapped = model.clone(post_linear=make_eps_tap(eps))
+    base = {k: v for k, v in variables.items() if k != "perturbations"}
+
+    def picked_logit_sum(v):
+        inp = jnp.transpose(v, (0, 2, 3, 1)) if nchw else v
+        out = tapped.apply(base, inp)
+        out = out[0] if isinstance(out, tuple) else out
+        yy = jnp.asarray(y)
+        return jnp.take_along_axis(out, yy[:, None], axis=1).sum()
+
+    grads = jax.grad(picked_logit_sum)(x)
+    return (x * grads).sum(axis=1 if nchw else -1)
+
+
+def lrp(model, variables, x: jax.Array, y, eps: float = 1e-6,
+        nchw: bool = True) -> jax.Array:
+    """Layer-wise relevance propagation, matching the reference registry.
+
+    For the ResNet zoo this is the zennit-`EpsilonPlusFlat`-with-canonizer
+    counterpart (`src/evaluators.py:885-899`): BN folded into convs, ZPlus
+    rule on convs, ε on dense, Flat on the stem — see
+    `wam_tpu.evalsuite.lrp.lrp_resnet`. Other models with a ``post_linear``
+    hook fall back to the pure ε-rule (`lrp_eps`)."""
+    from wam_tpu.evalsuite.lrp import lrp_resnet
+    from wam_tpu.models.resnet import ResNet
+
+    if isinstance(model, ResNet):
+        return lrp_resnet(model, variables, x, y, eps=eps, nchw=nchw)
+    return lrp_eps(model, variables, x, y, eps=eps, nchw=nchw)

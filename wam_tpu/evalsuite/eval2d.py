@@ -232,9 +232,9 @@ class Eval2DWAM:
             probs_alt = self._probs_for(reconstruct(x[s], masks_grid), label)
             deltas = base_probs[s, label] - probs_alt
 
-            # attribution mass per superpixel of the (blurred) mosaic
-            g = wam.shape[-1] // grid_size * grid_size
-            cell_sums = superpixel_sum(wam[:g, :g], grid_size).reshape(-1)
+            # attribution mass per superpixel of the (blurred) mosaic; edge
+            # cells keep partial mass (superpixel_sum zero-pads)
+            cell_sums = superpixel_sum(wam, grid_size).reshape(-1)
             attrs = jnp.asarray(onehot) @ cell_sums
 
             results.append(float(spearman(deltas, attrs)))

@@ -105,9 +105,7 @@ class _BaseEvalBaselines:
         if m == "gradxinput":
             return B.gradient_x_input(self.model_fn, x, y)
         if m == "lrp":
-            # n_steps=0: the ε→0 identity — keeps 'lrp' distinct from
-            # 'integratedgrad' (whose path average n_steps>1 would duplicate)
-            return B.lrp(self.model_fn, x, y, n_steps=0)
+            return B.lrp(self.model, self.variables, x, y, nchw=self.nchw)
         raise AssertionError(m)
 
     def precompute(self, x, y):
@@ -208,8 +206,8 @@ class EvalImageBaselines(_BaseEvalBaselines):
             probs = self._probs_for(self._perturb(x[s], masks), label)
             deltas = base_probs[s, label] - probs
 
-            g = attr_map.shape[-1] // grid_size * grid_size
-            cell = superpixel_sum(attr_map[:g, :g], grid_size).reshape(-1)
+            # edge cells keep partial mass (superpixel_sum zero-pads)
+            cell = superpixel_sum(attr_map, grid_size).reshape(-1)
             attrs = jnp.asarray(onehot) @ cell
             results.append(float(spearman(deltas, attrs)))
         return results

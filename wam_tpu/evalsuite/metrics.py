@@ -63,12 +63,19 @@ def minmax_normalize(a: jax.Array) -> jax.Array:
 
 def spearman(a: jax.Array, b: jax.Array) -> jax.Array:
     """Spearman rank correlation of two 1D vectors (scipy.stats.spearmanr
-    role in μ-fidelity, `src/evaluators.py:761-763`), on-device."""
+    role in μ-fidelity, `src/evaluators.py:761-763`), on-device.
+
+    Ties receive AVERAGED ranks, matching scipy's default — μ-fidelity
+    probability deltas tie routinely (saturated softmax identical to float
+    precision), where first-occurrence ranks would diverge from the
+    reference (VERDICT.md round-1 weak #6). rank(v) = (#less + (#leq−1)/2),
+    via two searchsorted passes on the sorted copy."""
 
     def ranks(v):
-        order = jnp.argsort(v)
-        r = jnp.zeros_like(v).at[order].set(jnp.arange(v.shape[0], dtype=v.dtype))
-        return r
+        sv = jnp.sort(v)
+        lo = jnp.searchsorted(sv, v, side="left")
+        hi = jnp.searchsorted(sv, v, side="right")
+        return (lo + hi - 1).astype(v.dtype) / 2.0
 
     ra, rb = ranks(a), ranks(b)
     ra = ra - ra.mean()
@@ -103,14 +110,24 @@ def make_probs_fn(model_fn, batch_size: int = 128, mesh=None, data_axis: str = "
         return jnp.take(softmax_probs(model_fn(padded)), lab, axis=1)
 
     n = mesh.shape[data_axis]
+    # Per-dispatch cap: batch_size per shard (a huge fan — e.g. μ-fidelity
+    # with a large sample_size — must not exceed per-device memory just
+    # because a mesh is attached; round-1 ADVICE.md item 1).
+    chunk = max(batch_size, 1) * n
 
     def probs_fn(inputs, label):
-        m = inputs.shape[0]
-        pad = (-m) % n
-        if pad:
-            # cyclic tiling handles pad > m (mesh wider than the batch)
-            inputs = jnp.resize(inputs, (m + pad,) + inputs.shape[1:])
-        inputs = jax.device_put(inputs, NamedSharding(mesh, PartitionSpec(data_axis)))
-        return run(inputs, jnp.asarray(label))[:m]
+        lab = jnp.asarray(label)
+        sharding = NamedSharding(mesh, PartitionSpec(data_axis))
+        outs = []
+        for i in range(0, inputs.shape[0], chunk):
+            part = inputs[i : i + chunk]
+            m = part.shape[0]
+            pad = (-m) % n
+            if pad:
+                # cyclic tiling handles pad > m (mesh wider than the batch)
+                part = jnp.resize(part, (m + pad,) + part.shape[1:])
+            part = jax.device_put(part, sharding)
+            outs.append(run(part, lab)[:m])
+        return outs[0] if len(outs) == 1 else jnp.concatenate(outs)
 
     return probs_fn

@@ -30,25 +30,32 @@ __all__ = ["ResNet", "resnet18", "resnet34", "resnet50", "resnet101", "bind_infe
 ModuleDef = Any
 
 
+def _identity(z):
+    return z
+
+
 class BasicBlock(nn.Module):
     features: int
     strides: int = 1
     norm: ModuleDef = nn.BatchNorm
     act: Callable = nn.relu
+    # Hook applied after every linear(+BN) output — identity by default;
+    # LRP swaps in an ε-rule cotangent tap via model.clone (evalsuite).
+    post_linear: Callable = _identity
 
     @nn.compact
     def __call__(self, x):
         residual = x
         y = nn.Conv(self.features, (3, 3), (self.strides, self.strides), padding=1,
                     use_bias=False, name="conv1")(x)
-        y = self.norm(name="bn1")(y)
+        y = self.post_linear(self.norm(name="bn1")(y))
         y = self.act(y)
         y = nn.Conv(self.features, (3, 3), padding=1, use_bias=False, name="conv2")(y)
-        y = self.norm(name="bn2")(y)
+        y = self.post_linear(self.norm(name="bn2")(y))
         if residual.shape != y.shape:
             residual = nn.Conv(self.features, (1, 1), (self.strides, self.strides),
                                use_bias=False, name="downsample_conv")(x)
-            residual = self.norm(name="downsample_bn")(residual)
+            residual = self.post_linear(self.norm(name="downsample_bn")(residual))
         return self.act(y + residual)
 
 
@@ -58,24 +65,25 @@ class Bottleneck(nn.Module):
     norm: ModuleDef = nn.BatchNorm
     expansion: int = 4
     act: Callable = nn.relu
+    post_linear: Callable = _identity
 
     @nn.compact
     def __call__(self, x):
         residual = x
         y = nn.Conv(self.features, (1, 1), use_bias=False, name="conv1")(x)
-        y = self.norm(name="bn1")(y)
+        y = self.post_linear(self.norm(name="bn1")(y))
         y = self.act(y)
         y = nn.Conv(self.features, (3, 3), (self.strides, self.strides), padding=1,
                     use_bias=False, name="conv2")(y)
-        y = self.norm(name="bn2")(y)
+        y = self.post_linear(self.norm(name="bn2")(y))
         y = self.act(y)
         y = nn.Conv(self.features * self.expansion, (1, 1), use_bias=False, name="conv3")(y)
-        y = self.norm(name="bn3")(y)
+        y = self.post_linear(self.norm(name="bn3")(y))
         if residual.shape != y.shape:
             residual = nn.Conv(self.features * self.expansion, (1, 1),
                                (self.strides, self.strides), use_bias=False,
                                name="downsample_conv")(x)
-            residual = self.norm(name="downsample_bn")(residual)
+            residual = self.post_linear(self.norm(name="downsample_bn")(residual))
         return self.act(y + residual)
 
 
@@ -131,26 +139,29 @@ class ResNet(nn.Module):
     # Space-to-depth stem: same parameters, same function, cheaper input
     # gradient on TPU (see _StemConv).
     stem_s2d: bool = False
+    # Post-linear hook threaded to every block (see BasicBlock.post_linear).
+    post_linear: Callable = _identity
 
     @nn.compact
     def __call__(self, x, train: bool = False):
         """x: (B, H, W, C) NHWC. Returns logits (B, num_classes)."""
         norm = partial(nn.BatchNorm, use_running_average=not train, momentum=0.9, epsilon=1e-5)
         x = _StemConv(s2d=self.stem_s2d, name="conv1")(x)
-        x = norm(name="bn1")(x)
+        x = self.post_linear(norm(name="bn1")(x))
         x = self.act(x)
         x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))
         for stage, n_blocks in enumerate(self.stage_sizes):
             for i in range(n_blocks):
                 strides = 2 if stage > 0 and i == 0 else 1
                 x = self.block_cls(64 * 2**stage, strides=strides, norm=norm,
-                                   act=self.act, name=f"layer{stage + 1}_{i}")(x)
+                                   act=self.act, post_linear=self.post_linear,
+                                   name=f"layer{stage + 1}_{i}")(x)
             self.sow("intermediates", f"stage{stage + 1}", x)
             # Gradient tap for the GradCAM-family baselines: no-op unless a
             # 'perturbations' collection is passed (wam_tpu.evalsuite.baselines).
             x = self.perturb(f"stage{stage + 1}", x)
         x = x.mean(axis=(1, 2))
-        return nn.Dense(self.num_classes, name="fc")(x)
+        return self.post_linear(nn.Dense(self.num_classes, name="fc")(x))
 
 
 resnet18 = partial(ResNet, stage_sizes=(2, 2, 2, 2), block_cls=BasicBlock)

@@ -45,11 +45,27 @@ def gaussian_filter2d(img: jax.Array, sigma: float = 2.0) -> jax.Array:
 
 def superpixel_sum(img: jax.Array, grid: int) -> jax.Array:
     """Sum over (grid × grid) superpixels: (..., H, W) → (..., grid, grid).
-    H and W must be divisible by grid."""
+
+    Non-divisible sizes partition every pixel into the SAME cell that
+    `upsample_nearest` (jax.image.resize nearest) would map it to — the
+    perturbation masks in μ-fidelity are built by exactly that upsample, so
+    attribution cell sums stay aligned with the perturbed regions. Round 1
+    silently truncated the trailing rows/cols instead (VERDICT.md weak #7).
+    """
     h, w = img.shape[-2:]
-    ch, cw = h // grid, w // grid
-    r = img.reshape(img.shape[:-2] + (grid, ch, grid, cw))
-    return r.sum(axis=(-3, -1))
+    if h % grid == 0 and w % grid == 0:
+        r = img.reshape(img.shape[:-2] + (grid, h // grid, grid, w // grid))
+        return r.sum(axis=(-3, -1))
+    # cell id per row/col = nearest-resize source index, by construction
+    ids_h = jax.image.resize(
+        jnp.arange(grid, dtype=jnp.float32), (h,), method="nearest"
+    ).astype(jnp.int32)
+    ids_w = jax.image.resize(
+        jnp.arange(grid, dtype=jnp.float32), (w,), method="nearest"
+    ).astype(jnp.int32)
+    Eh = jax.nn.one_hot(ids_h, grid, dtype=img.dtype)  # (h, grid)
+    Ew = jax.nn.one_hot(ids_w, grid, dtype=img.dtype)  # (w, grid)
+    return jnp.einsum("...hw,hg,wk->...gk", img, Eh, Ew)
 
 
 def upsample_nearest(a: jax.Array, hw: tuple[int, int]) -> jax.Array:

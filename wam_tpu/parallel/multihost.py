@@ -23,10 +23,22 @@ from jax.sharding import Mesh
 __all__ = ["init_distributed", "hybrid_mesh", "process_local_batch"]
 
 
+def _distributed_client_exists() -> bool:
+    """Whether jax's distributed client is already up (private API probe,
+    guarded against jax-version drift)."""
+    try:
+        from jax._src import distributed
+
+        return distributed.global_state.client is not None
+    except Exception:
+        return False
+
+
 def init_distributed(
     coordinator_address: str | None = None,
     num_processes: int | None = None,
     process_id: int | None = None,
+    initialization_timeout: float | None = None,
 ) -> dict:
     """Connect this process to the multi-host runtime.
 
@@ -39,10 +51,14 @@ def init_distributed(
     import os
 
     if coordinator_address is not None or num_processes not in (None, 1):
+        kwargs = {}
+        if initialization_timeout is not None:
+            kwargs["initialization_timeout"] = initialization_timeout
         jax.distributed.initialize(
             coordinator_address=coordinator_address,
             num_processes=num_processes,
             process_id=process_id,
+            **kwargs,
         )
     else:
         # Auto-init only when a launcher really indicates multiple hosts: a
@@ -60,11 +76,14 @@ def init_distributed(
         if multi_host:
             try:
                 jax.distributed.initialize()
-            except RuntimeError:
-                # already initialized, or the backend is already up (e.g. a
-                # notebook that touched jax.devices() first) — proceed with
-                # whatever process topology jax reports
-                pass
+            except RuntimeError as exc:
+                # Suppress ONLY the already-initialized/backend-already-up
+                # cases; a genuine bring-up failure (unreachable coordinator,
+                # bad env) must not silently degrade to single-process
+                # (round-1 ADVICE.md item 3).
+                already = _distributed_client_exists() or "already" in str(exc).lower()
+                if not already:
+                    raise
     return {
         "process_index": jax.process_index(),
         "process_count": jax.process_count(),
