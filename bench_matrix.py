@@ -17,10 +17,12 @@ import argparse
 import json
 
 
-def _timed(run, *args, repeats=3):
+def _timed(run, *args, repeats=3, laps=1):
     from wam_tpu.profiling import bench_time
 
-    return bench_time(run, *args, repeats=repeats)
+    # laps>1 amortizes the tunneled-TPU host round trip (~100 ms measured)
+    # over in-order executions — see BASELINE.md round-2 methodology note.
+    return bench_time(run, *args, repeats=repeats, laps=laps)
 
 
 def main():
@@ -68,18 +70,25 @@ def main():
             # written per row so an interrupted sweep keeps finished results
             writer.write(rec)
 
-    def vision_fn(ctor, image, num_classes=1000):
-        model = ctor(num_classes=num_classes)
+    laps = 4 if on_accel else 1
+
+    def vision_fn(ctor, image, num_classes=1000, fold_bn=False, **model_kw):
+        model = ctor(num_classes=num_classes, **model_kw)
         variables = model.init(jax.random.PRNGKey(0), jnp.zeros((1, image, image, 3)))
-        return bind_inference(model, variables, nchw=True, compute_dtype=dtype)
+        return bind_inference(
+            model, variables, nchw=True, compute_dtype=dtype, fold_bn=fold_bn,
+        )
 
     # 1. base single-image pass ------------------------------------------------
     image = 64 if q else 224
-    fn50 = vision_fn(resnet50, image)
+    use_rewrites = not args.f32  # keep the f32 reference config rewrite-free
+    fn50 = vision_fn(resnet50, image, fold_bn=use_rewrites,
+                     stem_s2d=use_rewrites and image % 2 == 0)
     base = BaseWAM2D(fn50, wavelet="haar", J=3, mode="reflect")
     x1 = jax.random.normal(jax.random.PRNGKey(1), (1, 3, image, image), jnp.float32)
     y1 = jnp.zeros((1,), jnp.int32)
-    record("wam2d_base_resnet50_single_haar_J3", 1, _timed(lambda: base(x1, y1)))
+    record("wam2d_base_resnet50_single_haar_J3", 1,
+           _timed(lambda: base(x1, y1), laps=laps))
 
     # 2. flagship SmoothGrad ---------------------------------------------------
     batch, n = (4, 3) if q else (32, 25)
@@ -90,7 +99,7 @@ def main():
     x2 = jax.random.normal(jax.random.PRNGKey(2), (batch, 3, image, image), jnp.float32)
     y2 = jnp.arange(batch, dtype=jnp.int32) % 1000
     record(f"wam2d_smoothgrad_resnet50_b{batch}_db4_n{n}", batch,
-           _timed(lambda: ex2(x2, y2)), "images/s")
+           _timed(lambda: ex2(x2, y2), laps=laps), "images/s")
 
     # 3. audio SmoothGrad ------------------------------------------------------
     # quick: shortest length whose melspec (hop 512, 129 frames) survives
@@ -108,7 +117,7 @@ def main():
     x3 = jax.random.normal(jax.random.PRNGKey(3), (ab, wave_len), jnp.float32)
     y3 = jnp.arange(ab, dtype=jnp.int32) % 50
     record(f"wam1d_smoothgrad_audiocnn_b{ab}_db6_J5_n{an}", ab,
-           _timed(lambda: ex3(x3, y3)), "waveforms/s")
+           _timed(lambda: ex3(x3, y3), laps=laps), "waveforms/s")
 
     # 4. 3D SmoothGrad ---------------------------------------------------------
     size = 16 if q else 32
@@ -123,7 +132,7 @@ def main():
     x4 = jax.random.normal(jax.random.PRNGKey(4), (vb, 1, size, size, size), jnp.float32)
     y4 = jnp.arange(vb, dtype=jnp.int32) % 10
     record(f"wam3d_smoothgrad_resnet3d18_b{vb}_{size}cube_haar_J2_n{vn}", vb,
-           _timed(lambda: ex4(x4, y4)), "volumes/s")
+           _timed(lambda: ex4(x4, y4), laps=laps), "volumes/s")
 
     # 5. ViT IG path -----------------------------------------------------------
     steps = 4 if q else 64
@@ -134,7 +143,8 @@ def main():
     )
     x5 = jax.random.normal(jax.random.PRNGKey(5), (1, 3, image, image), jnp.float32)
     y5 = jnp.zeros((1,), jnp.int32)
-    record(f"wam2d_ig_vitb16_path{steps}", 1, _timed(lambda: ex5(x5, y5)))
+    record(f"wam2d_ig_vitb16_path{steps}", 1,
+           _timed(lambda: ex5(x5, y5), laps=laps))
 
 
 if __name__ == "__main__":

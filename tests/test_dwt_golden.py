@@ -1,0 +1,183 @@
+"""Independent DWT golden values and algebraic invariants (VERDICT.md #4).
+
+Round 1's pywt-parity tests compared against `tests/reference_dwt.py`,
+written by the same author from the same understanding — a shared
+convention misconception would pass everything. This file pins the
+transform from OUTSIDE that shared code path, with no import of
+`tests/reference_dwt.py`:
+
+1. literal closed-form Daubechies filter values (db2 exact radicals, db4's
+   published D8 decimals — standard tables, e.g. Daubechies 1992, Table 6.1);
+2. the worked examples printed in pywt's own documentation
+   (`pywt.dwt([1,2,3,4],'haar')`, `pywt.wavedec([1..8],'db1',level=2)`);
+3. a definitional oracle: pywt's dwt is the FULL convolution with the
+   decomposition filter downsampled at odd indices — reproduced here with
+   nothing but `np.convolve` and the closed-form filters, and compared to
+   our zero-padding mode over the whole output (zero padding == plain full
+   convolution);
+4. algebraic invariants no padding convention can fake: double-shift
+   orthonormality, QMF relation, vanishing moments, periodized perfect
+   reconstruction and Parseval energy at odd lengths;
+5. cross-mode interior agreement: away from the boundary all padding modes
+   must agree exactly (boundary handling only touches the edges).
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from wam_tpu.wavelets.filters import build_wavelet
+from wam_tpu.wavelets.transform import dwt, wavedec, waverec
+
+SQRT2 = np.sqrt(2.0)
+SQRT3 = np.sqrt(3.0)
+
+# Closed-form db2 decomposition low-pass in pywt's ascending-index order
+# (Daubechies D4: h = [(1±√3), (3±√3)]/(4√2)).
+DB2_DEC_LO = np.array(
+    [(1 - SQRT3), (3 - SQRT3), (3 + SQRT3), (1 + SQRT3)]
+) / (4 * SQRT2)
+
+# Published Daubechies D8 (pywt 'db4') scaling coefficients h0..h7
+# (Daubechies 1992, Table 6.1; identical digits in the pywt wavelet browser),
+# listed here in pywt dec_lo order (reversed h).
+DB4_H = np.array(
+    [
+        0.2303778133088964,
+        0.7148465705529154,
+        0.6308807679298587,
+        -0.0279837694168599,
+        -0.1870348117190931,
+        0.0308413818355607,
+        0.0328830116668852,
+        -0.0105974017850690,
+    ]
+)
+DB4_DEC_LO = DB4_H[::-1]
+
+
+def test_db2_filters_match_closed_form():
+    wav = build_wavelet("db2")
+    np.testing.assert_allclose(np.asarray(wav.dec_lo), DB2_DEC_LO, atol=1e-12)
+
+
+def test_db4_filters_match_published_table():
+    wav = build_wavelet("db4")
+    np.testing.assert_allclose(np.asarray(wav.dec_lo), DB4_DEC_LO, atol=1e-10)
+
+
+@pytest.mark.parametrize("name,N", [("db2", 2), ("db4", 4), ("sym4", 4), ("haar", 1)])
+def test_orthonormality_qmf_and_vanishing_moments(name, N):
+    """Double-shift orthonormality, Σlo=√2, QMF high-pass, and N vanishing
+    moments — properties of the true Daubechies/Symlet filters that any
+    transcription error would break."""
+    wav = build_wavelet(name)
+    lo = np.asarray(wav.dec_lo, dtype=np.float64)
+    hi = np.asarray(wav.dec_hi, dtype=np.float64)
+    L = len(lo)
+    np.testing.assert_allclose(lo.sum(), SQRT2, atol=1e-10)
+    np.testing.assert_allclose(hi.sum(), 0.0, atol=1e-10)
+    for m in range(1, L // 2):
+        np.testing.assert_allclose(np.dot(lo[2 * m :], lo[: L - 2 * m]), 0.0, atol=1e-10)
+        np.testing.assert_allclose(np.dot(hi[2 * m :], hi[: L - 2 * m]), 0.0, atol=1e-10)
+    np.testing.assert_allclose(np.dot(lo, lo), 1.0, atol=1e-10)
+    np.testing.assert_allclose(np.dot(hi, hi), 1.0, atol=1e-10)
+    # QMF, pywt sign convention: hi[k] = (-1)^(k+1) lo[L-1-k]
+    # (e.g. haar dec_hi = [-1/√2, +1/√2], db2 dec_hi starts at -0.4830)
+    np.testing.assert_allclose(
+        hi, np.array([(-1) ** (k + 1) * lo[L - 1 - k] for k in range(L)]), atol=1e-10
+    )
+    # vanishing moments: Σ k^p hi[k] = 0 for p < N
+    for p in range(N):
+        np.testing.assert_allclose(
+            np.dot(np.arange(L, dtype=np.float64) ** p, hi), 0.0, atol=1e-7
+        )
+
+
+def test_pywt_doc_example_haar_dwt():
+    """pywt documentation worked example: dwt([1,2,3,4], 'haar') →
+    cA=[2.12132034, 4.94974747], cD=[-0.70710678, -0.70710678]."""
+    cA, cD = dwt(jnp.asarray([[1.0, 2.0, 3.0, 4.0]]), "haar", mode="symmetric")
+    np.testing.assert_allclose(
+        np.asarray(cA)[0], [2.12132034, 4.94974747], atol=1e-7
+    )
+    np.testing.assert_allclose(
+        np.asarray(cD)[0], [-0.70710678, -0.70710678], atol=1e-7
+    )
+
+
+def test_pywt_doc_example_db1_wavedec_level2():
+    """pywt documentation worked example: wavedec([1..8], 'db1', level=2) →
+    cA2=[5., 13.], cD2=[-2., -2.], cD1=[-0.707..x4]."""
+    x = jnp.asarray(np.arange(1.0, 9.0))[None]
+    cA2, cD2, cD1 = wavedec(x, "db1", level=2, mode="symmetric")
+    np.testing.assert_allclose(np.asarray(cA2)[0], [5.0, 13.0], atol=1e-6)
+    np.testing.assert_allclose(np.asarray(cD2)[0], [-2.0, -2.0], atol=1e-6)
+    np.testing.assert_allclose(np.asarray(cD1)[0], [-0.70710678] * 4, atol=1e-6)
+
+
+@pytest.mark.parametrize("name,filt", [("db2", DB2_DEC_LO), ("db4", DB4_DEC_LO)])
+@pytest.mark.parametrize("n", [16, 37, 63])
+def test_zero_mode_equals_definitional_full_convolution(name, filt, n):
+    """pywt's dwt in 'zero' mode IS the full convolution of the signal with
+    the decomposition filter, downsampled at odd indices, trimmed to
+    floor((n+L-1)/2) — reproduced with np.convolve and the closed-form
+    filters only (no shared helper code)."""
+    rng = np.random.default_rng(n)
+    x = rng.standard_normal(n)
+    L = len(filt)
+    out_len = (n + L - 1) // 2
+
+    lo_full = np.convolve(x, filt)[1::2][:out_len]
+    wav = build_wavelet(name)
+    hi_filt = np.asarray(wav.dec_hi, dtype=np.float64)
+    # independent QMF construction of the high-pass from the closed form
+    # (pywt sign convention: leading coefficient negative)
+    hi_closed = np.array([(-1) ** (k + 1) * filt[L - 1 - k] for k in range(L)])
+    np.testing.assert_allclose(hi_filt, hi_closed, atol=1e-10)
+    hi_full = np.convolve(x, hi_closed)[1::2][:out_len]
+
+    cA, cD = dwt(jnp.asarray(x, dtype=jnp.float32)[None], name, mode="zero")
+    np.testing.assert_allclose(np.asarray(cA)[0], lo_full, atol=2e-5)
+    np.testing.assert_allclose(np.asarray(cD)[0], hi_full, atol=2e-5)
+
+
+@pytest.mark.parametrize("name", ["db2", "db4", "sym4"])
+@pytest.mark.parametrize("n", [37, 61])
+def test_interior_agrees_across_all_modes(name, n):
+    """Padding only affects the edges: coefficients more than one filter
+    length from either end must be bitwise-equal across zero / symmetric /
+    reflect / periodic — a shared boundary-convention misconception cannot
+    fake this, and the interior itself is pinned by the zero-mode
+    definitional test above."""
+    rng = np.random.default_rng(7)
+    x = jnp.asarray(rng.standard_normal(n), dtype=jnp.float32)[None]
+    wav = build_wavelet(name)
+    L = wav.filt_len
+    outs = {m: dwt(x, name, mode=m) for m in ("zero", "symmetric", "reflect", "periodic")}
+    sl = slice(L, -L)
+    base_cA = np.asarray(outs["zero"][0])[0][sl]
+    base_cD = np.asarray(outs["zero"][1])[0][sl]
+    assert base_cA.size > 4  # the interior must be non-trivial
+    for m, (cA, cD) in outs.items():
+        np.testing.assert_allclose(np.asarray(cA)[0][sl], base_cA, atol=1e-6, err_msg=m)
+        np.testing.assert_allclose(np.asarray(cD)[0][sl], base_cD, atol=1e-6, err_msg=m)
+
+
+@pytest.mark.parametrize("name", ["haar", "db2", "db4", "sym4"])
+@pytest.mark.parametrize("n", [32, 100])
+def test_periodized_perfect_reconstruction_and_parseval(name, n):
+    """For the periodized orthonormal transform: synthesis∘analysis is the
+    identity and total energy is conserved (Parseval) — including a length
+    (100) whose level-2 coefficient count is odd."""
+    from wam_tpu.wavelets.periodized import wavedec_per, waverec_per
+
+    rng = np.random.default_rng(n)
+    x = jnp.asarray(rng.standard_normal(n), dtype=jnp.float32)[None]
+    coeffs = wavedec_per(x, name, 2)
+    rec = waverec_per(coeffs, name)
+    np.testing.assert_allclose(np.asarray(rec), np.asarray(x), atol=2e-6)
+    ex = float((np.asarray(x) ** 2).sum())
+    ec = sum(float((np.asarray(c) ** 2).sum()) for c in coeffs)
+    np.testing.assert_allclose(ec, ex, rtol=1e-5)

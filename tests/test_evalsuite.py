@@ -398,3 +398,142 @@ def test_eval_image_baselines(img_model_fn):
     assert len(ins) == 1
     mus = ev.mu_fidelity(x, [0], grid_size=8, sample_size=5, subset_size=10)
     assert len(mus) == 1
+
+
+def test_batched_auc_matches_per_image_loop():
+    """VERDICT.md round-1 #6: the single-dispatch batched AUC path must
+    reproduce the round-1 per-image host loop exactly."""
+    from wam_tpu.evalsuite.metrics import (
+        batched_auc_runner,
+        compute_auc,
+        generate_masks,
+        softmax_probs,
+    )
+
+    model = TinyImgModel()
+    variables = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 16, 16, 3)))
+
+    def model_fn(v):
+        return model.apply(variables, jnp.transpose(v, (0, 2, 3, 1)))
+
+    rng = np.random.default_rng(2)
+    x = jnp.asarray(rng.standard_normal((5, 3, 16, 16)), dtype=jnp.float32)
+    expl = jnp.asarray(rng.standard_normal((5, 16, 16)), dtype=jnp.float32)
+    y = np.array([0, 1, 2, 3, 4])
+    n_iter = 8
+
+    def inputs_fn(x_s, e_s):
+        ins, _ = generate_masks(n_iter, e_s)
+        return x_s[None] * ins[:, None]
+
+    runner = batched_auc_runner(inputs_fn, model_fn, images_per_chunk=2)
+    scores, curves = runner(x, expl, jnp.asarray(y))
+
+    for s in range(5):
+        inputs = inputs_fn(x[s], expl[s])
+        probs = softmax_probs(model_fn(inputs))[:, int(y[s])]
+        np.testing.assert_allclose(np.asarray(curves[s]), np.asarray(probs), atol=1e-6)
+        np.testing.assert_allclose(float(scores[s]), float(compute_auc(probs)), atol=1e-6)
+
+
+def test_eval2d_auc_runner_cache_reused():
+    """The jitted batch runner is memoized per (mode, n_iter, shapes)."""
+    from wam_tpu.evalsuite.eval2d import Eval2DWAM
+
+    model = TinyImgModel()
+    variables = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 16, 16, 3)))
+
+    def model_fn(v):
+        return model.apply(variables, jnp.transpose(v, (0, 2, 3, 1)))
+
+    ev = Eval2DWAM(model_fn, explainer=lambda x, y: jnp.ones(x.shape[:1] + x.shape[-2:]),
+                   wavelet="haar", J=2, batch_size=32)
+    x = jnp.asarray(np.random.default_rng(1).standard_normal((2, 3, 16, 16)), dtype=jnp.float32)
+    y = np.array([0, 1])
+    ev.insertion(x, y, n_iter=4)
+    assert len(ev._auc_runners) == 1
+    ev.insertion(x, y, n_iter=4)
+    assert len(ev._auc_runners) == 1
+    ev.deletion(x, y, n_iter=4)
+    assert len(ev._auc_runners) == 2
+
+
+def test_gradcam_on_vit_token_grid():
+    """VERDICT.md round-1 #10: GradCAM over the ViT token tap — class token
+    dropped, patch tokens folded to the √N grid, (B, H, W) map out."""
+    from wam_tpu.evalsuite.baselines import gradcam, gradcam_pp, layercam
+    from wam_tpu.models.vit import vit_tiny_test
+
+    model = vit_tiny_test(num_classes=5)
+    variables = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 32, 32, 3)))
+    assert "perturbations" in variables  # the tap exists
+    x = jnp.asarray(np.random.default_rng(3).standard_normal((2, 3, 32, 32)), dtype=jnp.float32)
+    y = jnp.array([1, 4])
+    for fn in (gradcam, gradcam_pp, layercam):
+        cam = fn(model, variables, x, y, layer="tokens")
+        assert cam.shape == (2, 32, 32)
+        arr = np.asarray(cam)
+        assert np.all(np.isfinite(arr)) and np.all(arr >= 0)
+    # the token adapter itself: acts/grads come back on the 4x4 patch grid
+    # (32/8 patches per side), class token dropped, and the activations vary
+    # with the input
+    from wam_tpu.evalsuite.baselines import _acts_and_grads
+
+    acts, grads = _acts_and_grads(model, variables, x, y, "tokens", nchw=True)
+    assert acts.shape == (2, 4, 4, 64)
+    assert grads.shape == (2, 4, 4, 64)
+    acts2, _ = _acts_and_grads(model, variables, x.at[0].multiply(-1.0), y, "tokens", nchw=True)
+    assert not np.allclose(np.asarray(acts[0]), np.asarray(acts2[0]))
+
+
+def test_guided_backprop_rejects_models_without_act():
+    """VERDICT.md round-1 weak #8: the documented error path for non-ReLU
+    models (no swappable `act`) must actually raise."""
+    from wam_tpu.evalsuite.baselines import guided_backprop
+    from wam_tpu.models.vit import vit_tiny_test
+
+    model = vit_tiny_test(num_classes=5)
+    variables = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 32, 32, 3)))
+    x = jnp.zeros((1, 3, 32, 32))
+    with pytest.raises(ValueError, match="act"):
+        guided_backprop(model, variables, x, jnp.array([0]))
+
+
+def test_gradcam_batch_matches_per_sample():
+    """Gradient taps must be per-sample even when variables were initialized
+    at batch 1 — the stored perturbation variable's init batch must not
+    batch-sum the CAM weights (regression: round-2 fix in _acts_and_grads)."""
+    from wam_tpu.evalsuite.baselines import gradcam
+    from wam_tpu.models import resnet18
+
+    model = resnet18(num_classes=5)
+    variables = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 32, 32, 3)))
+    x = jnp.asarray(np.random.default_rng(9).standard_normal((2, 3, 32, 32)), dtype=jnp.float32)
+    y = jnp.array([1, 3])
+    both = np.asarray(gradcam(model, variables, x, y))
+    for s in range(2):
+        one = np.asarray(gradcam(model, variables, x[s : s + 1], y[s : s + 1]))
+        np.testing.assert_allclose(both[s], one[0], atol=1e-4)
+
+
+def test_lrp_resnet_walker_bottleneck_validates_against_autodiff():
+    """Same autodiff validation for the Bottleneck branch — the path the
+    production ResNet-50/101 'lrp' evaluations take (3-conv main branch,
+    stride on conv2, downsample shortcut)."""
+    from wam_tpu.evalsuite.baselines import gradient_x_input
+    from wam_tpu.evalsuite.lrp import lrp_resnet
+    from wam_tpu.models import bind_inference
+    from wam_tpu.models.resnet import Bottleneck, ResNet
+
+    model = ResNet(stage_sizes=(1, 2), block_cls=Bottleneck, num_classes=4)
+    variables = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 32, 32, 3)))
+    x = jnp.asarray(np.random.default_rng(13).standard_normal((2, 3, 32, 32)), dtype=jnp.float32)
+    y = jnp.array([0, 3])
+    r = lrp_resnet(model, variables, x, y, eps=1e-9, composite="epsilon")
+    gxi = gradient_x_input(bind_inference(model, variables, nchw=True), x, y)
+    np.testing.assert_allclose(np.asarray(r), np.asarray(gxi) * 3 * 2, atol=2e-6)
+    # EpsilonPlusFlat on the same net: finite + conserving (bias-free init)
+    repf = lrp_resnet(model, variables, x, y)
+    logits = bind_inference(model, variables, nchw=True)(x)
+    picked = np.take_along_axis(np.asarray(logits), np.asarray(y)[:, None], 1)[:, 0]
+    np.testing.assert_allclose(np.asarray(repf.sum(axis=(1, 2))), picked, rtol=1e-4, atol=1e-5)

@@ -16,7 +16,13 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from wam_tpu.evalsuite.metrics import compute_auc, generate_masks, make_probs_fn, softmax_probs
+from wam_tpu.evalsuite.metrics import (
+    batched_auc_runner,
+    compute_auc,
+    generate_masks,
+    make_probs_fn,
+    softmax_probs,
+)
 from wam_tpu.evalsuite.packing import array_to_coeffs1d, coeffs_to_array1d
 from wam_tpu.ops.melspec import melspectrogram
 from wam_tpu.wam1d import normalize_waveforms
@@ -56,7 +62,9 @@ class Eval1DWAM:
         self.n_fft = n_fft
         self.sample_rate = sample_rate
         self.batch_size = batch_size
+        self.mesh = mesh
         self._probs_fn = make_probs_fn(model_fn, batch_size, mesh, data_axis)
+        self._auc_runners: dict = {}
         self.grad_wams = None
         self.insertion_curves = []
         self.deletion_curves = []
@@ -112,30 +120,49 @@ class Eval1DWAM:
         x = normalize_waveforms(x)
         y = np.asarray(y)
         mel_grads, coeff_grads = self.precompute(x, y)
-        source_mels = np.asarray(self._melspec(x))[:, 0]
+        source_mels = self._melspec(x)[:, 0]
 
-        scores, curves, raw = [], [], []
-        for s in range(x.shape[0]):
-            if target == "melspec":
-                inputs = self.perturbed_from_melspec(
-                    jnp.asarray(mel_grads[s]), jnp.asarray(source_mels[s]), mode, n_iter
+        if target == "melspec":
+            expl = (jnp.asarray(mel_grads), jnp.asarray(source_mels))
+
+            def inputs_fn(x_s, expl_s):
+                grad_mel, source_mel = expl_s
+                return self.perturbed_from_melspec(grad_mel, source_mel, mode, n_iter)
+
+        elif target == "wavelet":
+            expl = tuple(jnp.asarray(g) for g in coeff_grads)
+
+            def inputs_fn(x_s, expl_s):
+                return self.perturbed_from_wavelet(x_s, list(expl_s), mode, n_iter)
+
+        else:
+            raise ValueError(f"Unknown target {target!r}")
+
+        if self.mesh is None or argmax:
+            # one jit dispatch for the whole batch (VERDICT.md round-1 #6);
+            # the argmax (input-fidelity) variant returns raw logit rows
+            key = (mode, target, n_iter, argmax, x.shape[1:])
+            runner = self._auc_runners.get(key)
+            if runner is None:
+                runner = batched_auc_runner(
+                    inputs_fn,
+                    self.model_fn,
+                    images_per_chunk=max(1, self.batch_size // (n_iter + 1)),
+                    return_logits=argmax,
                 )
-            elif target == "wavelet":
-                sample_grads = [g[s] for g in coeff_grads]
-                inputs = self.perturbed_from_wavelet(x[s], sample_grads, mode, n_iter)
-            else:
-                raise ValueError(f"Unknown target {target!r}")
+                self._auc_runners[key] = runner
             if argmax:
-                logits_all = []
-                for i in range(0, inputs.shape[0], self.batch_size):
-                    logits_all.append(np.asarray(self.model_fn(inputs[i : i + self.batch_size])))
-                raw.append(np.concatenate(logits_all))
-                continue
+                return list(np.asarray(runner(x, expl, jnp.asarray(y))))
+            scores, ps = runner(x, expl, jnp.asarray(y))
+            return [float(v) for v in scores], [np.asarray(p) for p in ps]
+
+        scores, curves = [], []
+        for s in range(x.shape[0]):
+            expl_s = jax.tree_util.tree_map(lambda a: a[s], expl)
+            inputs = inputs_fn(x[s], expl_s)
             probs = self._probs_for(inputs, int(y[s]))
             scores.append(float(compute_auc(probs)))
             curves.append(np.asarray(probs))
-        if argmax:
-            return raw
         return scores, curves
 
     def insertion(self, x, y, target: str = "wavelet", n_iter: int = 64):

@@ -22,7 +22,14 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from wam_tpu.evalsuite.metrics import compute_auc, generate_masks, make_probs_fn, softmax_probs, spearman
+from wam_tpu.evalsuite.metrics import (
+    batched_auc_runner,
+    compute_auc,
+    generate_masks,
+    make_probs_fn,
+    softmax_probs,
+    spearman,
+)
 from wam_tpu.evalsuite.packing import array_to_coeffs2d, coeffs_to_array2d
 from wam_tpu.ops.filters import gaussian_filter2d, superpixel_sum, upsample_nearest
 from wam_tpu.wavelets import wavedec2, waverec2
@@ -95,6 +102,7 @@ class Eval2DWAM:
         self.mesh = mesh
         self.data_axis = data_axis
         self._probs_fn = make_probs_fn(model_fn, batch_size, mesh, data_axis)
+        self._auc_runners: dict = {}
         self.grad_wams = None
         self.insertion_curves = []
         self.deletion_curves = []
@@ -137,30 +145,49 @@ class Eval2DWAM:
 
     # -- insertion / deletion ---------------------------------------------
 
+    def _perturb_for_auc(self, img, wam, mode: str, n_iter: int):
+        """One sample's perturbation fan: resize the mosaic into the packed
+        coefficient domain (equal for haar on dyadic sizes), build the mask
+        family, reconstruct."""
+        image01 = self.denormalize_fn(img)
+        coeffs = wavedec2(image01, self.wavelet, self.J, self.mode)
+        ph, pw = coeffs_to_array2d(coeffs).shape[-2:]
+        if wam.shape != (ph, pw):  # static shapes
+            wam = jax.image.resize(wam, (ph, pw), method="nearest")
+        ins, dele = generate_masks(n_iter, wam)
+        masks = ins if mode == "insertion" else dele
+        return self._masked_reconstructions(image01, masks)
+
     def evaluate_auc(self, x, y, mode: str, n_iter: int = 64):
         """Per-sample AUC of class probability along the nested mask family
-        (`src/evaluators.py:605-647`). Returns (scores, curves)."""
+        (`src/evaluators.py:605-647`). Returns (scores, curves).
+
+        Single-device path: ONE jit dispatch for the whole batch
+        (`batched_auc_runner`). Mesh path: per-image sharded perturbation
+        fan (the fan itself spans the mesh)."""
         x = jnp.asarray(x)
         y = np.asarray(y)
         wams = self.precompute(x, y)
 
-        @jax.jit
-        def perturb_one(img, wam):
-            image01 = self.denormalize_fn(img)
-            coeffs = wavedec2(image01, self.wavelet, self.J, self.mode)
-            ph, pw = coeffs_to_array2d(coeffs).shape[-2:]
-            if wam.shape != (ph, pw):  # static shapes — equal for haar/dyadic
-                wam = jax.image.resize(wam, (ph, pw), method="nearest")
-            ins, dele = generate_masks(n_iter, wam)
-            masks = ins if mode == "insertion" else dele
-            return self._masked_reconstructions(image01, masks)
+        if self.mesh is None:
+            key = (mode, n_iter, x.shape[1:], wams.shape[1:])
+            runner = self._auc_runners.get(key)
+            if runner is None:
+                runner = batched_auc_runner(
+                    lambda img, wam: self._perturb_for_auc(img, wam, mode, n_iter),
+                    self.model_fn,
+                    images_per_chunk=max(1, self.batch_size // (n_iter + 1)),
+                )
+                self._auc_runners[key] = runner
+            scores, ps = runner(x, wams, jnp.asarray(y))
+            return [float(v) for v in scores], [np.asarray(p) for p in ps]
 
+        perturb_one = jax.jit(
+            lambda img, wam: self._perturb_for_auc(img, wam, mode, n_iter)
+        )
         scores, curves = [], []
         for s in range(x.shape[0]):
-            # resize the mosaic to the packed domain if they differ (equal
-            # for haar on dyadic sizes)
-            wam = wams[s]
-            inputs = perturb_one(x[s], wam)
+            inputs = perturb_one(x[s], wams[s])
             probs = self._probs_for(inputs, int(y[s]))
             scores.append(float(compute_auc(probs)))
             curves.append(np.asarray(probs))
@@ -232,8 +259,9 @@ class Eval2DWAM:
             probs_alt = self._probs_for(reconstruct(x[s], masks_grid), label)
             deltas = base_probs[s, label] - probs_alt
 
-            # attribution mass per superpixel of the (blurred) mosaic; edge
-            # cells keep partial mass (superpixel_sum zero-pads)
+            # attribution mass per superpixel of the (blurred) mosaic; every
+            # pixel lands in the same cell the mask upsample maps it to
+            # (superpixel_sum's nearest-resize partition)
             cell_sums = superpixel_sum(wam, grid_size).reshape(-1)
             attrs = jnp.asarray(onehot) @ cell_sums
 

@@ -16,7 +16,14 @@ import numpy as np
 
 from wam_tpu.evalsuite import baselines as B
 from wam_tpu.evalsuite.eval2d import _minmax01, imagenet_denormalize, imagenet_preprocess
-from wam_tpu.evalsuite.metrics import compute_auc, generate_masks, make_probs_fn, softmax_probs, spearman
+from wam_tpu.evalsuite.metrics import (
+    batched_auc_runner,
+    compute_auc,
+    generate_masks,
+    make_probs_fn,
+    softmax_probs,
+    spearman,
+)
 from wam_tpu.ops.filters import gaussian_filter2d, superpixel_sum, upsample_nearest
 
 __all__ = ["EvalImageBaselines", "EvalAudioBaselines", "IMAGE_METHODS", "AUDIO_METHODS"]
@@ -78,6 +85,7 @@ class _BaseEvalBaselines:
 
         self.model_fn = model_fn
         self._probs_fn = make_probs_fn(model_fn, batch_size, mesh, data_axis)
+        self._auc_runners: dict = {}
 
     def compute_explanations(self, x, y) -> jax.Array:
         """(B, H, W) maps in the perturbation domain
@@ -127,11 +135,28 @@ class _BaseEvalBaselines:
         y = np.asarray(y)
         expl = self.precompute(x, y)
 
+        def inputs_fn(x_s, expl_s):
+            ins, dele = generate_masks(n_iter, expl_s)
+            masks = ins if mode == "insertion" else dele
+            return self._perturb(x_s, masks)
+
+        if self.mesh is None:
+            # one jit dispatch for the whole batch (VERDICT.md round-1 #6)
+            key = (mode, n_iter, x.shape[1:], tuple(expl.shape[1:]))
+            runner = self._auc_runners.get(key)
+            if runner is None:
+                runner = batched_auc_runner(
+                    inputs_fn,
+                    self.model_fn,
+                    images_per_chunk=max(1, self.batch_size // (n_iter + 1)),
+                )
+                self._auc_runners[key] = runner
+            scores, ps = runner(x, expl, jnp.asarray(y))
+            return [float(v) for v in scores], [np.asarray(p) for p in ps]
+
         scores, curves = [], []
         for s in range(x.shape[0]):
-            ins, dele = generate_masks(n_iter, expl[s])
-            masks = ins if mode == "insertion" else dele
-            inputs = self._perturb(x[s], masks)
+            inputs = inputs_fn(x[s], expl[s])
             probs = self._probs_for(inputs, int(y[s]))
             scores.append(float(compute_auc(probs)))
             curves.append(np.asarray(probs))
@@ -206,7 +231,8 @@ class EvalImageBaselines(_BaseEvalBaselines):
             probs = self._probs_for(self._perturb(x[s], masks), label)
             deltas = base_probs[s, label] - probs
 
-            # edge cells keep partial mass (superpixel_sum zero-pads)
+            # every pixel lands in the same cell the mask upsample maps it to
+            # (superpixel_sum's nearest-resize partition)
             cell = superpixel_sum(attr_map, grid_size).reshape(-1)
             attrs = jnp.asarray(onehot) @ cell
             results.append(float(spearman(deltas, attrs)))

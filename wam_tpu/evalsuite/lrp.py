@@ -85,28 +85,6 @@ def _conv_site(x_in, W, b, stride, R, rule: str, eps: float):
     return _rho_step(_conv_fwd(W, b, stride), x_in, R, eps)
 
 
-def _stem_conv_fwd(W, b):
-    def f(t):
-        out = lax.conv_general_dilated(t, W, (2, 2), [(3, 3), (3, 3)], dimension_numbers=_DN)
-        return out if b is None else out + b
-    return f
-
-
-def _stem_site(x_in, W, b, R, rule: str, eps: float):
-    if rule == "flat":
-        ones_W = jnp.ones_like(W)
-        ones_x = jnp.ones_like(x_in)
-
-        def zfwd(t):
-            return lax.conv_general_dilated(t, ones_W, (2, 2), [(3, 3), (3, 3)],
-                                            dimension_numbers=_DN)
-
-        z, vjp = jax.vjp(zfwd, ones_x)
-        (c,) = vjp(R / _stab(z, eps))
-        return ones_x * c
-    return _rho_step(_stem_conv_fwd(W, b), x_in, R, eps)
-
-
 def _maxpool_route(x_in, R):
     """Winner-take-all relevance routing through the 3x3/2 stem pool."""
     pool = lambda t: nn.max_pool(t, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))
@@ -256,10 +234,10 @@ def lrp_resnet(
                                    stride, R_res, conv_rule, eps)
             R = R_main + R_res
 
-    # ---- stem ---------------------------------------------------------------
+    # ---- stem (7x7/2 conv = _conv_fwd's pad L//2 = 3 at stride 2) ----------
     R = _maxpool_route(stem_relu, R)
-    R = _stem_site(inp, params["conv1"]["kernel"], _bn_bias(params, "bn1"),
-                   R, first_rule, eps)
+    R = _conv_site(inp, params["conv1"]["kernel"], _bn_bias(params, "bn1"),
+                   2, R, first_rule, eps)
 
     # input relevance map, channel-summed (input layout is always NHWC here)
     return R.sum(axis=-1)

@@ -65,6 +65,10 @@ class ViT(nn.Module):
         for i in range(self.depth):
             x = EncoderBlock(self.heads, self.mlp_hidden, name=f"block{i}")(x)
         self.sow("intermediates", "tokens", x)
+        # Gradient tap for the GradCAM-family baselines (token-grid CAM):
+        # no-op unless a 'perturbations' collection is passed
+        # (wam_tpu.evalsuite.baselines._acts_and_grads).
+        x = self.perturb("tokens", x)
         x = nn.LayerNorm(name="ln")(x)
         return nn.Dense(self.num_classes, name="head")(x[:, 0])
 
