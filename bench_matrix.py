@@ -81,7 +81,11 @@ def main():
 
     # 1. base single-image pass ------------------------------------------------
     image = 64 if q else 224
-    use_rewrites = not args.f32  # keep the f32 reference config rewrite-free
+    # --f32 disables the PARAMETER rewrites (fold_bn / stem_s2d) along with
+    # bf16. Execution-form rewrites that are unconditional in the models
+    # (PatchConv patch embeddings, vit.py/convnext.py) still apply; the
+    # pre-rewrite baselines are the recorded round-1 rows in BASELINE.md.
+    use_rewrites = not args.f32
     fn50 = vision_fn(resnet50, image, fold_bn=use_rewrites,
                      stem_s2d=use_rewrites and image % 2 == 0)
     base = BaseWAM2D(fn50, wavelet="haar", J=3, mode="reflect")

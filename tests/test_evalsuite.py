@@ -537,3 +537,31 @@ def test_lrp_resnet_walker_bottleneck_validates_against_autodiff():
     logits = bind_inference(model, variables, nchw=True)(x)
     picked = np.take_along_axis(np.asarray(logits), np.asarray(y)[:, None], 1)[:, 0]
     np.testing.assert_allclose(np.asarray(repf.sum(axis=(1, 2))), picked, rtol=1e-4, atol=1e-5)
+
+
+def test_batched_auc_fan_chunked_matches_unchunked():
+    """When one sample's fan exceeds batch_size, the runner chunks the model
+    forward within the fan (memory cap honored) with identical results."""
+    from wam_tpu.evalsuite.metrics import batched_auc_runner, generate_masks
+
+    model = TinyImgModel()
+    variables = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 16, 16, 3)))
+
+    def model_fn(v):
+        return model.apply(variables, jnp.transpose(v, (0, 2, 3, 1)))
+
+    rng = np.random.default_rng(4)
+    x = jnp.asarray(rng.standard_normal((3, 3, 16, 16)), dtype=jnp.float32)
+    expl = jnp.asarray(rng.standard_normal((3, 16, 16)), dtype=jnp.float32)
+    y = jnp.array([0, 1, 2])
+
+    def inputs_fn(x_s, e_s):
+        ins, _ = generate_masks(8, e_s)
+        return x_s[None] * ins[:, None]
+
+    plain = batched_auc_runner(inputs_fn, model_fn, images_per_chunk=1)
+    chunked = batched_auc_runner(inputs_fn, model_fn, images_per_chunk=1, fan_chunk=4)
+    s0, c0 = plain(x, expl, y)
+    s1, c1 = chunked(x, expl, y)
+    np.testing.assert_allclose(np.asarray(c0), np.asarray(c1), atol=1e-6)
+    np.testing.assert_allclose(np.asarray(s0), np.asarray(s1), atol=1e-6)

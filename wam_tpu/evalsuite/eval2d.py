@@ -23,10 +23,10 @@ import jax.numpy as jnp
 import numpy as np
 
 from wam_tpu.evalsuite.metrics import (
-    batched_auc_runner,
     compute_auc,
     generate_masks,
     make_probs_fn,
+    run_cached_auc,
     softmax_probs,
     spearman,
 )
@@ -170,17 +170,17 @@ class Eval2DWAM:
         wams = self.precompute(x, y)
 
         if self.mesh is None:
-            key = (mode, n_iter, x.shape[1:], wams.shape[1:])
-            runner = self._auc_runners.get(key)
-            if runner is None:
-                runner = batched_auc_runner(
-                    lambda img, wam: self._perturb_for_auc(img, wam, mode, n_iter),
-                    self.model_fn,
-                    images_per_chunk=max(1, self.batch_size // (n_iter + 1)),
-                )
-                self._auc_runners[key] = runner
-            scores, ps = runner(x, wams, jnp.asarray(y))
-            return [float(v) for v in scores], [np.asarray(p) for p in ps]
+            return run_cached_auc(
+                self._auc_runners,
+                (mode, tuple(wams.shape[1:])),
+                lambda img, wam: self._perturb_for_auc(img, wam, mode, n_iter),
+                self.model_fn,
+                self.batch_size,
+                n_iter,
+                x,
+                wams,
+                y,
+            )
 
         perturb_one = jax.jit(
             lambda img, wam: self._perturb_for_auc(img, wam, mode, n_iter)

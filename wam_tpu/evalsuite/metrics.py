@@ -20,6 +20,7 @@ __all__ = [
     "spearman",
     "make_probs_fn",
     "batched_auc_runner",
+    "run_cached_auc",
 ]
 
 
@@ -93,7 +94,11 @@ def spearman(a: jax.Array, b: jax.Array) -> jax.Array:
 
 
 def batched_auc_runner(
-    inputs_fn, model_fn, images_per_chunk: int, return_logits: bool = False
+    inputs_fn,
+    model_fn,
+    images_per_chunk: int,
+    return_logits: bool = False,
+    fan_chunk: int | None = None,
 ):
     """One-jit-dispatch insertion/deletion evaluation across an image batch.
 
@@ -108,15 +113,24 @@ def batched_auc_runner(
 
     ``inputs_fn(x_s, expl_s) -> (M, ...)`` builds one sample's perturbation
     fan (mask generation included; ``expl_s`` may be any pytree).
-    ``return_logits=True`` returns raw logits rows (the 1D input-fidelity
-    argmax path) instead of (scores, prob_curves).
+    ``fan_chunk`` bounds the model rows WITHIN one sample's fan (an inner
+    lax.map) for when the fan alone exceeds the caller's batch-size memory
+    cap. ``return_logits=True`` returns raw logits rows (the 1D
+    input-fidelity argmax path) instead of (scores, prob_curves).
     """
+
+    def forward(inputs):
+        if fan_chunk is not None and fan_chunk < inputs.shape[0]:
+            return jax.lax.map(
+                lambda r: model_fn(r[None])[0], inputs, batch_size=fan_chunk
+            )
+        return model_fn(inputs)
 
     @jax.jit
     def run(xb, explb, yb):
         def one(args):
             xs, es, lab = args
-            logits = model_fn(inputs_fn(xs, es))
+            logits = forward(inputs_fn(xs, es))
             if return_logits:
                 return logits
             return jnp.take(softmax_probs(logits), lab, axis=1)
@@ -127,6 +141,42 @@ def batched_auc_runner(
         return compute_auc(out), out
 
     return run
+
+
+def run_cached_auc(
+    cache: dict,
+    key_extra,
+    inputs_fn,
+    model_fn,
+    batch_size: int,
+    n_iter: int,
+    x,
+    expl,
+    y,
+    return_logits: bool = False,
+):
+    """Memoized `batched_auc_runner` invocation shared by the evaluators.
+
+    Chunk geometry honors the caller's ``batch_size`` memory cap in both
+    regimes: several images per chunk when the fan is small, an inner
+    fan-chunked forward when one sample's fan alone exceeds it."""
+    import numpy as np
+
+    M = n_iter + 1
+    images_per_chunk = max(1, batch_size // M)
+    fan_chunk = batch_size if (images_per_chunk == 1 and M > batch_size) else None
+    key = (n_iter, return_logits, tuple(x.shape[1:]), key_extra)
+    runner = cache.get(key)
+    if runner is None:
+        runner = batched_auc_runner(
+            inputs_fn, model_fn, images_per_chunk, return_logits, fan_chunk
+        )
+        cache[key] = runner
+    out = runner(x, expl, jnp.asarray(y))
+    if return_logits:
+        return list(np.asarray(out))
+    scores, ps = out
+    return [float(v) for v in scores], [np.asarray(p) for p in ps]
 
 
 def make_probs_fn(model_fn, batch_size: int = 128, mesh=None, data_axis: str = "data"):

@@ -15,6 +15,8 @@ from typing import Sequence
 import flax.linen as nn
 import jax.numpy as jnp
 
+from wam_tpu.models.patchconv import PatchConv
+
 __all__ = ["ConvNeXt", "convnext_tiny", "convnext_test"]
 
 
@@ -41,12 +43,14 @@ class ConvNeXt(nn.Module):
     @nn.compact
     def __call__(self, x, train: bool = False):
         """x: (B, H, W, C) NHWC → logits."""
-        x = nn.Conv(self.dims[0], (4, 4), (4, 4), padding="VALID", name="stem_conv")(x)
+        # stride==kernel conv as matmul: same {kernel,bias} params, MXU-fast
+        # input gradient (see models/patchconv.py)
+        x = PatchConv(self.dims[0], 4, name="stem_conv")(x)
         x = nn.LayerNorm(name="stem_ln")(x)
         for stage, (depth, dim) in enumerate(zip(self.depths, self.dims)):
             if stage > 0:
                 x = nn.LayerNorm(name=f"down{stage}_ln")(x)
-                x = nn.Conv(dim, (2, 2), (2, 2), padding="VALID", name=f"down{stage}_conv")(x)
+                x = PatchConv(dim, 2, name=f"down{stage}_conv")(x)
             for i in range(depth):
                 x = ConvNeXtBlock(dim, name=f"stage{stage}_block{i}")(x)
             self.sow("intermediates", f"stage{stage + 1}", x)

@@ -1,0 +1,49 @@
+"""Non-overlapping (stride == kernel) convolution as extract-patches+matmul.
+
+When a conv's stride equals its kernel size (ViT patch embedding, ConvNeXt
+stem and stage downsamplers), the operation is exactly a block reshape
+followed by one (p·p·C → features) matmul. The parameters are kept as the
+conv's ``{kernel: (p, p, C, features), bias}`` so checkpoint ingestion is
+unchanged; only the execution form differs.
+
+Why: XLA lowers the CONV form's input gradient to a stride-p transposed
+convolution that is catastrophically slow on TPU — 82 ms per call on v5e
+for ViT-B/16's 16×16 embedding, 93% of the whole IG attribution graph
+(round-2 trace; the rewrite took the ViT IG workload from 1.37 to 15.1
+items/s). The matmul form's VJP is a matmul + free reshape.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+__all__ = ["PatchConv"]
+
+
+class PatchConv(nn.Module):
+    """(B, H, W, C) → (B, H//p, W//p, features); VALID semantics (H, W
+    remainders cropped, matching Conv(kernel=p, stride=p, VALID))."""
+
+    features: int
+    patch: int
+    use_bias: bool = True
+
+    @nn.compact
+    def __call__(self, x):
+        p, C = self.patch, x.shape[-1]
+        kernel = self.param(
+            "kernel", nn.initializers.lecun_normal(), (p, p, C, self.features),
+            jnp.float32,
+        )
+        B, H, W, _ = x.shape
+        if H % p or W % p:
+            x = x[:, : H // p * p, : W // p * p]
+            H, W = x.shape[1], x.shape[2]
+        x = x.reshape(B, H // p, p, W // p, p, C)
+        x = x.transpose(0, 1, 3, 2, 4, 5).reshape(B, H // p, W // p, p * p * C)
+        out = x @ kernel.reshape(-1, self.features).astype(x.dtype)
+        if self.use_bias:
+            bias = self.param("bias", nn.initializers.zeros, (self.features,), jnp.float32)
+            out = out + bias.astype(x.dtype)
+        return out

@@ -13,6 +13,8 @@ from functools import partial
 import flax.linen as nn
 import jax.numpy as jnp
 
+from wam_tpu.models.patchconv import PatchConv
+
 __all__ = ["ViT", "vit_b16", "vit_tiny_test"]
 
 
@@ -53,8 +55,10 @@ class ViT(nn.Module):
     def __call__(self, x, train: bool = False):
         """x: (B, H, W, C) NHWC → logits (B, num_classes)."""
         B = x.shape[0]
-        x = nn.Conv(self.dim, (self.patch, self.patch), (self.patch, self.patch),
-                    padding="VALID", name="patch_embed")(x)
+        # Patch embedding as extract-patches+matmul (same {kernel, bias}
+        # params as the conv form; see models/patchconv.py for why — the
+        # conv form's input gradient is pathologically slow on TPU).
+        x = PatchConv(self.dim, self.patch, name="patch_embed")(x)
         x = x.reshape(B, -1, self.dim)
         cls = self.param("cls_token", nn.initializers.zeros, (1, 1, self.dim))
         x = jnp.concatenate([jnp.tile(cls, (B, 1, 1)), x], axis=1)

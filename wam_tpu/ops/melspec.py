@@ -63,8 +63,21 @@ def stft_power(x: jax.Array, n_fft: int = 1024, hop: int | None = None, center: 
         x = jnp.pad(x, pad, mode="reflect")
     L = x.shape[-1]
     n_frames = 1 + (L - n_fft) // hop
-    idx = np.arange(n_frames)[:, None] * hop + np.arange(n_fft)[None, :]
-    frames = x[..., idx]  # (..., n_frames, n_fft)
+    if n_fft % hop == 0:
+        # Framing as k shifted reshape views (hop divides n_fft): frame i is
+        # the concatenation of hop-blocks i..i+k-1. Bitwise-identical to the
+        # gather below, but XLA lowers it to slices — the gather form cost
+        # 121 ms of the 419 ms audio attribution step on v5e (round-2 trace:
+        # a 441k-index gather plus its scatter-add VJP).
+        k = n_fft // hop
+        nb = n_frames + k - 1
+        blocks = x[..., : nb * hop].reshape(x.shape[:-1] + (nb, hop))
+        frames = jnp.concatenate(
+            [blocks[..., j : j + n_frames, :] for j in range(k)], axis=-1
+        )
+    else:
+        idx = np.arange(n_frames)[:, None] * hop + np.arange(n_fft)[None, :]
+        frames = x[..., idx]  # (..., n_frames, n_fft)
     window = jnp.asarray(np.hanning(n_fft + 1)[:-1], dtype=x.dtype)  # periodic Hann
     spec = jnp.fft.rfft(frames * window, axis=-1)
     return jnp.abs(spec) ** 2
