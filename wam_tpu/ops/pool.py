@@ -1,0 +1,146 @@
+"""Stem max-pool (3x3, stride 2, pad 1) with a Pallas TPU backward.
+
+XLA's native VJP for this pool is a `select-and-scatter` that tiles poorly
+on TPU (~20 ms of the round-2 flagship attribution step at effective batch
+800, ~9%). Two pure-XLA rewrites were tried and REVERTED in round 2 — the
+custom_vjp graph boundary made XLA materialize the forward reduce-window
+and residuals in hostile layouts, costing more than the scatter saved
+(BASELINE.md ablation). This kernel avoids both problems:
+
+- the forward stays `nn.max_pool` (fused by XLA as usual) and the ONLY
+  residual is the pool input `x` — the pooled output is recomputed inside
+  the backward kernel from the VMEM-resident tile, so no extra tensor is
+  materialized between forward and backward;
+- the backward runs one grid step per image: recompute y = maxpool(x),
+  then route the cotangent with equality masks evaluated per input phase.
+  Everything is unstrided reshape/max/where ops on VMEM blocks.
+
+Routing semantics: gradient is distributed to EVERY element equal to its
+window max (not just the first, as select-and-scatter routes). The
+systematic tie case — ReLU zero-plateaus feeding the stem pool — is
+annihilated by the adjacent ReLU VJP (those positions have pre-activation
+<= 0), so only accidental equal-value collisions differ; SmoothGrad's
+noise floor dominates those.
+
+Off-TPU (or for odd spatial sizes) the VJP falls back to XLA's own.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+__all__ = ["max_pool_stem"]
+
+_POOL = dict(window_shape=(3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))
+
+
+def _plain_pool(x):
+    return nn.max_pool(x, **_POOL)
+
+
+def _bwd_kernel(x_ref, g_ref, gx_ref):
+    # f32 internally: Mosaic's vector compare doesn't support bf16 on this
+    # target, and the equality routing must be exact (f32 embeds bf16).
+    out_dtype = x_ref.dtype
+    x = x_ref[0].astype(jnp.float32)  # (H, W, C)
+    g = g_ref[0].astype(jnp.float32)  # (H//2, W//2, C)
+    H, W, C = x.shape
+    Ho, Wo = H // 2, W // 2
+    neg = jnp.asarray(-jnp.inf, x.dtype)
+
+    # ---- recompute y = maxpool(x) with unstrided ops --------------------
+    xp = jnp.pad(x, ((1, 1), (1, 1), (0, 0)), constant_values=-jnp.inf)
+    # row triples {2i, 2i+1, 2i+2}: pair-max via reshape + next pair's head
+    rb = xp.reshape(Ho + 1, 2, W + 2, C)
+    rp = jnp.maximum(rb[:, 0], rb[:, 1])  # (Ho+1, W+2, C) pair max
+    rows = jnp.maximum(rp[:Ho], rb[1:, 0])  # (Ho, W+2, C) triple max
+    cb = rows.reshape(Ho, Wo + 1, 2, C)
+    cp = jnp.maximum(cb[:, :, 0], cb[:, :, 1])
+    y = jnp.maximum(cp[:, :Wo], cb[:, 1:, 0])  # (Ho, Wo, C)
+
+    # ---- shifted window views (w+1 along rows / cols), guarded ----------
+    yR = jnp.concatenate([y[1:], jnp.full_like(y[:1], neg)], axis=0)
+    gR = jnp.concatenate([g[1:], jnp.zeros_like(g[:1])], axis=0)
+
+    def cshift(a, fill):
+        return jnp.concatenate([a[:, 1:], jnp.full_like(a[:, :1], fill)], axis=1)
+
+    yC, gC = cshift(y, neg), cshift(g, 0)
+    yRC, gRC = cshift(yR, neg), cshift(gR, 0)
+
+    # ---- per-phase routing ---------------------------------------------
+    # Input (2q+a, 2r+b) belongs to windows (q+da, r+db): even coords have
+    # one window per axis, odd coords two (kernel 3, stride 2, pad 1).
+    xv = x.reshape(Ho, 2, Wo, 2, C)
+
+    def route(xph, taps):
+        acc = jnp.zeros_like(xph)
+        for yy, gg in taps:
+            acc = acc + jnp.where(xph == yy, gg, jnp.zeros_like(gg))
+        return acc
+
+    p00 = route(xv[:, 0, :, 0], [(y, g)])
+    p10 = route(xv[:, 1, :, 0], [(y, g), (yR, gR)])
+    p01 = route(xv[:, 0, :, 1], [(y, g), (yC, gC)])
+    p11 = route(xv[:, 1, :, 1], [(y, g), (yR, gR), (yC, gC), (yRC, gRC)])
+
+    gx = jnp.stack(
+        [jnp.stack([p00, p01], axis=2), jnp.stack([p10, p11], axis=2)], axis=1
+    )  # (Ho, 2, Wo, 2, C)
+    gx_ref[0] = gx.reshape(H, W, C).astype(out_dtype)
+
+
+def _bwd_pallas(x, g):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    B, H, W, C = x.shape
+    Ho, Wo = H // 2, W // 2
+    # The kernel's temporaries exceed Mosaic's conservative 16 MB scoped
+    # VMEM default at 112² x 64; raise the limit (v5e has far more VMEM).
+    params = pltpu.CompilerParams(vmem_limit_bytes=120 * 2**20)
+    return pl.pallas_call(
+        _bwd_kernel,
+        grid=(B,),
+        in_specs=[
+            pl.BlockSpec((1, H, W, C), lambda i: (i, 0, 0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, Ho, Wo, C), lambda i: (i, 0, 0, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, H, W, C), lambda i: (i, 0, 0, 0), memory_space=pltpu.VMEM
+        ),
+        out_shape=jax.ShapeDtypeStruct((B, H, W, C), x.dtype),
+        compiler_params=params,
+    )(x, g)
+
+
+@jax.custom_vjp
+def max_pool_stem(x):
+    return _plain_pool(x)
+
+
+def _fwd(x):
+    return _plain_pool(x), x
+
+
+def _bwd(x, g):
+    H, W = x.shape[1], x.shape[2]
+    # bf16 only: the kernel's working set at f32 slightly exceeds the v5e
+    # 128 MB VMEM for the 112²x64 stem (measured 129.9 MB); bf16 — the
+    # production compute dtype — fits comfortably.
+    use_pallas = (
+        jax.default_backend() == "tpu"
+        and x.dtype == jnp.bfloat16
+        and H % 2 == 0
+        and W % 2 == 0
+        and x.ndim == 4
+    )
+    if not use_pallas:
+        _, vjp = jax.vjp(_plain_pool, x)
+        return (vjp(g)[0],)
+    return (_bwd_pallas(x, g),)
+
+
+max_pool_stem.defvjp(_fwd, _bwd)
