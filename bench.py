@@ -26,9 +26,14 @@ QUICK = "--quick" in sys.argv
 # similarity 0.9987 (measured, batch 8 n=25: SmoothGrad's σ=0.25·range noise
 # floor dominates bf16 rounding) for a 1.5-1.6x throughput gain on v5e.
 F32 = "--f32" in sys.argv
-# --dwt-bf16 additionally runs the wavelet transform itself in bf16
-# (cosine vs f32 path drops to ~0.977; ~3% faster). Off by default.
-DWT_BF16 = "--dwt-bf16" in sys.argv and not F32
+# bf16 DWT input is ON by default since round 3: the noisy input is cast to
+# bf16 at the DWT boundary INSIDE the step (noise stays f32 — identical
+# draws to the f32 path) and the transform accumulates f32 with f32 coeffs
+# out (wavelets/matmul.py). Measured cosine vs full-f32: 0.998655, i.e.
+# indistinguishable from the bf16 model alone (0.998633) — the round-2
+# 0.977 was the noise realization changing, not DWT rounding (BASELINE.md
+# round-3 note). Disable with --no-dwt-bf16.
+DWT_BF16 = "--no-dwt-bf16" not in sys.argv and not F32
 
 
 def tpu_throughput() -> float:
@@ -69,11 +74,17 @@ def tpu_throughput() -> float:
     @jax.jit
     def run(x, key):
         def step(noisy):
+            if DWT_BF16:
+                # cast at the DWT boundary, INSIDE the step: noise
+                # generation stays f32 (identical draws to the f32 path),
+                # the DWT reads bf16 and accumulates f32 (wavelets/matmul).
+                # Round-2 cast the whole input before SmoothGrad, which
+                # changed the noise realization itself — that, not DWT
+                # rounding, was most of the 0.977 cosine (BASELINE.md r3).
+                noisy = noisy.astype(jnp.bfloat16)
             _, grads = engine.attribute(noisy, y)
             return mosaic2d(grads, True)
 
-        if DWT_BF16:
-            x = x.astype(jnp.bfloat16)
         # Full sample-vmap (one chunk): measured fastest on v5e-1 — XLA
         # rematerializes to fit, and the MXU sees the largest batches. On the
         # CPU fallback keep chunks of one sample so host memory stays bounded.

@@ -112,11 +112,18 @@ def main():
         rows.append((p, float(np.mean(ious))))
         print(f"p={p:.2f}  mean IoU={rows[-1][1]:.3f}")
 
+    # Provenance column (VERDICT.md round-2 weak #5): smoke runs on
+    # synthetic images / random-init weights must not be mistakable for the
+    # reference's published-quality numbers (results/iou.csv).
+    img_src = "image-dir" if args.images else "synthetic-sines"
+    init_src = "checkpoint" if args.checkpoint else "random-init"
+    provenance = f"{img_src}+{init_src}"
+    comparable = bool(args.images and args.checkpoint)
     with open(args.out, "w") as f:
-        f.write(",iou\n")
+        f.write(",iou,provenance,comparable_to_reference\n")
         for p, v in rows:
-            f.write(f"{p},{v}\n")
-    print(f"wrote {args.out}")
+            f.write(f"{p},{v},{provenance},{comparable}\n")
+    print(f"wrote {args.out} (provenance: {provenance})")
 
 
 if __name__ == "__main__":

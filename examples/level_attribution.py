@@ -82,10 +82,13 @@ def main():
         header = ",".join(
             f"level_{j}_mean,level_{j}_std" for j in range(args.levels + 1)
         )
-        f.write(f"model,{header}\n")
+        # provenance column: this script runs random-init models on random
+        # noise images — NOT comparable to the reference's published
+        # results_variance.csv (VERDICT.md round-2 weak #5)
+        f.write(f"model,{header},provenance\n")
         for name, mean, std in zip(args.models, means, stds):
             cells = ",".join(f"{m},{s}" for m, s in zip(mean, std))
-            f.write(f"{name},{cells}\n")
+            f.write(f"{name},{cells},random-noise-images+random-init\n")
 
     fig = visualize_gradients_at_levels(
         means, title=f"Per-level attribution ({args.wavelet})",

@@ -1,0 +1,75 @@
+"""Attribution-drift check for reduced-precision configs (BASELINE.md
+ablation: cosine similarity of flagship SmoothGrad mosaics vs the f32 path).
+
+Prints one JSON line with cosine(f32, bf16-model) and
+cosine(f32, bf16-model+bf16-DWT) on a b8 n25 flagship slice.
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    from wam_tpu.config import enable_compilation_cache, ensure_usable_backend
+
+    platform = ensure_usable_backend(timeout_s=180.0)
+    enable_compilation_cache()
+
+    import jax
+    import jax.numpy as jnp
+
+    from wam_tpu.core.engine import WamEngine
+    from wam_tpu.core.estimators import smoothgrad
+    from wam_tpu.models import bind_inference, resnet50
+    from wam_tpu.ops.packing2d import mosaic2d
+
+    batch, n_samples, image = 8, 25, 224
+    model = resnet50(num_classes=1000)
+    variables = model.init(jax.random.PRNGKey(0), jnp.zeros((1, image, image, 3)))
+    x = jax.random.normal(jax.random.PRNGKey(1), (batch, 3, image, image), jnp.float32)
+    y = jnp.arange(batch, dtype=jnp.int32) % 1000
+    key = jax.random.PRNGKey(42)
+
+    def mosaic_for(compute_dtype, dwt_bf16):
+        model_fn = bind_inference(
+            model, variables, nchw=True, compute_dtype=compute_dtype,
+            fold_bn=compute_dtype is not None,
+        )
+        engine = WamEngine(model_fn, ndim=2, wavelet="db4", level=3, mode="reflect")
+
+        def step(noisy):
+            if dwt_bf16:
+                # cast inside the step: same noise draws as the f32 path
+                noisy = noisy.astype(jnp.bfloat16)
+            _, grads = engine.attribute(noisy, y)
+            return mosaic2d(grads, True)
+
+        @jax.jit
+        def run(x, key):
+            return smoothgrad(step, x, key, n_samples=n_samples,
+                              stdev_spread=0.25, batch_size=n_samples)
+
+        return run(x, key)
+
+    def cosine(a, b):
+        a = jnp.ravel(a).astype(jnp.float64)
+        b = jnp.ravel(b).astype(jnp.float64)
+        return float(
+            (a @ b) / (jnp.linalg.norm(a) * jnp.linalg.norm(b))
+        )
+
+    ref = mosaic_for(None, False)
+    bf16 = mosaic_for(jnp.bfloat16, False)
+    bf16_dwt = mosaic_for(jnp.bfloat16, True)
+    print(json.dumps({
+        "platform": platform,
+        "cosine_bf16_model": round(cosine(ref, bf16), 6),
+        "cosine_bf16_model_bf16_dwt": round(cosine(ref, bf16_dwt), 6),
+    }))
+
+
+if __name__ == "__main__":
+    main()

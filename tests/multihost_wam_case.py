@@ -1,0 +1,58 @@
+"""Shared WAM workload for the 2-process multihost parity test.
+
+Everything here is deterministic given the fixed seeds (Flax init, input
+draw, SmoothGrad noise via threefry), so two cluster processes and the
+single-process golden build IDENTICAL computations over the same global
+(4 data × 2 sample) mesh — making exact-equality assertions meaningful.
+Used by tests/test_multihost.py (VERDICT.md round-2 next #4).
+"""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+
+def build_case():
+    from wam_tpu.core.engine import WamEngine
+    from wam_tpu.evalsuite.eval2d import Eval2DWAM
+    from wam_tpu.models import bind_inference, resnet18
+    from wam_tpu.ops.packing2d import mosaic2d
+    from wam_tpu.parallel import sharded_smoothgrad
+
+    model = resnet18(num_classes=5)
+    variables = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 16, 16, 3)))
+    model_fn = bind_inference(model, variables, nchw=True)
+    engine = WamEngine(model_fn, ndim=2, wavelet="haar", level=2, mode="reflect")
+
+    rng = np.random.default_rng(5)
+    x = jnp.asarray(rng.standard_normal((4, 3, 16, 16)), dtype=jnp.float32)
+    y = jnp.arange(4) % 5
+
+    def step(noisy):
+        _, grads = engine.attribute(noisy, y)
+        return mosaic2d(grads, True)
+
+    def smoothgrad_runner(mesh):
+        runner = sharded_smoothgrad(step, mesh, n_samples=4, stdev_spread=0.25)
+        return runner(x, jax.random.PRNGKey(7))
+
+    fixed_maps = jnp.asarray(rng.standard_normal((2, 16, 16)), dtype=jnp.float32)
+    x_eval = x[:2]
+    y_eval = [1, 3]
+
+    def insertion_runner(mesh):
+        ev = Eval2DWAM(
+            model_fn,
+            explainer=lambda xx, yy: fixed_maps,
+            wavelet="haar",
+            J=2,
+            batch_size=8,
+            mesh=mesh,
+        )
+        return ev.insertion(x_eval, y_eval, n_iter=4)
+
+    return {
+        "smoothgrad_runner": smoothgrad_runner,
+        "insertion_runner": insertion_runner,
+    }

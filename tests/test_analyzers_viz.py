@@ -71,6 +71,7 @@ def model_fn():
     return lambda x: m.apply(p, x)
 
 
+@pytest.mark.slow
 def test_analyzer_necessary_components(model_fn):
     from wam_tpu.wam2d import WaveletAttribution2D
 

@@ -177,6 +177,7 @@ def test_mnist3d_loaders(tmp_path):
     assert got[0][0].shape[0] == 3 and got[1][0].shape[0] == 1
 
 
+@pytest.mark.slow
 def test_model_registry_and_orbax_roundtrip(tmp_path):
     import jax.numpy as jnp
 

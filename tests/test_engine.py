@@ -11,6 +11,11 @@ from wam_tpu.core.engine import WamEngine, target_loss
 from wam_tpu.core.estimators import integrated_path, noise_sigma, smoothgrad, trapezoid
 from wam_tpu.wavelets import wavedec2
 
+# slow tier (VERDICT.md round-2 #7): heavyweight compiles / subprocesses;
+# core tier is pytest -m 'not slow' (see PARITY.md)
+pytestmark = pytest.mark.slow
+
+
 
 def _linear_model(W):
     """x (B,C,H,W) -> logits (B,K) via flattened matmul."""

@@ -3,6 +3,7 @@ runnable: `entry()` jit-compiles single-device, `dryrun_multichip` executes
 the full sharded SmoothGrad step on the virtual 8-device CPU mesh
 (conftest.py forces the cpu platform and 8 host devices)."""
 
+import pytest
 import os
 import subprocess
 import sys
@@ -16,6 +17,11 @@ _REPO = Path(__file__).resolve().parent.parent
 sys.path.insert(0, str(_REPO))
 
 import __graft_entry__ as graft  # noqa: E402
+
+# slow tier (VERDICT.md round-2 #7): heavyweight compiles / subprocesses;
+# core tier is pytest -m 'not slow' (see PARITY.md)
+pytestmark = pytest.mark.slow
+
 
 
 def test_entry_jit_compiles_and_runs():

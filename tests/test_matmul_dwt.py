@@ -15,6 +15,11 @@ from wam_tpu.wavelets import matmul as mm
 from wam_tpu.wavelets.filters import build_wavelet
 from wam_tpu.wavelets.transform import _analysis, _synthesis
 
+# slow tier (VERDICT.md round-2 #7): heavyweight compiles / subprocesses;
+# core tier is pytest -m 'not slow' (see PARITY.md)
+pytestmark = pytest.mark.slow
+
+
 
 WAVELETS = ["haar", "db4", "sym3"]
 MODES = ["zero", "reflect", "symmetric", "periodic", "constant"]
@@ -129,3 +134,47 @@ def test_matmul_roundtrip():
         sub = mm.analysis2_mm(x, "db4", mode)
         rec = mm.synthesis2_mm(sub, "db4", (24, 24))
         np.testing.assert_allclose(rec, x, atol=1e-4)
+
+
+def test_pallas_bf16_in_f32_accumulate():
+    """bf16 inputs are accepted directly (half HBM traffic) with f32
+    accumulation and FLOAT32 coefficients out, so the multi-level cascade
+    never re-rounds (VERDICT.md round-2 #6)."""
+    x = jax.random.normal(jax.random.PRNGKey(9), (2, 32, 32), jnp.float32)
+    ref = mm.dwt2_pallas(x, "db4", "reflect")
+    got = mm.dwt2_pallas(x.astype(jnp.bfloat16), "db4", "reflect")
+    assert ref.dtype == jnp.float32 and got.dtype == jnp.float32
+    # only the one-time input rounding separates the two paths
+    scale = float(jnp.abs(ref).max())
+    assert float(jnp.abs(got - ref).max()) < 0.01 * scale
+    cos = float(
+        (ref * got).sum()
+        / (jnp.linalg.norm(ref.ravel()) * jnp.linalg.norm(got.ravel()))
+    )
+    assert cos > 0.9999
+
+    # gradient flows back in the INPUT dtype
+    g = jax.grad(lambda t: mm.dwt2_pallas(t, "db4", "reflect").sum())(
+        x.astype(jnp.bfloat16)
+    )
+    assert g.dtype == jnp.bfloat16
+
+
+@pytest.mark.parametrize("impl", ["pallas", "matmul", "conv"])
+def test_wavedec2_bf16_cascade_stays_f32(impl):
+    """End-to-end multi-level wavedec2 with bf16 input: every backend
+    returns f32 coefficients (bf16-in/f32-accumulate policy lives in the
+    dwt2 dispatch, not just the pallas kernel) and tracks the f32 path."""
+    tf.set_dwt2_impl(impl)
+    try:
+        x = jax.random.normal(jax.random.PRNGKey(10), (1, 48, 48), jnp.float32)
+        ref = tf.wavedec2(x, "db4", 2, "reflect")
+        got = tf.wavedec2(x.astype(jnp.bfloat16), "db4", 2, "reflect")
+        assert got[0].dtype == jnp.float32
+        assert got[1].diagonal.dtype == jnp.float32
+        for r, g in zip(jax.tree_util.tree_leaves(ref), jax.tree_util.tree_leaves(got)):
+            rn = jnp.linalg.norm(r.ravel()) * jnp.linalg.norm(g.ravel())
+            cos = float((r * g).sum() / rn) if float(rn) else 1.0
+            assert cos > 0.999
+    finally:
+        tf.set_dwt2_impl("auto")

@@ -2,6 +2,7 @@
 shapes, dB clamping, filterbank geometry, differentiability, approximate
 invertibility (SURVEY.md §7.2 'differentiating through the melspec')."""
 
+import pytest
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -58,6 +59,7 @@ def test_amplitude_to_db_clamp():
     np.testing.assert_allclose(out, [-100.0, 0.0, 20.0], atol=1e-4)
 
 
+@pytest.mark.slow
 def test_melspectrogram_shape_and_grad():
     x = jnp.asarray(np.random.default_rng(1).standard_normal((2, 2048)), dtype=jnp.float32)
     mel = melspectrogram(x, sample_rate=8000, n_fft=256, n_mels=32)

@@ -9,6 +9,11 @@ import pytest
 
 from wam_tpu.models import bind_inference, resnet18, resnet50, torch_resnet_to_flax
 
+# slow tier (VERDICT.md round-2 #7): heavyweight compiles / subprocesses;
+# core tier is pytest -m 'not slow' (see PARITY.md)
+pytestmark = pytest.mark.slow
+
+
 
 def test_resnet18_forward_shape():
     model = resnet18(num_classes=10)

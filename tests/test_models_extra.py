@@ -1,11 +1,17 @@
 """ViT / ConvNeXt smoke tests + config/results/profiling infrastructure."""
 
+import pytest
 import argparse
 import os
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+# slow tier (VERDICT.md round-2 #7): heavyweight compiles / subprocesses;
+# core tier is pytest -m 'not slow' (see PARITY.md)
+pytestmark = pytest.mark.slow
+
 
 
 def test_vit_forward():

@@ -12,12 +12,18 @@ checks the global result against the analytic value.
 SURVEY.md §5.8; runs on CPU only (no TPU needed).
 """
 
+import pytest
 import os
 import socket
 import subprocess
 import sys
 import textwrap
 from pathlib import Path
+
+# slow tier (VERDICT.md round-2 #7): heavyweight compiles / subprocesses;
+# core tier is pytest -m 'not slow' (see PARITY.md)
+pytestmark = pytest.mark.slow
+
 
 _REPO = Path(__file__).resolve().parent.parent
 
@@ -77,6 +83,102 @@ def _free_port() -> int:
     with socket.socket() as s:
         s.bind(("127.0.0.1", 0))
         return s.getsockname()[1]
+
+
+# Real-WAM cluster worker (VERDICT.md round-2 next #4): the actual
+# attribution pipeline (sharded SmoothGrad over a WamEngine step on a tiny
+# ResNet) and a mesh-attached Eval2DWAM insertion run ACROSS the process
+# boundary, and every process checks the gathered global result against the
+# single-process 8-device golden the pytest process computed beforehand.
+_WAM_WORKER = textwrap.dedent(
+    """
+    import os, sys
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=4"
+    ).strip()
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.experimental import multihost_utils
+
+    sys.path.insert(0, {repo!r})
+    from wam_tpu.parallel.multihost import hybrid_mesh, init_distributed
+
+    pid = int(sys.argv[1])
+    golden_path = sys.argv[2]
+    init_distributed(
+        coordinator_address={coord!r}, num_processes=2, process_id=pid
+    )
+    mesh = hybrid_mesh({{"data": -1, "sample": 2}}, dcn_axis="data")
+    assert mesh.shape == {{"data": 4, "sample": 2}}
+
+    from tests.multihost_wam_case import build_case
+
+    case = build_case()
+    out = case["smoothgrad_runner"](mesh)
+    full = np.asarray(multihost_utils.process_allgather(out, tiled=True))
+
+    ins = case["insertion_runner"](mesh)
+
+    golden = np.load(golden_path)
+    # not bitwise: the 2-process partitioner lowers the cross-host mean with
+    # a different reduction tree than single-process (measured max diff
+    # 1.8e-7); everything else in the step is identical
+    np.testing.assert_allclose(full, golden["mosaic"], atol=1e-5, rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(ins), golden["ins"], atol=1e-6)
+    print(f"WAMWORKER{{pid}}_OK", flush=True)
+    """
+)
+
+
+def test_two_process_real_wam_matches_single_process(tmp_path):
+    """sharded_smoothgrad + Eval2DWAM.insertion on a 2-process hybrid mesh
+    reproduce the single-process 8-device result exactly."""
+    import numpy as np
+
+    from tests.multihost_wam_case import build_case
+    from wam_tpu.parallel import hybrid_mesh
+
+    # golden: same global mesh shape, one process, 8 devices
+    case = build_case()
+    mesh = hybrid_mesh({"data": 4, "sample": 2})
+    golden_mosaic = np.asarray(case["smoothgrad_runner"](mesh))
+    golden_ins = np.asarray(case["insertion_runner"](mesh))
+    golden_path = tmp_path / "golden.npz"
+    np.savez(golden_path, mosaic=golden_mosaic, ins=golden_ins)
+
+    coord = f"127.0.0.1:{_free_port()}"
+    code = _WAM_WORKER.format(repo=str(_REPO), coord=coord)
+    env = {
+        k: v
+        for k, v in os.environ.items()
+        if k not in ("JAX_PLATFORMS", "XLA_FLAGS")
+    }
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c", code, str(pid), str(golden_path)],
+            cwd=str(_REPO),
+            env=env,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+        )
+        for pid in (0, 1)
+    ]
+    outs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=540)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        outs.append(out)
+    for pid, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"worker {pid} failed:\n{out[-4000:]}"
+        assert f"WAMWORKER{pid}_OK" in out, out[-2000:]
 
 
 def test_two_process_distributed_end_to_end():

@@ -1,0 +1,409 @@
+"""End-to-end cross-framework attribution oracle (VERDICT.md round-2
+missing #1 / next-round #2).
+
+Restates the REFERENCE pipeline semantics — conv-based wavedec2/waverec2
+with reflect padding, requires_grad coefficient leaves, diag-logit-mean
+backward, and the dyadic gradient mosaic (`lib/wam_2D.py:79-131,200-264`) —
+entirely in torch, on weights shared with the Flax model, sharing NO code
+with `wam_tpu`'s JAX path. A convention drift anywhere in the chain
+(detail-orientation swap, mosaic quadrant layout, normalization order,
+padding phase) fails these tests even if wam_tpu stays self-consistent.
+
+Wavelet filter banks are hard-coded from their published values (Daubechies
+1988 / pywt's tables) rather than imported, so the oracle also pins
+`wam_tpu.wavelets.filters` against an independent source.
+"""
+
+import numpy as np
+import pytest
+import torch
+import torch.nn.functional as F
+
+import jax
+import jax.numpy as jnp
+
+# slow tier (VERDICT.md round-2 #7): heavyweight compiles / subprocesses;
+# core tier is pytest -m 'not slow' (see PARITY.md)
+pytestmark = pytest.mark.slow
+
+
+# -- independent filter constants (pywt's printed db4/haar banks) -----------
+
+SQ2 = 1.0 / np.sqrt(2.0)
+HAAR = {
+    "dec_lo": [SQ2, SQ2],
+    "dec_hi": [-SQ2, SQ2],
+    "rec_lo": [SQ2, SQ2],
+    "rec_hi": [SQ2, -SQ2],
+}
+_DB4_DEC_LO = [
+    -0.010597401784997278,
+    0.032883011666982945,
+    0.030841381835986965,
+    -0.18703481171888114,
+    -0.02798376941698385,
+    0.6308807679295904,
+    0.7148465705525415,
+    0.23037781330885523,
+]
+DB4 = {
+    "dec_lo": _DB4_DEC_LO,
+    # orthogonal QMF relations (pywt's sign convention): rec_lo =
+    # reverse(dec_lo), dec_hi[k] = (-1)^(k+1) · dec_lo[L-1-k],
+    # rec_hi = reverse(dec_hi)
+    "rec_lo": _DB4_DEC_LO[::-1],
+    "dec_hi": [((-1) ** (k + 1)) * _DB4_DEC_LO[-1 - k] for k in range(8)],
+}
+DB4["rec_hi"] = DB4["dec_hi"][::-1]
+BANKS = {"haar": HAAR, "db4": DB4}
+
+
+def test_filter_tables_match_independent_constants():
+    from wam_tpu.wavelets.filters import build_wavelet
+
+    for name, bank in BANKS.items():
+        wav = build_wavelet(name)
+        for part in ("dec_lo", "dec_hi", "rec_lo", "rec_hi"):
+            np.testing.assert_allclose(
+                np.asarray(getattr(wav, part), dtype=np.float64),
+                np.asarray(bank[part], dtype=np.float64),
+                atol=1e-12,
+                err_msg=f"{name}.{part}",
+            )
+
+
+# -- torch restatement of the reference DWT pipeline ------------------------
+
+
+def _kernels(wavelet: str):
+    bank = BANKS[wavelet]
+    # analysis: pywt correlates with the REVERSED decomposition filter
+    lo = torch.tensor(bank["dec_lo"][::-1], dtype=torch.float32)
+    hi = torch.tensor(bank["dec_hi"][::-1], dtype=torch.float32)
+    akern = torch.stack([torch.outer(a, b) for a in (lo, hi) for b in (lo, hi)])[
+        :, None
+    ]  # (4, 1, L, L) — channel order (row, col): aa, ad, da, dd
+    rlo = torch.tensor(bank["rec_lo"], dtype=torch.float32)
+    rhi = torch.tensor(bank["rec_hi"], dtype=torch.float32)
+    skern = torch.stack([torch.outer(a, b) for a in (rlo, rhi) for b in (rlo, rhi)])[
+        :, None
+    ]
+    return akern, skern, len(bank["dec_lo"])
+
+
+def torch_wavedec2(x, wavelet: str, J: int):
+    """ptwt.wavedec2 semantics (reflect mode): per level pad L-1 per side
+    with reflect, correlate the flipped filters at stride 2 keeping odd
+    phases. x: (B, C, H, W) → [cA, (cH, cV, cD)_J, ..., (cH, cV, cD)_1],
+    each (B, C, h, w); shapes list for the inverse."""
+    akern, _, L = _kernels(wavelet)
+    B, C = x.shape[:2]
+    a = x.reshape(B * C, 1, *x.shape[2:])
+    details, shapes = [], []
+    for _ in range(J):
+        shapes.append(a.shape[-2:])
+        xp = F.pad(a, (L - 1,) * 4, mode="reflect")[:, :, 1:, 1:]
+        c = F.conv2d(xp, akern, stride=2)
+        a = c[:, :1]
+        h, w = c.shape[-2:]
+        # (row, col) channels: 1 = lo-row/hi-col = vertical detail,
+        # 2 = hi-row/lo-col = horizontal, 3 = diagonal (pywt cH/cV/cD)
+        details.append(
+            (
+                c[:, 2].reshape(B, C, h, w),
+                c[:, 1].reshape(B, C, h, w),
+                c[:, 3].reshape(B, C, h, w),
+            )
+        )
+    cA = a[:, 0].reshape(B, C, *a.shape[-2:])
+    return [cA] + details[::-1], shapes[::-1]
+
+
+def torch_waverec2(coeffs, shapes, wavelet: str):
+    """Inverse: conv_transpose2d of the zero-stuffed subbands (true
+    convolution), trimming the full convolution by L-2 and cropping each
+    level to the recorded analysis input shape."""
+    _, skern, L = _kernels(wavelet)
+    cA = coeffs[0]
+    B, C = cA.shape[:2]
+    a = cA.reshape(B * C, 1, *cA.shape[-2:])
+    for (cH, cV, cD), hw in zip(coeffs[1:], shapes):
+        h, w = cH.shape[-2:]
+        a = a[:, :, :h, :w]
+        sub = torch.cat(
+            [
+                a,
+                cV.reshape(B * C, 1, h, w),
+                cH.reshape(B * C, 1, h, w),
+                cD.reshape(B * C, 1, h, w),
+            ],
+            dim=1,
+        )
+        a = F.conv_transpose2d(sub, skern, stride=2, padding=L - 2)
+        a = a[:, :, : hw[0], : hw[1]]
+    return a.reshape(B, C, *a.shape[-2:])
+
+
+def torch_mosaic(grad_coeffs, normalize: bool = True):
+    """`BaseWAM2D.visualize_grad_wam` (`lib/wam_2D.py:200-264`): channel-mean
+    → abs → per-block /max; approx top-left, per level (finest i=0):
+    diagonal [s:e, s:e], vertical [s:e, :s], horizontal [:s, s:e] with
+    s = S/2^{i+1}, e = S/2^i (the reference hard-codes S=224 at :238-239;
+    restated with the generic S its formula encodes)."""
+    size = 2 * grad_coeffs[-1][0].shape[-1]
+    B = grad_coeffs[0].shape[0]
+    out = np.zeros((B, size, size), dtype=np.float64)
+
+    def prep(t):
+        m = np.abs(np.asarray(t.detach().numpy(), dtype=np.float64).mean(axis=1))
+        return m / m.max() if (normalize and m.max() > 0) else m
+
+    approx = prep(grad_coeffs[0])
+    out[:, : approx.shape[1], : approx.shape[2]] = approx
+    for i, (cH, cV, cD) in enumerate(grad_coeffs[1:][::-1]):
+        e = size // (2**i)
+        s = size // (2 ** (i + 1))
+        b = e - s
+        out[:, s:e, s:e] = prep(cD)[:, :b, :b]
+        out[:, s:e, :s] = prep(cV)[:, :b, :s]
+        out[:, :s, s:e] = prep(cH)[:, :s, :b]
+    return out
+
+
+def torch_wam2d(tmodel, x, y, wavelet: str, J: int):
+    """The full reference single pass (`lib/wam_2D.py:79-131`): decompose,
+    require grads on every coefficient leaf, reconstruct, forward,
+    diag-logit-mean backward, mosaic of the coefficient gradients."""
+    coeffs, shapes = torch_wavedec2(x, wavelet, J)
+    leaves = [coeffs[0].detach().requires_grad_(True)]
+    for (cH, cV, cD) in coeffs[1:]:
+        leaves.append(
+            (
+                cH.detach().requires_grad_(True),
+                cV.detach().requires_grad_(True),
+                cD.detach().requires_grad_(True),
+            )
+        )
+    rec = torch_waverec2(leaves, shapes, wavelet)
+    out = tmodel(rec)
+    loss = torch.diag(out[:, y]).mean()
+    loss.backward()
+    grads = [leaves[0].grad] + [
+        (h.grad, v.grad, d.grad) for (h, v, d) in leaves[1:]
+    ]
+    return torch_mosaic(grads), rec
+
+
+# -- shared-weights fixtures ------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def shared_resnet():
+    from tests.torch_ref_models import TorchResNet18
+    from wam_tpu.models import bind_inference, resnet18, torch_resnet_to_flax
+
+    torch.manual_seed(7)
+    tmodel = TorchResNet18(num_classes=10).eval()
+    variables = torch_resnet_to_flax(tmodel.state_dict())
+    fmodel = resnet18(num_classes=10)
+    model_fn = bind_inference(fmodel, variables, nchw=True)
+    return tmodel, model_fn
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("wavelet,J", [("haar", 2), ("db4", 2)])
+def test_wam2d_mosaic_matches_torch_reference(shared_resnet, wavelet, J):
+    """Base-pass mosaic parity torch↔JAX on shared ResNet-18 weights."""
+    from wam_tpu.wam2d import BaseWAM2D
+
+    tmodel, model_fn = shared_resnet
+    rng = np.random.default_rng(31)
+    x = rng.standard_normal((2, 3, 64, 64)).astype(np.float32)
+    y = np.array([3, 7])
+
+    wam = BaseWAM2D(model_fn, wavelet=wavelet, J=J, mode="reflect")
+    ours = np.asarray(wam(jnp.asarray(x), jnp.asarray(y)), dtype=np.float64)
+
+    theirs, rec = torch_wam2d(tmodel, torch.tensor(x), torch.tensor(y), wavelet, J)
+
+    # the reconstruction must be a faithful inverse in both frameworks
+    np.testing.assert_allclose(rec.detach().numpy(), x, atol=1e-4)
+    assert ours.shape == theirs.shape
+    np.testing.assert_allclose(ours, theirs, atol=1e-4)
+
+
+@pytest.mark.slow
+def test_wam2d_smoothgrad_step_matches_torch_reference(shared_resnet):
+    """One SmoothGrad step with FIXED injected noise (not RNG-matched): the
+    reference's per-image σ = spread·(max−min) noisy pass
+    (`lib/wam_2D.py:379-415`) run through both pipelines."""
+    from wam_tpu.wam2d import BaseWAM2D
+
+    tmodel, model_fn = shared_resnet
+    rng = np.random.default_rng(33)
+    x = rng.standard_normal((2, 3, 64, 64)).astype(np.float32)
+    y = np.array([1, 5])
+    noise = rng.standard_normal(x.shape).astype(np.float32)
+    sigma = 0.25 * (x.max(axis=(1, 2, 3)) - x.min(axis=(1, 2, 3)))
+    noisy = x + noise * sigma[:, None, None, None]
+
+    wam = BaseWAM2D(model_fn, wavelet="db4", J=2, mode="reflect")
+    ours = np.asarray(wam(jnp.asarray(noisy), jnp.asarray(y)), dtype=np.float64)
+    theirs, _ = torch_wam2d(tmodel, torch.tensor(noisy), torch.tensor(y), "db4", 2)
+    np.testing.assert_allclose(ours, theirs, atol=1e-4)
+
+
+# -- 1D melspec-tap oracle (`lib/wam_1D.py:88-150`) -------------------------
+
+
+def _np_mel_fbank(n_freqs, n_mels, sr):
+    """HTK triangular filterbank, written independently from the formula
+    (torchaudio defaults: f_min=0, f_max=sr/2, no norm)."""
+    def hz2mel(f):
+        return 2595.0 * np.log10(1.0 + f / 700.0)
+
+    def mel2hz(m):
+        return 700.0 * (10.0 ** (m / 2595.0) - 1.0)
+
+    freqs = np.linspace(0.0, sr / 2.0, n_freqs)
+    pts = mel2hz(np.linspace(hz2mel(0.0), hz2mel(sr / 2.0), n_mels + 2))
+    fb = np.zeros((n_freqs, n_mels))
+    for m in range(n_mels):
+        rising = (freqs - pts[m]) / (pts[m + 1] - pts[m])
+        falling = (pts[m + 2] - freqs) / (pts[m + 2] - pts[m + 1])
+        fb[:, m] = np.maximum(0.0, np.minimum(rising, falling))
+    return fb.astype(np.float32)
+
+
+def torch_melspec_db(wave, sr, n_fft, n_mels):
+    """torchaudio ``MelSpectrogram`` defaults + ``AmplitudeToDB('power')``
+    restated (`lib/wam_1D.py:194-219`): hop = n_fft//2, centered reflect
+    pad, periodic Hann, |rfft|², HTK fbank, 10·log10(max(x, 1e-10)).
+    Returns (N, T, n_mels), time-major like the reference's transpose."""
+    hop = n_fft // 2
+    x = F.pad(wave[:, None], (n_fft // 2, n_fft // 2), mode="reflect")[:, 0]
+    frames = x.unfold(-1, n_fft, hop)  # (N, T, n_fft)
+    win = torch.hann_window(n_fft, periodic=True, dtype=wave.dtype)
+    spec = torch.fft.rfft(frames * win, dim=-1)
+    power = spec.real**2 + spec.imag**2
+    fb = torch.tensor(_np_mel_fbank(n_fft // 2 + 1, n_mels, sr), dtype=wave.dtype)
+    mel = power @ fb
+    return 10.0 * torch.log10(torch.clamp(mel, min=1e-10))
+
+
+def torch_wavedec1(x, wavelet, J):
+    bank = BANKS[wavelet]
+    L = len(bank["dec_lo"])
+    akern = torch.stack(
+        [
+            torch.tensor(bank["dec_lo"][::-1], dtype=torch.float32),
+            torch.tensor(bank["dec_hi"][::-1], dtype=torch.float32),
+        ]
+    )[:, None]
+    a = x[:, None]  # (N, 1, W)
+    details, lengths = [], []
+    for _ in range(J):
+        lengths.append(a.shape[-1])
+        xp = F.pad(a, (L - 1, L - 1), mode="reflect")[:, :, 1:]
+        c = F.conv1d(xp, akern, stride=2)
+        a = c[:, :1]
+        details.append(c[:, 1])
+    return [a[:, 0]] + details[::-1], lengths[::-1]
+
+
+def torch_waverec1(coeffs, lengths, wavelet):
+    bank = BANKS[wavelet]
+    L = len(bank["rec_lo"])
+    skern = torch.stack(
+        [
+            torch.tensor(bank["rec_lo"], dtype=torch.float32),
+            torch.tensor(bank["rec_hi"], dtype=torch.float32),
+        ]
+    )[:, None]
+    a = coeffs[0]
+    for d, n in zip(coeffs[1:], lengths):
+        a = a[..., : d.shape[-1]]
+        sub = torch.stack([a, d], dim=1)  # (N, 2, len)
+        a = F.conv_transpose1d(sub, skern, stride=2, padding=L - 2)[:, 0]
+        a = a[..., :n]
+    return a
+
+
+class _TorchAudioNet(torch.nn.Module):
+    """Tiny melspec classifier used on both sides with shared weights."""
+
+    def __init__(self, n_classes=4):
+        super().__init__()
+        self.conv = torch.nn.Conv2d(1, 6, 3, stride=2, padding=1)
+        self.fc = torch.nn.Linear(6, n_classes)
+
+    def forward(self, mel):  # (N, 1, T, M)
+        h = torch.relu(self.conv(mel))
+        return self.fc(h.mean(dim=(2, 3)))
+
+
+@pytest.mark.slow
+def test_wam1d_melspec_tap_matches_torch_reference():
+    """The 1D pipeline (`lib/wam_1D.py:88-150`): wavedec → requires_grad
+    leaves → waverec → melspec (retain_grad tap) → diag-logit-mean backward.
+    Compares BOTH gradient families (melspec tap and every coefficient
+    level) across frameworks on shared weights."""
+    import flax.linen as nn
+
+    from wam_tpu.wam1d import BaseWAM1D
+
+    torch.manual_seed(11)
+    tnet = _TorchAudioNet().eval()
+
+    class FlaxAudioNet(nn.Module):
+        @nn.compact
+        def __call__(self, mel):  # (N, 1, T, M) NCHW-style like the torch net
+            x = jnp.transpose(mel, (0, 2, 3, 1))
+            x = nn.Conv(6, (3, 3), strides=(2, 2), padding=1, name="conv")(x)
+            x = nn.relu(x).mean(axis=(1, 2))
+            return nn.Dense(4, name="fc")(x)
+
+    params = {
+        "conv": {
+            "kernel": jnp.asarray(
+                tnet.conv.weight.detach().numpy().transpose(2, 3, 1, 0)
+            ),
+            "bias": jnp.asarray(tnet.conv.bias.detach().numpy()),
+        },
+        "fc": {
+            "kernel": jnp.asarray(tnet.fc.weight.detach().numpy().T),
+            "bias": jnp.asarray(tnet.fc.bias.detach().numpy()),
+        },
+    }
+    fnet = FlaxAudioNet()
+    model_fn = lambda mel: fnet.apply({"params": params}, mel)
+
+    sr, n_fft, n_mels, J = 8000, 256, 32, 2
+    rng = np.random.default_rng(41)
+    wave = rng.standard_normal((2, 2048)).astype(np.float32)
+    wave /= wave.max(axis=-1, keepdims=True)  # pre-normalized on both sides
+    y = np.array([1, 3])
+
+    wam = BaseWAM1D(model_fn, wavelet="db4", J=J, mode="reflect",
+                    n_mels=n_mels, n_fft=n_fft, sample_rate=sr)
+    g_mel, g_coeffs = wam(jnp.asarray(wave), jnp.asarray(y))
+
+    # torch restatement
+    coeffs, lengths = torch_wavedec1(torch.tensor(wave), "db4", J)
+    leaves = [c.detach().requires_grad_(True) for c in coeffs]
+    rec = torch_waverec1(leaves, lengths, "db4")
+    mel = torch_melspec_db(rec, sr, n_fft, n_mels)[:, None]  # (N, 1, T, M)
+    mel.retain_grad()
+    out = tnet(mel)
+    loss = torch.diag(out[:, torch.tensor(y)]).mean()
+    loss.backward()
+
+    np.testing.assert_allclose(rec.detach().numpy(), wave, atol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(g_mel), mel.grad[:, 0].numpy(), atol=1e-5
+    )
+    assert len(g_coeffs) == len(leaves)
+    for ours, theirs in zip(g_coeffs, leaves):
+        np.testing.assert_allclose(
+            np.asarray(ours), theirs.grad.numpy(), atol=1e-5
+        )

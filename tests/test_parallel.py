@@ -12,6 +12,11 @@ from wam_tpu.core.estimators import smoothgrad
 from wam_tpu.ops.packing2d import mosaic2d
 from wam_tpu.parallel import data_sample_mesh, make_mesh, sharded_integrated_path, sharded_smoothgrad
 
+# slow tier (VERDICT.md round-2 #7): heavyweight compiles / subprocesses;
+# core tier is pytest -m 'not slow' (see PARITY.md)
+pytestmark = pytest.mark.slow
+
+
 
 def _need_devices(n=8):
     if len(jax.devices()) < n:

@@ -11,6 +11,11 @@ from wam_tpu.parallel import make_mesh
 from wam_tpu.parallel.halo import sharded_dwt_per, sharded_wavedec_per
 from wam_tpu.wavelets.periodized import dwt_per, idwt_per, wavedec_per, waverec_per
 
+# slow tier (VERDICT.md round-2 #7): heavyweight compiles / subprocesses;
+# core tier is pytest -m 'not slow' (see PARITY.md)
+pytestmark = pytest.mark.slow
+
+
 
 @pytest.mark.parametrize("wavelet", ["haar", "db2", "db4", "sym4"])
 def test_periodized_roundtrip_and_energy(wavelet):

@@ -16,6 +16,10 @@ from wam_tpu.wam1d import (
     scaleogram,
 )
 
+# slow tier (VERDICT.md round-2 #7): heavyweight compiles / subprocesses;
+# core tier is pytest -m 'not slow' (see PARITY.md)
+pytestmark = pytest.mark.slow
+
 SR, NFFT, NMELS, WLEN = 8000, 256, 32, 4096
 
 

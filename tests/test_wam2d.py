@@ -10,6 +10,11 @@ import pytest
 
 from wam_tpu.wam2d import BaseWAM2D, WaveletAttribution2D
 
+# slow tier (VERDICT.md round-2 #7): heavyweight compiles / subprocesses;
+# core tier is pytest -m 'not slow' (see PARITY.md)
+pytestmark = pytest.mark.slow
+
+
 
 class TinyCNN(nn.Module):
     classes: int = 7

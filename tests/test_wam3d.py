@@ -11,6 +11,11 @@ from wam_tpu.ops.packing3d import cube3d, visualize_cube
 from wam_tpu.wam3d import BaseWAM3D, WaveletAttribution3D, filter_coeffs
 from wam_tpu.wavelets import wavedec3
 
+# slow tier (VERDICT.md round-2 #7): heavyweight compiles / subprocesses;
+# core tier is pytest -m 'not slow' (see PARITY.md)
+pytestmark = pytest.mark.slow
+
+
 
 def _const_coeffs(J=2, size=16, batch=1):
     coeffs = []

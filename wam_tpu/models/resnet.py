@@ -138,6 +138,8 @@ class ResNet(nn.Module):
     act: Callable = nn.relu
     # Space-to-depth stem: same parameters, same function, cheaper input
     # gradient on TPU (see _StemConv).
+    # (A Pallas stem-pool backward was evaluated and REMOVED in round 3:
+    # measured slower than XLA's own select-and-scatter — BASELINE.md.)
     stem_s2d: bool = False
     # Post-linear hook threaded to every block (see BasicBlock.post_linear).
     post_linear: Callable = _identity

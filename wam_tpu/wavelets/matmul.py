@@ -170,7 +170,11 @@ def synthesis2_mm(subbands: jax.Array, wavelet, out_shape) -> jax.Array:
 
 
 def _fused_kernel(a_ref, bt_ref, x_ref, out_ref):
-    t = jnp.dot(a_ref[:], x_ref[0], preferred_element_type=jnp.float32,
+    # bf16 inputs are upcast HERE, in VMEM: HBM streams half the bytes while
+    # both matmuls still run with f32 operands/accumulators (VERDICT.md
+    # round-2 #6 — bf16-in/f32-accumulate).
+    t = jnp.dot(a_ref[:], x_ref[0].astype(jnp.float32),
+                preferred_element_type=jnp.float32,
                 precision=lax.Precision.HIGHEST)
     y = jnp.dot(t, bt_ref[:], preferred_element_type=jnp.float32,
                 precision=lax.Precision.HIGHEST)
@@ -212,18 +216,21 @@ def _dwt2_pallas_core(x3: jax.Array, A: jax.Array, Bt: jax.Array) -> jax.Array:
 
 
 def _core_fwd(x3, A, Bt):
-    return _pallas_forward(x3, A, Bt), (A, Bt)
+    # dtype token: custom_vjp residuals must be JAX values, so the input
+    # dtype rides along as a size-0 array
+    return _pallas_forward(x3, A, Bt), (A, Bt, jnp.zeros((0,), x3.dtype))
 
 
 def _core_bwd(res, g):
-    A, Bt = res
+    A, Bt, dtype_token = res
+    x_dtype = dtype_token.dtype
     h_out, w_out = g.shape[-2:]
     top = jnp.concatenate([g[:, 0], g[:, 1]], axis=-1)
     bot = jnp.concatenate([g[:, 2], g[:, 3]], axis=-1)
     gy = jnp.concatenate([top, bot], axis=-2)  # (n, 2h', 2w')
     dx = jnp.matmul(jnp.matmul(A.T, gy, precision=lax.Precision.HIGHEST), Bt.T,
                     precision=lax.Precision.HIGHEST)  # adjoint of y = A x B^T
-    return dx.astype(g.dtype), jnp.zeros_like(A), jnp.zeros_like(Bt)
+    return dx.astype(x_dtype), jnp.zeros_like(A), jnp.zeros_like(Bt)
 
 
 _dwt2_pallas_core.defvjp(_core_fwd, _core_bwd)
@@ -234,12 +241,19 @@ def dwt2_pallas(x: jax.Array, wavelet, mode: str) -> jax.Array:
 
     x: (..., H, W) -> (..., 4, H', W'), identical layout/values to
     `transform._analysis(x, wav, mode, 2)`; differentiable (custom VJP is the
-    exact adjoint matmul pair)."""
+    exact adjoint matmul pair).
+
+    bf16 inputs are accepted as-is (half the HBM read traffic) and upcast
+    inside the kernel; coefficients come back FLOAT32 in every case, so the
+    multi-level approx cascade never re-rounds to bf16 between levels — the
+    round-2 ablation measured that cascade costing cosine 0.9987 → 0.977
+    (VERDICT.md round-2 #6)."""
     h, w = x.shape[-2:]
     A = analysis_matrices(h, wavelet, mode, jnp.float32)
     B = analysis_matrices(w, wavelet, mode, jnp.float32)
     batch_shape = x.shape[:-2]
-    x3 = x.reshape((-1, h, w)).astype(jnp.float32)
+    x3 = x.reshape((-1, h, w))
+    if x3.dtype != jnp.bfloat16:
+        x3 = x3.astype(jnp.float32)
     out = _dwt2_pallas_core(x3, A, B.T)
-    out = out.astype(x.dtype)
     return out.reshape(batch_shape + out.shape[1:])

@@ -302,9 +302,17 @@ def waverec(coeffs: Sequence[jax.Array], wavelet):
 
 
 def dwt2(x: jax.Array, wavelet, mode: str = "reflect"):
-    """Single-level 2D DWT over the last two axes. Returns (cA, Detail2D)."""
+    """Single-level 2D DWT over the last two axes. Returns (cA, Detail2D).
+
+    bf16 inputs produce FLOAT32 coefficients on every backend (bf16-in /
+    f32-accumulate): the pallas kernel reads bf16 natively and upcasts in
+    VMEM; conv/matmul upcast at this dispatch so all three impls agree in
+    dtype and accuracy — the only bf16 effect is the one-time input
+    rounding, never a per-level coefficient re-round (VERDICT.md r2 #6)."""
     wav = _resolve(wavelet)
     impl = _resolved_dwt2_impl()
+    if x.dtype == jnp.bfloat16 and impl != "pallas":
+        x = x.astype(jnp.float32)
     if impl != "conv":
         from wam_tpu.wavelets import matmul as _mm
 
