@@ -53,7 +53,11 @@ def tpu_throughput() -> float:
     from wam_tpu.ops.packing2d import mosaic2d
 
     batch, n_samples, image = (4, 3, 64) if QUICK else (BATCH, N_SAMPLES, IMAGE)
-    chunk = n_samples if platform != "cpu" else 1
+    # Sample chunk 4 → model batch b32·4 = 128 rows per mapped step: the
+    # round-3 scaling study found 128-row steps the per-row throughput sweet
+    # spot on v5e (the round-2 full-vmap 800-row graph spills activations;
+    # BASELINE.md round-3 scaling table). CPU keeps chunks of one sample.
+    chunk = 4 if platform != "cpu" else 1
 
     # stem_s2d + fold_bn are value-preserving rewrites (see models/resnet.py)
     # measured worth ~2% together on the flagship step.
@@ -85,11 +89,12 @@ def tpu_throughput() -> float:
             _, grads = engine.attribute(noisy, y)
             return mosaic2d(grads, True)
 
-        # Full sample-vmap (one chunk): measured fastest on v5e-1 — XLA
-        # rematerializes to fit, and the MXU sees the largest batches. On the
-        # CPU fallback keep chunks of one sample so host memory stays bounded.
+        # materialize_noise=False: noise is drawn inside the sample map, so
+        # the (n_samples, B, 3, H, W) buffer (1.9 GB at b128) never hits HBM
+        # — worth ~3% on the flagship (BASELINE.md round-3 scaling table).
         return smoothgrad(
-            step, x, key, n_samples=n_samples, stdev_spread=0.25, batch_size=chunk
+            step, x, key, n_samples=n_samples, stdev_spread=0.25,
+            batch_size=chunk, materialize_noise=False,
         )
 
     from wam_tpu.profiling import bench_time

@@ -33,6 +33,8 @@ def main():
     p.add_argument("--s2d", action="store_true")
     p.add_argument("--dwt-bf16", action="store_true",
                    help="cast the noisy input to bf16 before the DWT")
+    p.add_argument("--stream-noise", action="store_true",
+                   help="draw noise inside the sample map (no (n,B,...) buffer)")
     p.add_argument("--wavelet", default="db4")
     p.add_argument("--level", type=int, default=3)
     p.add_argument("--repeats", type=int, default=3)
@@ -73,6 +75,9 @@ def main():
     chunk = args.chunk or args.n_samples
 
     def step(noisy):
+        if args.dwt_bf16:
+            # boundary cast inside the step (round-3): noise stays f32
+            noisy = noisy.astype(jnp.bfloat16)
         _, grads = engine.attribute(noisy, y)
         return mosaic2d(grads, True)
 
@@ -80,10 +85,9 @@ def main():
         step = jax.checkpoint(step)
 
     def run(x, key):
-        if args.dwt_bf16:
-            x = x.astype(jnp.bfloat16)
         return smoothgrad(step, x, key, n_samples=args.n_samples,
-                          stdev_spread=0.25, batch_size=chunk)
+                          stdev_spread=0.25, batch_size=chunk,
+                          materialize_noise=not args.stream_noise)
 
     run = jax.jit(run)
 
@@ -96,6 +100,7 @@ def main():
         "batch": args.batch, "n_samples": args.n_samples, "image": args.image,
         "chunk": chunk, "dtype": args.dtype, "dwt_impl": args.dwt_impl,
         "remat": args.remat, "fold_bn": args.fold_bn, "s2d": args.s2d,
+        "stream_noise": args.stream_noise,
         "step_s": round(t, 4),
         "images_per_s": round(args.batch / t, 2),
         "total_wall_s": round(wall, 1),

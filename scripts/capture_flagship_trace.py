@@ -1,0 +1,59 @@
+"""Capture a profiler trace of the flagship step (new round-3 schedule) for
+the layout-copy audit (VERDICT r2 #5): run with
+    python scripts/capture_flagship_trace.py /tmp/trace_flagship
+then aggregate per-op device time with
+    python scripts/xplane_ops.py /tmp/trace_flagship 40
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    logdir = sys.argv[1] if len(sys.argv) > 1 else "/tmp/trace_flagship"
+
+    from wam_tpu.config import enable_compilation_cache, ensure_usable_backend
+
+    ensure_usable_backend(timeout_s=180.0)
+    enable_compilation_cache()
+
+    import jax
+    import jax.numpy as jnp
+
+    from wam_tpu.core.engine import WamEngine
+    from wam_tpu.core.estimators import smoothgrad
+    from wam_tpu.models import bind_inference, resnet50
+    from wam_tpu.ops.packing2d import mosaic2d
+
+    batch, n_samples, image = 32, 25, 224
+    model = resnet50(num_classes=1000, stem_s2d=True)
+    variables = model.init(jax.random.PRNGKey(0), jnp.zeros((1, image, image, 3)))
+    model_fn = bind_inference(model, variables, nchw=True,
+                              compute_dtype=jnp.bfloat16, fold_bn=True)
+    engine = WamEngine(model_fn, ndim=2, wavelet="db4", level=3, mode="reflect")
+    x = jax.random.normal(jax.random.PRNGKey(1), (batch, 3, image, image), jnp.float32)
+    y = jnp.arange(batch, dtype=jnp.int32) % 1000
+
+    @jax.jit
+    def run(x, key):
+        def step(noisy):
+            noisy = noisy.astype(jnp.bfloat16)
+            _, grads = engine.attribute(noisy, y)
+            return mosaic2d(grads, True)
+
+        return smoothgrad(step, x, key, n_samples=n_samples, stdev_spread=0.25,
+                          batch_size=4, materialize_noise=False)
+
+    key = jax.random.PRNGKey(42)
+    run(x, key).block_until_ready()  # compile outside the trace
+    with jax.profiler.trace(logdir):
+        for _ in range(2):
+            out = run(x, key)
+        jax.tree_util.tree_leaves(out)[0].block_until_ready()
+    print(f"trace written to {logdir}")
+
+
+if __name__ == "__main__":
+    main()

@@ -145,3 +145,33 @@ def test_integrated_path_linear_grad():
     out = integrated_path(lambda cs: cs["a"], c, n_steps=n)
     # trapz of α over linspace(0,1,5) with dx=1: mean-ish = (0+.25+.5+.75+1) with ends halved = 2.0
     np.testing.assert_allclose(out, np.full((2, 2), 2.0), atol=1e-6)
+
+
+def test_smoothgrad_streaming_noise_semantics():
+    """materialize_noise=False: deterministic per key, exact mean-of-steps
+    at zero noise, and the same ESTIMATOR (different, equally valid draws)
+    as the materialized path — means converge with n_samples."""
+    x = jnp.asarray(np.random.default_rng(6).standard_normal((2, 1, 8, 8)), dtype=jnp.float32)
+    step = lambda v: v * 3.0
+    # zero noise: identical to the materialized path and to step(x)
+    out0 = smoothgrad(step, x, jax.random.PRNGKey(0), n_samples=4,
+                      stdev_spread=0.0, materialize_noise=False)
+    np.testing.assert_allclose(out0, x * 3.0, atol=1e-6)
+    # deterministic per key; different stream than materialized
+    a = smoothgrad(step, x, jax.random.PRNGKey(7), n_samples=32,
+                   stdev_spread=0.3, materialize_noise=False)
+    b = smoothgrad(step, x, jax.random.PRNGKey(7), n_samples=32,
+                   stdev_spread=0.3, materialize_noise=False)
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b))
+    m = smoothgrad(step, x, jax.random.PRNGKey(7), n_samples=32,
+                   stdev_spread=0.3)
+    # linear step: both estimators are unbiased around 3x — their difference
+    # is 3·(mean of 2·32 indep draws · σ); bound at 6 joint std devs
+    sig = float(noise_sigma(x, 0.3).max())
+    bound = 6.0 * 3.0 * sig * np.sqrt(2.0 / 32.0)
+    assert float(jnp.abs(a - m).max()) < bound
+    assert float(jnp.abs(a - m).max()) > 0.0  # genuinely different stream
+    # chunked streaming == unchunked streaming (same draws, same mean)
+    c = smoothgrad(step, x, jax.random.PRNGKey(7), n_samples=32,
+                   stdev_spread=0.3, batch_size=4, materialize_noise=False)
+    np.testing.assert_allclose(np.asarray(a), np.asarray(c), atol=1e-6)

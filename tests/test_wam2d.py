@@ -98,3 +98,37 @@ def test_sample_batching_equivalence(model_fn):
     a = WaveletAttribution2D(model_fn, J=2, n_samples=6, sample_batch_size=None)(x, jnp.array([1]))
     b = WaveletAttribution2D(model_fn, J=2, n_samples=6, sample_batch_size=3)(x, jnp.array([1]))
     np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+
+
+def test_smoothgrad_dwt_bf16_tracks_f32():
+    """dwt_bf16=True casts at the DWT boundary inside the step: same noise
+    draws as the f32 path, f32 coefficients out — the mosaic tracks the f32
+    result to bf16 input rounding (BASELINE.md round-3)."""
+    W = jnp.asarray(
+        np.random.default_rng(3).standard_normal((3 * 32 * 32, 5)), jnp.float32
+    )
+    fn = lambda x: x.reshape(x.shape[0], -1) @ W
+    x = jnp.asarray(np.random.default_rng(4).standard_normal((2, 3, 32, 32)), jnp.float32)
+    y = jnp.array([1, 3])
+    ref = WaveletAttribution2D(fn, wavelet="db4", J=2, n_samples=3)(x, y)
+    got = WaveletAttribution2D(fn, wavelet="db4", J=2, n_samples=3, dwt_bf16=True)(x, y)
+    a, b = np.asarray(ref).ravel(), np.asarray(got).ravel()
+    cos = float(a @ b / (np.linalg.norm(a) * np.linalg.norm(b)))
+    assert cos > 0.999
+
+
+def test_ig_dwt_bf16_tracks_f32():
+    """dwt_bf16 applies to the IG path too (boundary cast before decompose)."""
+    W = jnp.asarray(
+        np.random.default_rng(5).standard_normal((3 * 32 * 32, 5)), jnp.float32
+    )
+    fn = lambda x: x.reshape(x.shape[0], -1) @ W
+    x = jnp.asarray(np.random.default_rng(6).standard_normal((1, 3, 32, 32)), jnp.float32)
+    y = jnp.array([2])
+    ref = WaveletAttribution2D(fn, wavelet="db4", J=2, method="integratedgrad",
+                               n_samples=4)(x, y)
+    got = WaveletAttribution2D(fn, wavelet="db4", J=2, method="integratedgrad",
+                               n_samples=4, dwt_bf16=True)(x, y)
+    a, b = np.asarray(ref).ravel(), np.asarray(got).ravel()
+    cos = float(a @ b / (np.linalg.norm(a) * np.linalg.norm(b)))
+    assert cos > 0.999

@@ -40,6 +40,7 @@ def smoothgrad(
     n_samples: int,
     stdev_spread: float,
     batch_size: int | None = None,
+    materialize_noise: bool = True,
 ) -> Any:
     """Mean of `step_fn` over ``n_samples`` noisy copies of ``x``.
 
@@ -48,12 +49,26 @@ def smoothgrad(
     (chunked by ``batch_size``) so memory is bounded; the sample axis can
     also be sharded across devices by wrapping the caller in shard_map
     (wam_tpu.parallel).
+
+    ``materialize_noise=False`` draws each sample's noise INSIDE the map
+    body (keys via `fold_in`) instead of materializing the full
+    (n_samples, *x.shape) buffer up front — at the flagship's b128 that
+    buffer is 1.9 GB of HBM traffic. Different (equally valid) draws than
+    the materialized path: same σ, different stream.
     """
     sigma = noise_sigma(x, stdev_spread)
     sigma = sigma.reshape(sigma.shape + (1,) * (x.ndim - 1))
-    noise = jax.random.normal(key, (n_samples,) + x.shape, dtype=x.dtype) * sigma
+    if materialize_noise:
+        noise = jax.random.normal(key, (n_samples,) + x.shape, dtype=x.dtype) * sigma
+        outs = lax.map(lambda n: step_fn(x + n), noise, batch_size=batch_size)
+    else:
+        def body(i):
+            k = jax.random.fold_in(key, i)
+            n = jax.random.normal(k, x.shape, x.dtype) * sigma
+            return step_fn(x + n)
 
-    outs = lax.map(lambda n: step_fn(x + n), noise, batch_size=batch_size)
+        idx = jnp.arange(n_samples)
+        outs = lax.map(body, idx, batch_size=batch_size)
     return jax.tree_util.tree_map(lambda a: jnp.mean(a, axis=0), outs)
 
 

@@ -85,6 +85,12 @@ class WaveletAttribution2D(BaseWAM2D):
     σ = stdev_spread·(max−min) (`lib/wam_2D.py:379-415`).
     method="integratedgrad": trapezoidal path integral over α·coeffs scaled
     by the (normalized) input-coefficient mosaic (`lib/wam_2D.py:417-459`).
+
+    ``dwt_bf16=True`` casts each noisy input to bfloat16 at the DWT boundary
+    (inside the step — noise draws stay f32, and the transform accumulates
+    f32 with f32 coefficients out, `wam_tpu.wavelets.matmul`). Measured on
+    the flagship: same cosine vs f32 as the bf16 model alone (0.9987), ~2%
+    faster on v5e (BASELINE.md round-3).
     """
 
     def __init__(
@@ -100,6 +106,7 @@ class WaveletAttribution2D(BaseWAM2D):
         stdev_spread: float = 0.25,
         random_seed: int = 42,
         sample_batch_size: int | None = None,
+        dwt_bf16: bool = False,
     ):
         super().__init__(
             model_fn,
@@ -112,6 +119,7 @@ class WaveletAttribution2D(BaseWAM2D):
         if method not in ("smooth", "integratedgrad"):
             raise ValueError(f"Unknown method {method!r}")
         self.method = method
+        self.dwt_bf16 = dwt_bf16
         self.n_samples = n_samples
         self.stdev_spread = stdev_spread
         self.random_seed = random_seed
@@ -123,6 +131,8 @@ class WaveletAttribution2D(BaseWAM2D):
 
     def _smooth_impl(self, x, y, key):
         def step(noisy):
+            if self.dwt_bf16:
+                noisy = noisy.astype(jnp.bfloat16)
             _, grads = self.engine.attribute(noisy, y)
             return mosaic2d(grads, self.normalize_coeffs)
 
@@ -144,6 +154,10 @@ class WaveletAttribution2D(BaseWAM2D):
     # -- Integrated gradients ---------------------------------------------
 
     def _ig_impl(self, x, y):
+        if self.dwt_bf16:
+            # same boundary cast as the smooth path: the analysis reads
+            # bf16, coefficients come back f32 (wavelets f32-accumulate)
+            x = x.astype(jnp.bfloat16)
         coeffs = self.engine.decompose(x)
         baseline = mosaic2d(coeffs, normalize=True)
         spatial = x.shape[-2:]
