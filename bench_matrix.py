@@ -96,57 +96,48 @@ def main():
 
     # 2. flagship SmoothGrad ---------------------------------------------------
     batch, n = (4, 3) if q else (32, 25)
+    # round-3 schedule: 128-row sample chunks + bf16 DWT boundary cast
+    # (BASELINE.md scaling study; the other workloads measured fastest at
+    # full sample vmap, so only this row chunks)
     ex2 = WaveletAttribution2D(
         fn50, wavelet="db4", J=3, method="smooth", n_samples=n,
-        sample_batch_size=n if on_accel else 1,
+        sample_batch_size=(4 if not q else n) if on_accel else 1,
+        dwt_bf16=on_accel and not args.f32,
+        stream_noise=bool(on_accel),
     )
     x2 = jax.random.normal(jax.random.PRNGKey(2), (batch, 3, image, image), jnp.float32)
     y2 = jnp.arange(batch, dtype=jnp.int32) % 1000
     record(f"wam2d_smoothgrad_resnet50_b{batch}_db4_n{n}", batch,
            _timed(lambda: ex2(x2, y2), laps=laps), "images/s")
 
+    # Workloads 3-5 are built by bench_workloads.py — the SAME builders the
+    # chunk-sweep tuner uses, so tuning always measures this exact config.
+    from bench_workloads import audio_workload, vit_workload, vol_workload
+
     # 3. audio SmoothGrad ------------------------------------------------------
     # quick: shortest length whose melspec (hop 512, 129 frames) survives
-    # AudioCNN's six pooling stages + VALID conv; full: 5 s at 44.1 kHz (ESC-50)
+    # AudioCNN's six pooling stages + VALID conv; full: 5 s at 44.1 kHz
+    # (ESC-50). Full sample vmap measured fastest (round-3 chunk sweep).
     wave_len = 65536 if q else 220500
     ab, an = (2, 4) if q else (8, 50)
-    amodel = AudioCNN(num_classes=50)
-    mel_t = wave_len // 512 + 1
-    avars = amodel.init(jax.random.PRNGKey(0), jnp.zeros((1, 1, mel_t, 128)))
-    afn = bind_audio_inference(amodel, avars)
-    ex3 = WaveletAttribution1D(
-        afn, wavelet="db6", J=5, method="smooth", n_samples=an,
-        stdev_spread=0.001, sample_batch_size=an if on_accel else 1,
-    )
-    x3 = jax.random.normal(jax.random.PRNGKey(3), (ab, wave_len), jnp.float32)
-    y3 = jnp.arange(ab, dtype=jnp.int32) % 50
+    ex3, x3, y3 = audio_workload(an if on_accel else 1, b=ab, n=an,
+                                 wave_len=wave_len)
     record(f"wam1d_smoothgrad_audiocnn_b{ab}_db6_J5_n{an}", ab,
            _timed(lambda: ex3(x3, y3), laps=laps), "waveforms/s")
 
-    # 4. 3D SmoothGrad ---------------------------------------------------------
+    # 4. 3D SmoothGrad (full sample vmap fastest, round-3 sweep) ---------------
     size = 16 if q else 32
     vb, vn = (2, 3) if q else (8, 25)
-    vmodel = resnet3d_18(num_classes=10)
-    vvars = vmodel.init(jax.random.PRNGKey(0), jnp.zeros((1, 1, size, size, size)))
-    vfn = lambda v: vmodel.apply(vvars, v)
-    ex4 = WaveletAttribution3D(
-        vfn, wavelet="haar", J=2, method="smooth", n_samples=vn,
-        sample_batch_size=vn if on_accel else 1,
-    )
-    x4 = jax.random.normal(jax.random.PRNGKey(4), (vb, 1, size, size, size), jnp.float32)
-    y4 = jnp.arange(vb, dtype=jnp.int32) % 10
+    ex4, x4, y4 = vol_workload(vn if on_accel else 1, b=vb, n=vn, size=size)
     record(f"wam3d_smoothgrad_resnet3d18_b{vb}_{size}cube_haar_J2_n{vn}", vb,
            _timed(lambda: ex4(x4, y4), laps=laps), "volumes/s")
 
-    # 5. ViT IG path -----------------------------------------------------------
+    # 5. ViT IG path (chunk 16 marginally fastest, round-3 sweep) --------------
     steps = 4 if q else 64
-    vitfn = vision_fn(vit_b16, image)
-    ex5 = WaveletAttribution2D(
-        vitfn, wavelet="haar", J=3, method="integratedgrad", n_samples=steps,
-        sample_batch_size=(8 if on_accel else 1) if not q else steps,
+    ex5, x5, y5 = vit_workload(
+        (16 if on_accel else 1) if not q else steps,
+        steps=steps, image=image, compute_dtype=dtype,
     )
-    x5 = jax.random.normal(jax.random.PRNGKey(5), (1, 3, image, image), jnp.float32)
-    y5 = jnp.zeros((1,), jnp.int32)
     record(f"wam2d_ig_vitb16_path{steps}", 1,
            _timed(lambda: ex5(x5, y5), laps=laps))
 

@@ -1,0 +1,63 @@
+"""Shared builders for the non-flagship canonical workloads (audio 1D,
+3D volumes, ViT IG) used by BOTH `bench_matrix.py` (the recorded benchmark)
+and `scripts/sweep_chunks.py` (the chunk tuner) — one definition, so a
+sweep always measures exactly the config the benchmark runs
+(round-3 advisor finding)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def audio_workload(chunk, *, b: int = 8, n: int = 50, wave_len: int = 220500):
+    """WAM-1D SmoothGrad on the ESC-50-shaped AudioCNN (BASELINE.json #3).
+    Returns (explainer, x, y)."""
+    from wam_tpu.models.audio import AudioCNN, bind_audio_inference
+    from wam_tpu.wam1d import WaveletAttribution1D
+
+    amodel = AudioCNN(num_classes=50)
+    mel_t = wave_len // 512 + 1
+    avars = amodel.init(jax.random.PRNGKey(0), jnp.zeros((1, 1, mel_t, 128)))
+    ex = WaveletAttribution1D(
+        bind_audio_inference(amodel, avars), wavelet="db6", J=5,
+        method="smooth", n_samples=n, stdev_spread=0.001,
+        sample_batch_size=chunk,
+    )
+    x = jax.random.normal(jax.random.PRNGKey(3), (b, wave_len), jnp.float32)
+    y = jnp.arange(b, dtype=jnp.int32) % 50
+    return ex, x, y
+
+
+def vol_workload(chunk, *, b: int = 8, n: int = 25, size: int = 32):
+    """WAM-3D SmoothGrad on the zoo's 3D-ResNet-18 (BASELINE.json #4)."""
+    from wam_tpu.models.resnet3d import resnet3d_18
+    from wam_tpu.wam3d import WaveletAttribution3D
+
+    vmodel = resnet3d_18(num_classes=10)
+    vvars = vmodel.init(jax.random.PRNGKey(0), jnp.zeros((1, 1, size, size, size)))
+    ex = WaveletAttribution3D(
+        lambda v: vmodel.apply(vvars, v), wavelet="haar", J=2,
+        method="smooth", n_samples=n, sample_batch_size=chunk,
+    )
+    x = jax.random.normal(jax.random.PRNGKey(4), (b, 1, size, size, size), jnp.float32)
+    y = jnp.arange(b, dtype=jnp.int32) % 10
+    return ex, x, y
+
+
+def vit_workload(chunk, *, steps: int = 64, image: int = 224, compute_dtype=None):
+    """WAM-2D IG on ViT-B/16 (BASELINE.json #5)."""
+    from wam_tpu.models import bind_inference
+    from wam_tpu.models.vit import vit_b16
+    from wam_tpu.wam2d import WaveletAttribution2D
+
+    model = vit_b16(num_classes=1000)
+    variables = model.init(jax.random.PRNGKey(0), jnp.zeros((1, image, image, 3)))
+    fn = bind_inference(model, variables, nchw=True, compute_dtype=compute_dtype)
+    ex = WaveletAttribution2D(
+        fn, wavelet="haar", J=3, method="integratedgrad", n_samples=steps,
+        sample_batch_size=chunk,
+    )
+    x = jax.random.normal(jax.random.PRNGKey(5), (1, 3, image, image), jnp.float32)
+    y = jnp.zeros((1,), jnp.int32)
+    return ex, x, y

@@ -132,3 +132,27 @@ def test_ig_dwt_bf16_tracks_f32():
     a, b = np.asarray(ref).ravel(), np.asarray(got).ravel()
     cos = float(a @ b / (np.linalg.norm(a) * np.linalg.norm(b)))
     assert cos > 0.999
+
+
+def test_stream_noise_class_api(model_fn):
+    """stream_noise=True must be EXACTLY the engine-level
+    smoothgrad(materialize_noise=False) composition with the same key —
+    pins the class wiring (σ, seed, averaging), not just shapes."""
+    from wam_tpu.core.estimators import smoothgrad
+    from wam_tpu.ops.packing2d import mosaic2d
+
+    x = jnp.asarray(np.random.default_rng(7).standard_normal((1, 3, 32, 32)), jnp.float32)
+    y = jnp.array([1])
+    expl = WaveletAttribution2D(model_fn, J=2, n_samples=6, stream_noise=True,
+                                random_seed=11)
+    got = expl(x, y)
+
+    def step(noisy):
+        _, grads = expl.engine.attribute(noisy, y)
+        return mosaic2d(grads, True)
+
+    want = smoothgrad(step, x, jax.random.PRNGKey(11), n_samples=6,
+                      stdev_spread=0.25, materialize_noise=False)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-6)
+    # deterministic per seed
+    np.testing.assert_allclose(np.asarray(got), np.asarray(expl(x, y)), atol=1e-6)

@@ -91,6 +91,11 @@ class WaveletAttribution2D(BaseWAM2D):
     f32 with f32 coefficients out, `wam_tpu.wavelets.matmul`). Measured on
     the flagship: same cosine vs f32 as the bf16 model alone (0.9987), ~2%
     faster on v5e (BASELINE.md round-3).
+
+    ``stream_noise=True`` draws SmoothGrad noise inside the sample map
+    instead of materializing the (n_samples, B, C, H, W) buffer — different
+    (equally valid) draws, lower peak HBM, a few % faster at large batches
+    (`core.estimators.smoothgrad(materialize_noise=False)`).
     """
 
     def __init__(
@@ -107,6 +112,7 @@ class WaveletAttribution2D(BaseWAM2D):
         random_seed: int = 42,
         sample_batch_size: int | None = None,
         dwt_bf16: bool = False,
+        stream_noise: bool = False,
     ):
         super().__init__(
             model_fn,
@@ -120,6 +126,7 @@ class WaveletAttribution2D(BaseWAM2D):
             raise ValueError(f"Unknown method {method!r}")
         self.method = method
         self.dwt_bf16 = dwt_bf16
+        self.stream_noise = stream_noise
         self.n_samples = n_samples
         self.stdev_spread = stdev_spread
         self.random_seed = random_seed
@@ -143,6 +150,7 @@ class WaveletAttribution2D(BaseWAM2D):
             n_samples=self.n_samples,
             stdev_spread=self.stdev_spread,
             batch_size=self.sample_batch_size,
+            materialize_noise=not self.stream_noise,
         )
 
     def smooth_wam(self, x, y):
