@@ -36,16 +36,23 @@ F32 = "--f32" in sys.argv
 DWT_BF16 = "--no-dwt-bf16" not in sys.argv and not F32
 
 
-def tpu_throughput() -> float:
+def tpu_throughput() -> tuple[float, str]:
     from wam_tpu.config import enable_compilation_cache, ensure_usable_backend
 
-    platform = ensure_usable_backend(timeout_s=180.0)
+    ensure_usable_backend(timeout_s=180.0)
     enable_compilation_cache()
-    if platform == "cpu":
-        print("# accelerator unavailable; benching on CPU", file=sys.stderr)
 
     import jax
     import jax.numpy as jnp
+
+    # Resolve the backend that will ACTUALLY run: the axon tunnel is
+    # single-client, so a concurrent holder can demote this process to CPU
+    # after the probe succeeded (memory: axon-tpu-tunnel-gotchas). Every
+    # platform-dependent choice below (chunk, laps, warning, JSON field)
+    # keys on this, not on the pre-init probe result.
+    platform = jax.default_backend()
+    if platform == "cpu":
+        print("# accelerator unavailable; benching on CPU", file=sys.stderr)
 
     from wam_tpu.core.engine import WamEngine
     from wam_tpu.core.estimators import smoothgrad
@@ -105,7 +112,7 @@ def tpu_throughput() -> float:
     # pipelined caller sees, not RTT-per-step (BASELINE.md round-2 note).
     t = bench_time(run, x, key, repeats=2 if QUICK else 3,
                    laps=2 if (QUICK or platform == "cpu") else 6)
-    return batch / t
+    return batch / t, platform
 
 
 def cpu_baseline_throughput(full: bool = False) -> float:
@@ -234,7 +241,7 @@ def main():
             )
         )
         return
-    tpu = tpu_throughput()
+    tpu, backend = tpu_throughput()
     try:
         cpu = cpu_baseline_throughput()
     except Exception as e:  # baseline must never block reporting
@@ -250,6 +257,7 @@ def main():
                 "vs_baseline": round(vs, 2) if vs == vs else None,
                 "dtype": "f32" if F32 else ("bf16+dwt-bf16" if DWT_BF16 else "bf16"),
                 "baseline_dtype": "f32-torch-cpu",
+                "platform": backend,
             }
         )
     )

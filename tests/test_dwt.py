@@ -170,3 +170,20 @@ def test_jit_and_vmap():
     np.testing.assert_allclose(f(x)[..., :32], x, atol=1e-4)
     g = jax.vmap(lambda v: wavedec(v, "haar", level=1)[0])
     assert g(x).shape == (4, 16)
+
+
+def test_dwt_bf16_inputs_promote_to_f32_all_ranks():
+    """Framework-wide bf16-in/f32-accumulate: 1D and 3D transforms promote
+    bf16 inputs to f32 coefficients like the 2D dispatch (round 3)."""
+    from wam_tpu.wavelets.transform import dwt3
+
+    x1 = jax.random.normal(jax.random.PRNGKey(0), (2, 32), jnp.float32)
+    cA, cD = dwt(x1.astype(jnp.bfloat16), "db2", "symmetric")
+    assert cA.dtype == jnp.float32 and cD.dtype == jnp.float32
+    ref_cA, _ = dwt(x1, "db2", "symmetric")
+    assert float(jnp.abs(cA - ref_cA).max()) < 0.02 * float(jnp.abs(ref_cA).max())
+
+    x3 = jax.random.normal(jax.random.PRNGKey(1), (1, 8, 8, 8), jnp.float32)
+    a3, d3 = dwt3(x3.astype(jnp.bfloat16), "haar", "symmetric")
+    assert a3.dtype == jnp.float32
+    assert d3["ddd"].dtype == jnp.float32

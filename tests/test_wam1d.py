@@ -174,3 +174,28 @@ def test_voxel_model_smoke():
     variables = model.init(jax.random.PRNGKey(0), x)
     out = model.apply(variables, x)
     assert out.shape == (2, 10)
+
+
+def test_stream_noise_1d_matches_engine_composition(model_fn):
+    """stream_noise=True on the 1D class equals the engine-level
+    smoothgrad(materialize_noise=False) composition with the same key."""
+    from wam_tpu.core.estimators import smoothgrad
+
+    expl = WaveletAttribution1D(
+        model_fn, wavelet="haar", J=2, n_samples=3, n_fft=NFFT, n_mels=NMELS,
+        sample_rate=SR, stream_noise=True, random_seed=5, stdev_spread=0.01,
+    )
+    wave = jnp.asarray(
+        np.random.default_rng(8).standard_normal((2, WLEN)), jnp.float32
+    )
+    wave = wave / wave.max(axis=-1, keepdims=True)
+    y = jnp.array([0, 1])
+    g_mel, g_coeffs = expl(wave, y)
+
+    want = smoothgrad(
+        lambda noisy: expl._tap_grads(noisy, y), wave, jax.random.PRNGKey(5),
+        n_samples=3, stdev_spread=0.01, materialize_noise=False,
+    )
+    np.testing.assert_allclose(np.asarray(g_mel), np.asarray(want[0]), atol=1e-6)
+    for a, b in zip(g_coeffs, want[1]):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)

@@ -163,6 +163,7 @@ class WaveletAttribution1D(BaseWAM1D):
         stdev_spread: float = 0.001,
         random_seed: int = 42,
         sample_batch_size: int | None = None,
+        stream_noise: bool = False,
     ):
         super().__init__(
             model_fn,
@@ -181,6 +182,10 @@ class WaveletAttribution1D(BaseWAM1D):
         self.stdev_spread = stdev_spread
         self.random_seed = random_seed
         self.sample_batch_size = sample_batch_size
+        # stream_noise: draw SmoothGrad noise inside the sample map instead
+        # of materializing the (n_samples, N, W) buffer (different, equally
+        # valid draws; see core.estimators.smoothgrad).
+        self.stream_noise = stream_noise
         # jit once per instance so repeated calls reuse the compiled graph.
         # Estimator config (n_samples, stdev_spread, ...) is frozen at first
         # trace; build a new instance to change it (constructor-kwargs config
@@ -215,6 +220,7 @@ class WaveletAttribution1D(BaseWAM1D):
             n_samples=self.n_samples,
             stdev_spread=self.stdev_spread,
             batch_size=self.sample_batch_size,
+            materialize_noise=not self.stream_noise,
         )
 
     def smooth_wam(self, x, y):

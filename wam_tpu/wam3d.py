@@ -199,6 +199,7 @@ class WaveletAttribution3D(BaseWAM3D):
         stdev_spread: float = 1e-4,
         random_seed: int = 42,
         sample_batch_size: int | None = None,
+        stream_noise: bool = False,
     ):
         super().__init__(
             model_fn,
@@ -217,6 +218,8 @@ class WaveletAttribution3D(BaseWAM3D):
         self.stdev_spread = stdev_spread
         self.random_seed = random_seed
         self.sample_batch_size = sample_batch_size
+        # stream_noise: see core.estimators.smoothgrad(materialize_noise=False)
+        self.stream_noise = stream_noise
         # Per-instance jit caches (estimator config is frozen at first trace;
         # build a new instance to change n_samples etc., as in the reference's
         # constructor-kwargs config surface, SURVEY.md §5.6). Instance-attribute
@@ -242,6 +245,7 @@ class WaveletAttribution3D(BaseWAM3D):
             n_samples=self.n_samples,
             stdev_spread=self.stdev_spread,
             batch_size=self.sample_batch_size,
+            materialize_noise=not self.stream_noise,
         )
 
     def _build_smooth(self, has_label: bool):

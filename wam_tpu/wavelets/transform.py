@@ -257,8 +257,13 @@ def _synthesis(subbands: jax.Array, wav: Wavelet, ndim: int, out_shape: Sequence
 
 
 def dwt(x: jax.Array, wavelet, mode: str = "symmetric"):
-    """Single-level 1D DWT along the last axis. Returns (cA, cD)."""
+    """Single-level 1D DWT along the last axis. Returns (cA, cD).
+
+    bf16 inputs produce f32 coefficients (the framework-wide bf16-in /
+    f32-accumulate policy — see dwt2)."""
     wav = _resolve(wavelet)
+    if x.dtype == jnp.bfloat16:
+        x = x.astype(jnp.float32)
     out = _analysis(x, wav, mode, 1)
     return out[..., 0, :], out[..., 1, :]
 
@@ -371,8 +376,13 @@ def waverec2(coeffs, wavelet):
 
 
 def dwt3(x: jax.Array, wavelet, mode: str = "symmetric"):
-    """Single-level 3D DWT over the last three axes. Returns (cA, {key: arr})."""
+    """Single-level 3D DWT over the last three axes. Returns (cA, {key: arr}).
+
+    bf16 inputs produce f32 coefficients (the framework-wide bf16-in /
+    f32-accumulate policy — see dwt2)."""
     wav = _resolve(wavelet)
+    if x.dtype == jnp.bfloat16:
+        x = x.astype(jnp.float32)
     out = _analysis(x, wav, mode, 3)
     keys = ("aaa",) + DETAIL3D_KEYS
     coeffs = {k: out[..., i, :, :, :] for i, k in enumerate(keys)}
