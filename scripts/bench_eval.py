@@ -1,0 +1,85 @@
+"""Evaluation-suite throughput on TPU: insertion/deletion AUC and
+μ-fidelity at a realistic config (ResNet-50, 224², b8, n_iter=64,
+μ sample_size=128) — the paths VERDICT r2 #3 batched into single jit
+dispatches. Prints one JSON line per metric.
+
+The reference runs these as per-image host loops of 65 pywt
+reconstructions + model calls (`src/evaluators.py:605-765`); there is no
+practical CPU-torch baseline to run in-session (hours), so the record is
+absolute TPU throughput.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    from wam_tpu.config import enable_compilation_cache, ensure_usable_backend
+
+    ensure_usable_backend(timeout_s=180.0)
+    enable_compilation_cache()
+
+    import jax
+    import jax.numpy as jnp
+
+    platform = jax.default_backend()
+    dtype_label = "bfloat16"
+
+    from wam_tpu.evalsuite.eval2d import Eval2DWAM
+    from wam_tpu.evalsuite.eval_baselines import EvalImageBaselines
+    from wam_tpu.models import bind_inference, resnet50
+    from wam_tpu.wam2d import WaveletAttribution2D
+
+    b, image = 8, 224
+    model = resnet50(num_classes=1000)
+    variables = model.init(jax.random.PRNGKey(0), jnp.zeros((1, image, image, 3)))
+    model_fn = bind_inference(model, variables, nchw=True,
+                              compute_dtype=jnp.bfloat16, fold_bn=True)
+    x = jax.random.normal(jax.random.PRNGKey(1), (b, 3, image, image), jnp.float32)
+    y = list(range(b))
+
+    expl = WaveletAttribution2D(model_fn, wavelet="haar", J=3, n_samples=8,
+                                stream_noise=True)
+    ev = Eval2DWAM(model_fn, expl, wavelet="haar", J=3, batch_size=128)
+    ev.precompute(x, y)
+
+    def timed(label, fn, n_items, unit, repeats=3):
+        fn()  # warm (compile)
+        dt = min(
+            (lambda t0: (fn(), time.perf_counter() - t0)[1])(time.perf_counter())
+            for _ in range(repeats)
+        )
+        print(json.dumps({
+            "metric": label, "value": round(n_items / dt, 3), "unit": unit,
+            "seconds": round(dt, 4), "platform": platform, "batch": b,
+            "dtype": dtype_label,
+        }), flush=True)
+
+    timed("eval2d_insertion_auc_b8_niter64", lambda: ev.insertion(x, y, n_iter=64),
+          b, "images/s")
+    timed("eval2d_deletion_auc_b8_niter64", lambda: ev.deletion(x, y, n_iter=64),
+          b, "images/s")
+    timed("eval2d_mu_fidelity_b8_s128",
+          lambda: ev.mu_fidelity(x, y, grid_size=28, sample_size=128,
+                                 subset_size=157),
+          b, "images/s")
+
+    # compute_dtype keeps BOTH evaluators at bf16 so the WAM-vs-baseline
+    # comparison is precision-matched (round-3 advisor finding)
+    evb = EvalImageBaselines(model, variables, method="saliency", batch_size=128,
+                             compute_dtype=jnp.bfloat16)
+    evb.precompute(x, jnp.asarray(y))
+    timed("eval_baselines_saliency_insertion_b8_niter64",
+          lambda: evb.insertion(x, y, n_iter=64), b, "images/s")
+    timed("eval_baselines_saliency_mu_fidelity_b8_s128",
+          lambda: evb.mu_fidelity(x, y, grid_size=28, sample_size=128,
+                                  subset_size=157),
+          b, "images/s")
+
+
+if __name__ == "__main__":
+    main()

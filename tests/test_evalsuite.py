@@ -681,3 +681,27 @@ def test_eval_audio_baselines_batched_matches_loop():
     fid = ev.input_fidelity(x, y)
     fid_loop = evm.input_fidelity(x, y)
     assert fid == fid_loop
+
+
+def test_eval_baselines_compute_dtype_bf16(img_model_fn):
+    """compute_dtype=jnp.bfloat16 casts params once and runs every path at
+    bf16 with f32 logits out; scores track the f32 evaluator closely."""
+    model = TinyImgModel()
+    variables = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 3, 32, 32)))
+    rng = np.random.default_rng(31)
+    x = jnp.asarray(rng.standard_normal((2, 3, 32, 32)), dtype=jnp.float32)
+    y = [0, 3]
+
+    from wam_tpu.evalsuite.eval_baselines import EvalImageBaselines
+
+    ev32 = EvalImageBaselines(model, variables, method="saliency",
+                              batch_size=16, nchw=False)
+    evbf = EvalImageBaselines(model, variables, method="saliency",
+                              batch_size=16, nchw=False,
+                              compute_dtype=jnp.bfloat16)
+    assert evbf.variables["params"]["Conv_0"]["kernel"].dtype == jnp.bfloat16
+    logits = evbf.model_fn(x)
+    assert logits.dtype == jnp.float32
+    ins32 = ev32.insertion(x, y, n_iter=8)
+    insbf = evbf.insertion(x, y, n_iter=8)
+    np.testing.assert_allclose(insbf, ins32, atol=0.15)

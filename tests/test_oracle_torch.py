@@ -233,6 +233,30 @@ def test_wam2d_mosaic_matches_torch_reference(shared_resnet, wavelet, J):
 
 
 @pytest.mark.slow
+def test_wam2d_mosaic_matches_torch_reference_at_224(shared_resnet):
+    """The production geometry — 224², db4, J=3 (BASELINE.json north star).
+    Pins padding phase, mosaic quadrant arithmetic, and normalization at the
+    exact flagship size (the reference hard-codes 224 in its mosaic; this is
+    the one size where its formula and the generic one must agree
+    everywhere). Tolerance 2e-3: at this depth/size ~0.2% of cells differ by
+    up to ~8e-4 from f32 accumulation-order drift between XLA and torch —
+    far below the O(1) whole-quadrant error any convention fault produces."""
+    from wam_tpu.wam2d import BaseWAM2D
+
+    tmodel, model_fn = shared_resnet
+    rng = np.random.default_rng(37)
+    x = rng.standard_normal((2, 3, 224, 224)).astype(np.float32)
+    y = np.array([2, 9])
+
+    wam = BaseWAM2D(model_fn, wavelet="db4", J=3, mode="reflect")
+    ours = np.asarray(wam(jnp.asarray(x), jnp.asarray(y)), dtype=np.float64)
+    theirs, rec = torch_wam2d(tmodel, torch.tensor(x), torch.tensor(y), "db4", 3)
+    np.testing.assert_allclose(rec.detach().numpy(), x, atol=1e-4)
+    assert ours.shape == theirs.shape
+    np.testing.assert_allclose(ours, theirs, atol=2e-3)
+
+
+@pytest.mark.slow
 def test_wam2d_smoothgrad_step_matches_torch_reference(shared_resnet):
     """One SmoothGrad step with FIXED injected noise (not RNG-matched): the
     reference's per-image σ = spread·(max−min) noisy pass
@@ -407,3 +431,4 @@ def test_wam1d_melspec_tap_matches_torch_reference():
         np.testing.assert_allclose(
             np.asarray(ours), theirs.grad.numpy(), atol=1e-5
         )
+

@@ -53,7 +53,8 @@ class _BaseEvalBaselines:
 
     def __init__(self, model, variables, method: str, batch_size: int, random_seed: int,
                  n_samples: int, stdev_spread: float, cam_layer: str, nchw: bool,
-                 methods: tuple[str, ...], mesh=None, data_axis: str = "data"):
+                 methods: tuple[str, ...], mesh=None, data_axis: str = "data",
+                 compute_dtype=None):
         if method == "srd":
             raise NotImplementedError(
                 "'srd' is excluded by design: the reference imports it from a "
@@ -65,6 +66,19 @@ class _BaseEvalBaselines:
         if method not in methods:
             raise ValueError(f"Unknown method {method!r}; expected one of {methods}")
         self.model = model
+        # compute_dtype (e.g. jnp.bfloat16): cast float params/stats ONCE so
+        # every path — the perturbation-fan model_fn AND the CAM/LRP routes
+        # that re-apply self.variables — runs at the same precision; inputs
+        # are cast at the model boundary, logits come back float32 (the
+        # bind_inference convention, models/resnet.py).
+        self.compute_dtype = compute_dtype
+        if compute_dtype is not None:
+            variables = jax.tree_util.tree_map(
+                lambda a: a.astype(compute_dtype)
+                if hasattr(a, "dtype") and jnp.issubdtype(a.dtype, jnp.floating)
+                else a,
+                variables,
+            )
         self.variables = variables
         self.method = method
         self.batch_size = batch_size
@@ -79,12 +93,15 @@ class _BaseEvalBaselines:
         self.insertion_curves = []
         self.deletion_curves = []
 
-        base = {k: v for k, v in variables.items() if k != "perturbations"}
+        base = {k: v for k, v in self.variables.items() if k != "perturbations"}
 
         def model_fn(x):
             inp = jnp.transpose(x, (0, 2, 3, 1)) if nchw else x
+            if self.compute_dtype is not None:
+                inp = inp.astype(self.compute_dtype)
             out = self.model.apply(base, inp)
-            return out[0] if isinstance(out, tuple) else out
+            out = out[0] if isinstance(out, tuple) else out
+            return out.astype(jnp.float32) if self.compute_dtype is not None else out
 
         self.model_fn = model_fn
         self._probs_fn = make_probs_fn(model_fn, batch_size, mesh, data_axis)
@@ -197,10 +214,12 @@ class EvalImageBaselines(_BaseEvalBaselines):
         nchw: bool = True,
         mesh=None,
         data_axis: str = "data",
+        compute_dtype=None,
     ):
         super().__init__(model, variables, method, batch_size, random_seed,
                          n_samples, stdev_spread, cam_layer, nchw=nchw,
-                         methods=IMAGE_METHODS, mesh=mesh, data_axis=data_axis)
+                         methods=IMAGE_METHODS, mesh=mesh, data_axis=data_axis,
+                         compute_dtype=compute_dtype)
         self.denormalize_fn = denormalize_fn
         self.preprocess_fn = preprocess_fn
 
@@ -307,10 +326,12 @@ class EvalAudioBaselines(_BaseEvalBaselines):
         cam_layer: str = "out3",
         mesh=None,
         data_axis: str = "data",
+        compute_dtype=None,
     ):
         super().__init__(model, variables, method, batch_size, random_seed,
                          n_samples, stdev_spread, cam_layer, nchw=False,
-                         methods=AUDIO_METHODS, mesh=mesh, data_axis=data_axis)
+                         methods=AUDIO_METHODS, mesh=mesh, data_axis=data_axis,
+                         compute_dtype=compute_dtype)
 
     def _perturb(self, x_s, masks):
         # x_s: (1, T, M); masks: (n_iter+1, T, M) -> (n_iter+1, 1, T, M)
