@@ -16,7 +16,7 @@ from typing import Sequence
 
 import numpy as np
 
-from wam_tpu.native import read_wav
+from wam_tpu.native import WavPrefetcher, read_wav
 from wam_tpu.ops.melspec import mel_filterbank
 
 __all__ = [
@@ -103,13 +103,34 @@ class ESC50:
     def __len__(self) -> int:
         return len(self.rows)
 
-    def _load(self, row) -> np.ndarray:
-        path = os.path.join(self.root_dir, "audio", row["filename"])
-        _, audio = read_wav(path)
+    def iter_waveforms(self, indices=None, workers: int = 4, capacity: int = 8):
+        """Stream (idx, normalized waveform) via the native threaded
+        prefetcher (`wam_tpu/native/prefetch.cpp`): C++ workers decode WAV
+        files ahead of the consumer in submission order — the reference's
+        torch-DataLoader-worker role for this dataset. Falls back to a
+        Python thread pool without the toolchain."""
+        idxs = list(range(len(self.rows))) if indices is None else list(indices)
+        paths = [
+            os.path.join(self.root_dir, "audio", self.rows[i]["filename"])
+            for i in idxs
+        ]
+        with WavPrefetcher(paths, workers=workers, capacity=capacity) as pf:
+            for i, (_, audio) in zip(idxs, pf):
+                yield i, self._normalize(audio)
+
+    @staticmethod
+    def _normalize(audio: np.ndarray) -> np.ndarray:
+        """Mono-select + float32 + peak normalization, shared by the
+        synchronous and prefetching decode paths."""
         if audio.ndim > 1:
             audio = audio[:, 0]
         audio = audio.astype(np.float32)
         return audio / audio.max()
+
+    def _load(self, row) -> np.ndarray:
+        path = os.path.join(self.root_dir, "audio", row["filename"])
+        _, audio = read_wav(path)
+        return self._normalize(audio)
 
     def __getitem__(self, idx: int):
         row = self.rows[idx]

@@ -1,9 +1,16 @@
 """Native runtime components (C++ via ctypes).
 
 `read_wav(path)` decodes a WAV file to float32 through the compiled
-`wavio.cpp` shared library when available (built lazily with g++), falling
-back to scipy.io.wavfile otherwise. Both paths return
-(sample_rate, samples) with samples (frames,) mono or (frames, channels).
+shared library when available (built lazily with g++ from `wavio.cpp` +
+`prefetch.cpp`), falling back to scipy.io.wavfile otherwise. Both paths
+return (sample_rate, samples) with samples (frames,) mono or
+(frames, channels).
+
+`WavPrefetcher(paths, workers, capacity)` streams decoded waveforms in
+submission order from a C++ thread pool that decodes AHEAD of the
+consumer (the torch-DataLoader-worker role for the ESC-50 pipeline,
+`prefetch.cpp`); a Python-threaded fallback covers environments without
+the toolchain.
 """
 
 from __future__ import annotations
@@ -15,11 +22,12 @@ import threading
 
 import numpy as np
 
-__all__ = ["read_wav", "native_available"]
+__all__ = ["read_wav", "native_available", "WavPrefetcher"]
 
 _HERE = os.path.dirname(__file__)
 _SRC = os.path.join(_HERE, "wavio.cpp")
-_LIB_PATH = os.path.join(_HERE, "_wavio.so")
+_SRC_PF = os.path.join(_HERE, "prefetch.cpp")
+_LIB_PATH = os.path.join(_HERE, "_wamnative.so")
 _lock = threading.Lock()
 _lib = None
 _build_failed = False
@@ -31,9 +39,11 @@ def _load() -> ctypes.CDLL | None:
         if _lib is not None or _build_failed:
             return _lib
         try:
-            if not os.path.exists(_LIB_PATH) or os.path.getmtime(_LIB_PATH) < os.path.getmtime(_SRC):
+            newest_src = max(os.path.getmtime(_SRC), os.path.getmtime(_SRC_PF))
+            if not os.path.exists(_LIB_PATH) or os.path.getmtime(_LIB_PATH) < newest_src:
                 subprocess.run(
-                    ["g++", "-O3", "-shared", "-fPIC", "-o", _LIB_PATH, _SRC],
+                    ["g++", "-O3", "-shared", "-fPIC", "-pthread",
+                     "-o", _LIB_PATH, _SRC, _SRC_PF],
                     check=True,
                     capture_output=True,
                 )
@@ -51,6 +61,19 @@ def _load() -> ctypes.CDLL | None:
                 ctypes.c_long,
             ]
             lib.wav_read_f32.restype = ctypes.c_long
+            lib.pf_create.argtypes = [
+                ctypes.POINTER(ctypes.c_char_p), ctypes.c_long,
+                ctypes.c_int, ctypes.c_long, ctypes.c_long,
+            ]
+            lib.pf_create.restype = ctypes.c_void_p
+            lib.pf_next.argtypes = [
+                ctypes.c_void_p, ctypes.POINTER(ctypes.c_float),
+                ctypes.c_long, ctypes.POINTER(ctypes.c_int),
+                ctypes.POINTER(ctypes.c_int),
+            ]
+            lib.pf_next.restype = ctypes.c_long
+            lib.pf_destroy.argtypes = [ctypes.c_void_p]
+            lib.pf_destroy.restype = None
             _lib = lib
         except Exception:
             _build_failed = True
@@ -89,3 +112,108 @@ def read_wav(path: str) -> tuple[int, np.ndarray]:
     if ch.value > 1:
         samples = samples.reshape(-1, ch.value)
     return sr.value, samples
+
+
+class WavPrefetcher:
+    """Ordered, bounded, threaded WAV prefetch (prefetch.cpp).
+
+    Iterate to receive (sample_rate, samples) per path IN ORDER; decoding
+    runs up to ``capacity`` items ahead on ``workers`` C++ threads. Use as
+    a context manager (or exhaust the iterator) so threads are joined.
+    Falls back to a Python ThreadPool when the native library is missing —
+    same contract, GIL-scheduled.
+    """
+
+    def __init__(self, paths: list[str], workers: int = 4, capacity: int = 8,
+                 max_frames: int = 16_000_000):
+        self.paths = [str(p) for p in paths]
+        self.workers = max(1, int(workers))
+        self.capacity = max(1, int(capacity))
+        self.max_frames = int(max_frames)
+        self._handle = None
+        self._fallback = None
+        lib = _load()
+        if lib is not None and self.paths:
+            arr = (ctypes.c_char_p * len(self.paths))(
+                *[p.encode() for p in self.paths]
+            )
+            self._paths_arr = arr  # keep alive for the worker threads
+            self._handle = lib.pf_create(
+                arr, len(self.paths), self.workers, self.capacity,
+                self.max_frames,
+            )
+        if self._handle is None and self.paths:
+            from concurrent.futures import ThreadPoolExecutor
+
+            self._pool = ThreadPoolExecutor(max_workers=self.workers)
+            self._fallback = True  # futures submitted lazily (bounded)
+
+    def __iter__(self):
+        lib = _load()
+        if self._handle is not None:
+            try:
+                # buffer sized in SAMPLES (2 channels of max_frames by
+                # default); pf_next returns -6 rather than truncate if a
+                # file needs more — raise max_frames for such corpora
+                cap_samples = self.max_frames * 2
+                buf = np.empty(cap_samples, dtype=np.float32)
+                sr = ctypes.c_int()
+                ch = ctypes.c_int()
+                for path in self.paths:
+                    got = lib.pf_next(
+                        self._handle,
+                        buf.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                        cap_samples, ctypes.byref(sr), ctypes.byref(ch),
+                    )
+                    if got == -1:  # exhausted (item errors are < -1)
+                        return
+                    if got < 0:
+                        raise IOError(
+                            f"prefetch decode failed (code {got}) for {path}"
+                            + (" — file exceeds max_frames" if got == -5 else "")
+                            + (" — buffer too small for channel count"
+                               if got == -6 else "")
+                        )
+                    samples = buf[: got * ch.value].copy()
+                    if ch.value > 1:
+                        samples = samples.reshape(-1, ch.value)
+                    yield sr.value, samples
+            finally:
+                # exhaustion, break, or error all join the C++ workers
+                self.close()
+            return
+        if self._fallback:
+            from collections import deque
+
+            pending: deque = deque()
+            try:
+                it = iter(self.paths)
+                # bounded work-ahead, honoring `capacity` like the C++ path
+                for p in it:
+                    pending.append(self._pool.submit(read_wav, p))
+                    if len(pending) >= self.capacity:
+                        break
+                for p in it:
+                    yield pending.popleft().result()
+                    pending.append(self._pool.submit(read_wav, p))
+                while pending:
+                    yield pending.popleft().result()
+            finally:
+                for fut in pending:
+                    fut.cancel()
+                self.close()
+
+    def close(self):
+        lib = _load()
+        if self._handle is not None and lib is not None:
+            lib.pf_destroy(self._handle)
+            self._handle = None
+        if self._fallback:
+            self._pool.shutdown(wait=False, cancel_futures=True)
+            self._fallback = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
