@@ -299,3 +299,30 @@ def test_wav_prefetcher_early_break_joins_threads(tmp_path):
         if k == 2:
             break
     assert pf._handle is None and not pf._fallback  # closed either path
+
+
+def test_wav_prefetcher_single_use_raises(tmp_path):
+    import pytest as _pytest
+
+    from wam_tpu.native import WavPrefetcher
+
+    paths = _write_wavs(tmp_path, 2)
+    pf = WavPrefetcher(paths, workers=1)
+    assert len(list(pf)) == 2
+    with _pytest.raises(RuntimeError):
+        list(pf)
+
+
+def test_wav_prefetcher_abandoned_is_finalized(tmp_path):
+    """A constructed-but-never-iterated prefetcher must be cleaned up by its
+    finalizer (no native thread leak)."""
+    import gc
+
+    from wam_tpu.native import WavPrefetcher
+
+    paths = _write_wavs(tmp_path, 4)
+    pf = WavPrefetcher(paths, workers=2, capacity=2)
+    fin = pf._finalizer
+    del pf
+    gc.collect()
+    assert not fin.alive  # ran (or was detached by an explicit close)

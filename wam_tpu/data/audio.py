@@ -121,11 +121,17 @@ class ESC50:
     @staticmethod
     def _normalize(audio: np.ndarray) -> np.ndarray:
         """Mono-select + float32 + peak normalization, shared by the
-        synchronous and prefetching decode paths."""
+        synchronous and prefetching decode paths.
+
+        Divides by the SIGNED maximum — the reference's convention
+        (`wf/wf.max()`, `lib/wam_1D.py:105-106` / `src/dataloader.py`) —
+        kept for parity; only the all-zero (silent) clip is guarded so it
+        yields zeros instead of NaNs."""
         if audio.ndim > 1:
             audio = audio[:, 0]
         audio = audio.astype(np.float32)
-        return audio / audio.max()
+        peak = audio.max()
+        return audio / (peak if peak != 0 else 1.0)
 
     def _load(self, row) -> np.ndarray:
         path = os.path.join(self.root_dir, "audio", row["filename"])

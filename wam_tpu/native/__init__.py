@@ -132,6 +132,7 @@ class WavPrefetcher:
         self.max_frames = int(max_frames)
         self._handle = None
         self._fallback = None
+        self._closed = False
         lib = _load()
         if lib is not None and self.paths:
             arr = (ctypes.c_char_p * len(self.paths))(
@@ -147,8 +148,24 @@ class WavPrefetcher:
 
             self._pool = ThreadPoolExecutor(max_workers=self.workers)
             self._fallback = True  # futures submitted lazily (bounded)
+        # unconditional cleanup: a constructed-but-abandoned prefetcher must
+        # not leak native worker threads (round-3 advisor finding)
+        import weakref
+
+        self._finalizer = weakref.finalize(self, WavPrefetcher._finalize,
+                                           _load(), self._handle)
+
+    @staticmethod
+    def _finalize(lib, handle):
+        if lib is not None and handle is not None:
+            lib.pf_destroy(handle)
 
     def __iter__(self):
+        if self._closed:
+            raise RuntimeError(
+                "WavPrefetcher is single-use: it was already exhausted or "
+                "closed; construct a new one for another pass"
+            )
         lib = _load()
         if self._handle is not None:
             try:
@@ -204,8 +221,10 @@ class WavPrefetcher:
                 self.close()
 
     def close(self):
+        self._closed = True
         lib = _load()
         if self._handle is not None and lib is not None:
+            self._finalizer.detach()  # we destroy now; finalizer must not
             lib.pf_destroy(self._handle)
             self._handle = None
         if self._fallback:

@@ -81,14 +81,24 @@ struct Prefetcher {
                              &item.channels, &frames_in_file);
       if (info_rc != 0) {
         item.frames = info_rc - 10;  // -11/-12: never collides with -1
-      } else if (frames_in_file > max_frames) {
-        item.frames = -5;  // too long: an error, not a silent truncation
+      } else if (frames_in_file > max_frames ||
+                 frames_in_file * static_cast<long>(item.channels) >
+                     2 * max_frames) {
+        // bound SAMPLES too: a corrupt header claiming a huge channel
+        // count must become a catchable error, not a giant allocation
+        item.frames = -5;
       } else {
-        item.samples.resize(static_cast<size_t>(frames_in_file) *
-                            item.channels);
-        long got = wav_read_f32(paths[idx].c_str(), item.samples.data(),
-                                frames_in_file);
-        item.frames = got < 0 ? got - 10 : got;
+        try {
+          item.samples.resize(static_cast<size_t>(frames_in_file) *
+                              item.channels);
+          long got = wav_read_f32(paths[idx].c_str(), item.samples.data(),
+                                  frames_in_file);
+          item.frames = got < 0 ? got - 10 : got;
+        } catch (const std::exception&) {
+          // bad_alloc etc. must not escape a std::thread (std::terminate)
+          item.frames = -7;
+          item.samples.clear();
+        }
       }
 
       {

@@ -53,6 +53,7 @@ __all__ = [
     "dwt_max_level",
     "set_dwt2_impl",
     "get_dwt2_impl",
+    "set_dwt1_impl",
 ]
 
 # 2D transform backend: "conv" = fused strided lax.conv, "matmul" =
@@ -79,6 +80,36 @@ def set_dwt2_impl(name: str) -> None:
 
 _dwt2_impl = "auto"
 set_dwt2_impl(os.environ.get("WAM_TPU_DWT2_IMPL", "auto"))
+
+
+# 1D transform backend: "conv" = the plain fused conv; "folded" = the
+# polyphase channel-fold (wavelets/folded1d.py — same linear map expressed
+# as a 128-channel conv, full sublane occupancy on long signals); "auto"
+# (default) = folded on TPU for signals past the fold break-even, conv
+# elsewhere. Exact re-expression up to float summation order.
+_DWT1_IMPLS = ("auto", "conv", "folded")
+_FOLD1D_MIN_LEN = 4096
+
+
+def set_dwt1_impl(name: str) -> None:
+    """Select the 1D DWT backend for *not-yet-traced* calls (see
+    set_dwt2_impl's note on jit caching)."""
+    global _dwt1_impl
+    if name not in _DWT1_IMPLS:
+        raise ValueError(f"impl {name!r} not one of {_DWT1_IMPLS}")
+    _dwt1_impl = name
+
+
+_dwt1_impl = "auto"
+set_dwt1_impl(os.environ.get("WAM_TPU_DWT1_IMPL", "auto"))
+
+
+def _use_folded1d(n: int) -> bool:
+    if _dwt1_impl == "folded":
+        return True
+    if _dwt1_impl == "conv":
+        return False
+    return jax.default_backend() == "tpu" and n >= _FOLD1D_MIN_LEN
 
 
 def get_dwt2_impl() -> str:
@@ -264,7 +295,16 @@ def dwt(x: jax.Array, wavelet, mode: str = "symmetric"):
     wav = _resolve(wavelet)
     if x.dtype == jnp.bfloat16:
         x = x.astype(jnp.float32)
-    out = _analysis(x, wav, mode, 1)
+    n = x.shape[-1]
+    if _use_folded1d(n):
+        from wam_tpu.wavelets.folded1d import fold_analysis1d
+
+        L = wav.filt_len
+        xp = _pad_axes(x, L - 1, (-1,), mode)[..., 1:]
+        n_out = (n + L - 1) // 2
+        out = fold_analysis1d(xp, wav, n_out)
+    else:
+        out = _analysis(x, wav, mode, 1)
     return out[..., 0, :], out[..., 1, :]
 
 
@@ -275,6 +315,10 @@ def idwt(cA: jax.Array, cD: jax.Array, wavelet, out_len: int | None = None):
     full = 2 * n - wav.filt_len + 2
     target = full if out_len is None else out_len
     sub = jnp.stack([cA, cD], axis=-2)
+    if _use_folded1d(target):
+        from wam_tpu.wavelets.folded1d import fold_synthesis1d
+
+        return fold_synthesis1d(sub, wav)[..., :target]
     return _synthesis(sub, wav, 1, (target,))
 
 
