@@ -55,7 +55,7 @@ def main():
         )
         print(json.dumps({
             "metric": label, "value": round(n_items / dt, 3), "unit": unit,
-            "seconds": round(dt, 4), "platform": platform, "batch": b,
+            "seconds": round(dt, 4), "platform": platform, "batch": n_items,
             "dtype": dtype_label,
         }), flush=True)
 
@@ -79,6 +79,27 @@ def main():
           lambda: evb.mu_fidelity(x, y, grid_size=28, sample_size=128,
                                   subset_size=157),
           b, "images/s")
+
+    # 1D audio evaluator: wavelet-domain insertion = 65 waverec(220k) +
+    # melspec + model forwards per sample — rides the folded 1D DWT
+    from bench_workloads import audio_workload
+    from wam_tpu.evalsuite.eval1d import Eval1DWAM
+    from wam_tpu.models.audio import AudioCNN, bind_audio_inference
+
+    wave_len, ab = 220500, 4
+    amodel = AudioCNN(num_classes=50)
+    avars = amodel.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 1, wave_len // 512 + 1, 128))
+    )
+    afn = bind_audio_inference(amodel, avars)
+    xw = jax.random.normal(jax.random.PRNGKey(9), (ab, wave_len), jnp.float32)
+    yw = list(range(ab))
+    ex1, _, _ = audio_workload(8, b=ab, n=8, wave_len=wave_len)
+    ev1 = Eval1DWAM(afn, ex1, wavelet="db6", J=5, batch_size=32)
+    ev1.precompute(xw, yw)
+    timed("eval1d_insertion_wavelet_b4_niter64",
+          lambda: ev1.insertion(xw, yw, target="wavelet", n_iter=64),
+          ab, "waveforms/s")
 
 
 if __name__ == "__main__":
