@@ -66,9 +66,13 @@ def tpu_throughput() -> tuple[float, str]:
     # BASELINE.md round-3 scaling table). CPU keeps chunks of one sample.
     chunk = 4 if platform != "cpu" else 1
 
-    # stem_s2d + fold_bn are value-preserving rewrites (see models/resnet.py)
-    # measured worth ~2% together on the flagship step.
-    model = resnet50(num_classes=1000, stem_s2d=not F32)
+    # fold_bn is a value-preserving rewrite (see models/resnet.py). The
+    # round-2 stem_s2d rewrite is OFF since round 3: its win targeted the
+    # conv1 input-grad of the 800-row full-vmap graph; under the 128-row
+    # schedule a back-to-back A/B measures a tie (147.6 vs 148.5 img/s)
+    # while s2d adds three re-tiling copies at the model seam (BASELINE.md
+    # layout-copy audit). The model option remains available.
+    model = resnet50(num_classes=1000)
     variables = model.init(jax.random.PRNGKey(0), jnp.zeros((1, image, image, 3)))
     model_fn = bind_inference(
         model,
