@@ -705,3 +705,24 @@ def test_eval_baselines_compute_dtype_bf16(img_model_fn):
     ins32 = ev32.insertion(x, y, n_iter=8)
     insbf = evbf.insertion(x, y, n_iter=8)
     np.testing.assert_allclose(insbf, ins32, atol=0.15)
+
+
+def test_lrp_under_bf16_evaluator_runs_f32(img_model_fn):
+    """`method='lrp'` with compute_dtype=bf16 must work: the walker upcasts
+    to f32 internally (the ε-stabilizer vanishes in bf16) and produces the
+    same relevance as the f32 evaluator."""
+    from wam_tpu.evalsuite.eval_baselines import EvalImageBaselines
+    from wam_tpu.models import resnet18
+
+    model = resnet18(num_classes=5)
+    variables = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 32, 32, 3)))
+    x = jnp.asarray(np.random.default_rng(41).standard_normal((1, 3, 32, 32)), jnp.float32)
+    y = [2]
+    r32 = EvalImageBaselines(model, variables, method="lrp",
+                             batch_size=16).precompute(x, jnp.asarray(y))
+    rbf = EvalImageBaselines(model, variables, method="lrp", batch_size=16,
+                             compute_dtype=jnp.bfloat16).precompute(x, jnp.asarray(y))
+    assert np.isfinite(np.asarray(rbf)).all()
+    # params were cast to bf16 at evaluator init (lossy) before the walker
+    # upcasts — agreement is bounded by that one rounding, not exactness
+    np.testing.assert_allclose(np.asarray(rbf), np.asarray(r32), atol=3e-4)

@@ -136,6 +136,17 @@ def lrp_resnet(
         )
     if model.stem_s2d:
         model = model.clone(stem_s2d=False)  # walker assumes the 7x7 stem form
+    # LRP is an f32-only computation: the ε-stabilizer (1e-6 relative to
+    # O(1) activations) vanishes in bf16's 8-bit mantissa, and the walker
+    # drives lax.conv directly with raw kernels (no flax promotion). If the
+    # caller evaluates at compute_dtype=bf16 (eval_baselines), params are
+    # upcast HERE and the relevance map is computed in f32 throughout.
+    variables = jax.tree_util.tree_map(
+        lambda a: a.astype(jnp.float32)
+        if hasattr(a, "dtype") and a.dtype == jnp.bfloat16
+        else a,
+        variables,
+    )
     folded = _fold_bn_variables(variables)
     params = folded["params"]
     base = {k: v for k, v in folded.items() if k != "perturbations"}
