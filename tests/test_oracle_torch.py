@@ -432,3 +432,102 @@ def test_wam1d_melspec_tap_matches_torch_reference():
             np.asarray(ours), theirs.grad.numpy(), atol=1e-5
         )
 
+
+
+# -- 3D engine oracle (`lib/wam_3D.py:194-238`) -----------------------------
+
+
+def _kernels3d(wavelet: str):
+    """8 analysis / synthesis outer-product kernels; channel order = binary
+    a/d counting over (axis0, axis1, axis2), matching DETAIL3D_KEYS."""
+    bank = BANKS[wavelet]
+    L = len(bank["dec_lo"])
+    lo = torch.tensor(bank["dec_lo"][::-1], dtype=torch.float32)
+    hi = torch.tensor(bank["dec_hi"][::-1], dtype=torch.float32)
+
+    def outer3(a, b, c):
+        return torch.einsum("i,j,k->ijk", a, b, c)
+
+    akern = torch.stack([
+        outer3(hi if (code >> 2) & 1 else lo,
+               hi if (code >> 1) & 1 else lo,
+               hi if code & 1 else lo)
+        for code in range(8)
+    ])[:, None]  # (8, 1, L, L, L)
+    rlo = torch.tensor(bank["rec_lo"], dtype=torch.float32)
+    rhi = torch.tensor(bank["rec_hi"], dtype=torch.float32)
+    skern = torch.stack([
+        outer3(rhi if (code >> 2) & 1 else rlo,
+               rhi if (code >> 1) & 1 else rlo,
+               rhi if code & 1 else rlo)
+        for code in range(8)
+    ])[:, None]  # (in=8 stacked later, 1, L, L, L)
+    return akern, skern, L
+
+
+def torch_wavedec3(x, wavelet, J):
+    """x: (B, D, H, W) mono volume → [cA, {aad..ddd}_J, ..., _1]."""
+    akern, _, L = _kernels3d(wavelet)
+    keys = ("aad", "ada", "add", "daa", "dad", "dda", "ddd")
+    a = x[:, None]  # (B, 1, D, H, W)
+    details, shapes = [], []
+    for _ in range(J):
+        shapes.append(a.shape[-3:])
+        xp = F.pad(a, (L - 1,) * 6, mode="reflect")[:, :, 1:, 1:, 1:]
+        c = F.conv3d(xp, akern, stride=2)
+        a = c[:, :1]
+        details.append({k: c[:, i + 1] for i, k in enumerate(keys)})
+    return [a[:, 0]] + details[::-1], shapes[::-1]
+
+
+def torch_waverec3(coeffs, shapes, wavelet):
+    _, skern, L = _kernels3d(wavelet)
+    keys = ("aad", "ada", "add", "daa", "dad", "dda", "ddd")
+    a = coeffs[0]
+    for det, hw in zip(coeffs[1:], shapes):
+        tgt = det["ddd"].shape[-3:]
+        a = a[..., : tgt[0], : tgt[1], : tgt[2]]
+        sub = torch.stack([a] + [det[k] for k in keys], dim=1)  # (B, 8, ...)
+        a = F.conv_transpose3d(sub, skern, stride=2, padding=L - 2)[:, 0]
+        a = a[..., : hw[0], : hw[1], : hw[2]]
+    return a
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("wavelet,J", [("haar", 2), ("db4", 1)])
+def test_wam3d_coeff_grads_match_torch_reference(wavelet, J):
+    """3D engine oracle: decompose → requires_grad leaves → reconstruct →
+    shared linear model → diag-logit-mean backward; every subband's
+    gradient must match across frameworks (pins the 3D axis order and
+    orientation naming end to end)."""
+    from wam_tpu.core.engine import WamEngine
+
+    rng = np.random.default_rng(53)
+    D = 16
+    W = rng.standard_normal((D**3, 4)).astype(np.float32)
+    x = rng.standard_normal((2, D, D, D)).astype(np.float32)
+    y = np.array([1, 3])
+
+    fn = lambda v: v.reshape(v.shape[0], -1) @ jnp.asarray(W)
+    eng = WamEngine(fn, ndim=3, wavelet=wavelet, level=J, mode="reflect")
+    _, grads = eng.attribute(jnp.asarray(x), jnp.asarray(y))
+
+    coeffs, shapes = torch_wavedec3(torch.tensor(x), wavelet, J)
+    leaves = [coeffs[0].detach().requires_grad_(True)]
+    for det in coeffs[1:]:
+        leaves.append({k: v.detach().requires_grad_(True) for k, v in det.items()})
+    rec = torch_waverec3(leaves, shapes, wavelet)
+    np.testing.assert_allclose(rec.detach().numpy(), x, atol=1e-4)
+    out = rec.reshape(rec.shape[0], -1) @ torch.tensor(W)
+    loss = torch.diag(out[:, torch.tensor(y)]).mean()
+    loss.backward()
+
+    np.testing.assert_allclose(
+        np.asarray(grads[0]), leaves[0].grad.numpy(), atol=1e-5
+    )
+    for ours_det, theirs_det in zip(grads[1:], leaves[1:]):
+        for k in ("aad", "ada", "add", "daa", "dad", "dda", "ddd"):
+            np.testing.assert_allclose(
+                np.asarray(ours_det[k]), theirs_det[k].grad.numpy(),
+                atol=1e-5, err_msg=f"subband {k}",
+            )
