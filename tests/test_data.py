@@ -326,3 +326,33 @@ def test_wav_prefetcher_abandoned_is_finalized(tmp_path):
     del pf
     gc.collect()
     assert not fin.alive  # ran (or was detached by an explicit close)
+
+
+def test_wav_prefetcher_python_fallback(tmp_path, monkeypatch):
+    """The GIL-threaded fallback (no g++) must honor the same contract:
+    ordered delivery, bounded work-ahead, matching samples, single-use."""
+    import pytest as _pytest
+
+    import wam_tpu.native as native
+
+    monkeypatch.setattr(native, "_load", lambda: None)
+    paths = _write_wavs(tmp_path, 10)
+    ref = []
+    # reference decode through scipy (read_wav also hits the fallback now)
+    from scipy.io import wavfile
+
+    for p in paths:
+        sr, data = wavfile.read(p)
+        ref.append((sr, data.astype(np.float32) / 32768.0))
+
+    with native.WavPrefetcher(paths, workers=3, capacity=2) as pf:
+        assert pf._handle is None and pf._fallback  # really the fallback
+        got = list(pf)
+    assert len(got) == 10
+    for (sr_a, a), (sr_b, b) in zip(got, ref):
+        assert sr_a == sr_b
+        np.testing.assert_allclose(np.asarray(a), b, atol=1e-7)
+    pf2 = native.WavPrefetcher(paths, workers=2, capacity=2)
+    list(pf2)
+    with _pytest.raises(RuntimeError):
+        list(pf2)
