@@ -313,6 +313,75 @@ def test_wav_prefetcher_single_use_raises(tmp_path):
         list(pf)
 
 
+def test_wav_prefetcher_double_iter_raises_eagerly(tmp_path):
+    """iter() twice BEFORE consuming anything must raise immediately — a
+    second generator would interleave the one shared native ordinal stream
+    and silently mispair paths with samples (round-3 advisor finding)."""
+    from wam_tpu.native import WavPrefetcher
+
+    paths = _write_wavs(tmp_path, 4)
+    pf = WavPrefetcher(paths, workers=2, capacity=2)
+    it1 = iter(pf)
+    with pytest.raises(RuntimeError):
+        iter(pf)  # eager: raises at iter(), not at first next()
+    assert len(list(it1)) == 4  # the first iterator is unaffected
+
+
+def test_wav_prefetcher_small_start_buffer_grows(tmp_path):
+    """The native iterator starts with a ~1 MB buffer and grows to each
+    item's exact size via pf_next_size — items larger than the start buffer
+    must still decode losslessly (no 128 MB worst-case preallocation)."""
+    from wam_tpu.native import WavPrefetcher, native_available, read_wav
+
+    if not native_available():
+        pytest.skip("native library unavailable")
+    # 2-channel, 300k frames = 600k samples > the 2^18-sample start buffer
+    rng = np.random.default_rng(7)
+    data = (rng.standard_normal((300_000, 2)) * 8000).astype(np.int16)
+    from scipy.io import wavfile
+
+    p = tmp_path / "big.wav"
+    wavfile.write(p, 16_000, data)
+    paths = [str(p)] + _write_wavs(tmp_path, 2)
+    ref = [read_wav(q) for q in paths]
+    with WavPrefetcher(paths, workers=2, capacity=2) as pf:
+        got = list(pf)
+    assert len(got) == len(ref)
+    for (sr_a, a), (sr_b, b) in zip(got, ref):
+        assert sr_a == sr_b and a.shape == b.shape
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_wav_prefetcher_concurrent_close_is_safe(tmp_path):
+    """close() from another thread while a consumer iterates must not crash
+    or deadlock: the wrapper serializes close() behind the in-flight native
+    call (and the C layer's -8/drain protocol covers direct C callers), so
+    the consumer sees a clean stop."""
+    import threading
+
+    from wam_tpu.native import WavPrefetcher, native_available
+
+    if not native_available():
+        pytest.skip("native library unavailable")
+    for _ in range(5):  # a few rounds to vary thread interleaving
+        paths = _write_wavs(tmp_path, 32)
+        pf = WavPrefetcher(paths, workers=2, capacity=2)
+        got, err = [], []
+
+        def consume():
+            try:
+                for item in iter(pf):
+                    got.append(item)
+            except (IOError, RuntimeError) as e:  # -8 surfaces as IOError
+                err.append(e)
+
+        t = threading.Thread(target=consume)
+        t.start()
+        pf.close()
+        t.join(timeout=30)
+        assert not t.is_alive(), "consumer deadlocked against pf_destroy"
+
+
 def test_wav_prefetcher_abandoned_is_finalized(tmp_path):
     """A constructed-but-never-iterated prefetcher must be cleaned up by its
     finalizer (no native thread leak)."""

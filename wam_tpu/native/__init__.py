@@ -72,6 +72,8 @@ def _load() -> ctypes.CDLL | None:
                 ctypes.POINTER(ctypes.c_int),
             ]
             lib.pf_next.restype = ctypes.c_long
+            lib.pf_next_size.argtypes = [ctypes.c_void_p]
+            lib.pf_next_size.restype = ctypes.c_long
             lib.pf_destroy.argtypes = [ctypes.c_void_p]
             lib.pf_destroy.restype = None
             _lib = lib
@@ -122,6 +124,13 @@ class WavPrefetcher:
     a context manager (or exhaust the iterator) so threads are joined.
     Falls back to a Python ThreadPool when the native library is missing —
     same contract, GIL-scheduled.
+
+    Thread safety: one iterator at a time (a second ``iter()`` raises
+    eagerly). ``close()`` may be called from another thread while the
+    iterator runs; it serializes behind the in-flight item (waits out at
+    most one decode) and the iterator then stops cleanly. The C API's -8
+    stop code additionally defends direct C callers that race pf_destroy
+    against a blocked pf_next (prefetch.cpp).
     """
 
     def __init__(self, paths: list[str], workers: int = 4, capacity: int = 8,
@@ -133,6 +142,10 @@ class WavPrefetcher:
         self._handle = None
         self._fallback = None
         self._closed = False
+        self._iterating = False
+        # serializes native calls against close() from another thread: a
+        # call started after pf_destroy returns would be a dangling handle
+        self._native_lock = threading.Lock()
         lib = _load()
         if lib is not None and self.paths:
             arr = (ctypes.c_char_p * len(self.paths))(
@@ -161,72 +174,102 @@ class WavPrefetcher:
             lib.pf_destroy(handle)
 
     def __iter__(self):
-        if self._closed:
-            raise RuntimeError(
-                "WavPrefetcher is single-use: it was already exhausted or "
-                "closed; construct a new one for another pass"
-            )
-        lib = _load()
+        # eager single-use guard: __iter__ is NOT a generator, so calling
+        # iter() twice raises immediately instead of handing out a second
+        # generator that would interleave the shared native ordinal stream
+        # (round-3 advisor finding); check-and-set under the lock so two
+        # threads cannot both pass it
+        with self._native_lock:
+            if self._closed or self._iterating:
+                raise RuntimeError(
+                    "WavPrefetcher is single-use: it is already being "
+                    "iterated or was closed; construct a new one for "
+                    "another pass"
+                )
+            self._iterating = True
         if self._handle is not None:
-            try:
-                # buffer sized in SAMPLES (2 channels of max_frames by
-                # default); pf_next returns -6 rather than truncate if a
-                # file needs more — raise max_frames for such corpora
-                cap_samples = self.max_frames * 2
-                buf = np.empty(cap_samples, dtype=np.float32)
-                sr = ctypes.c_int()
-                ch = ctypes.c_int()
-                for path in self.paths:
+            return self._iter_native()
+        if self._fallback:
+            return self._iter_fallback()
+        return iter(())
+
+    def _iter_native(self):
+        lib = _load()
+        try:
+            # buffer grown to each item's exact size via pf_next_size —
+            # no worst-case (max_frames*2 ≈ 128 MB) preallocation
+            buf = np.empty(1 << 18, dtype=np.float32)  # 1 MB start
+            sr = ctypes.c_int()
+            ch = ctypes.c_int()
+            for path in self.paths:
+                with self._native_lock:
+                    if self._handle is None:  # closed concurrently
+                        return
+                    need = lib.pf_next_size(self._handle)
+                    if need > buf.size:
+                        buf = np.empty(need, dtype=np.float32)
+                    elif buf.size > (1 << 18) and 0 < need < buf.size // 4:
+                        # shrink after an outlier so one huge file doesn't
+                        # pin its worst-case buffer for the rest of the epoch
+                        buf = np.empty(max(need, 1 << 18), dtype=np.float32)
                     got = lib.pf_next(
                         self._handle,
                         buf.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
-                        cap_samples, ctypes.byref(sr), ctypes.byref(ch),
+                        buf.size, ctypes.byref(sr), ctypes.byref(ch),
                     )
-                    if got == -1:  # exhausted (item errors are < -1)
-                        return
-                    if got < 0:
-                        raise IOError(
-                            f"prefetch decode failed (code {got}) for {path}"
-                            + (" — file exceeds max_frames" if got == -5 else "")
-                            + (" — buffer too small for channel count"
-                               if got == -6 else "")
-                        )
-                    samples = buf[: got * ch.value].copy()
-                    if ch.value > 1:
-                        samples = samples.reshape(-1, ch.value)
-                    yield sr.value, samples
-            finally:
-                # exhaustion, break, or error all join the C++ workers
-                self.close()
-            return
-        if self._fallback:
-            from collections import deque
+                if got == -1:  # exhausted (item errors are < -1)
+                    return
+                if got < 0:
+                    raise IOError(
+                        f"prefetch decode failed (code {got}) for {path}"
+                        + (" — file exceeds max_frames" if got == -5 else "")
+                        + (" — prefetcher was destroyed concurrently"
+                           if got == -8 else "")
+                    )
+                samples = buf[: got * ch.value].copy()
+                if ch.value > 1:
+                    samples = samples.reshape(-1, ch.value)
+                yield sr.value, samples
+        finally:
+            # exhaustion, break, or error all join the C++ workers
+            self.close()
 
-            pending: deque = deque()
-            try:
-                it = iter(self.paths)
-                # bounded work-ahead, honoring `capacity` like the C++ path
-                for p in it:
-                    pending.append(self._pool.submit(read_wav, p))
-                    if len(pending) >= self.capacity:
-                        break
-                for p in it:
-                    yield pending.popleft().result()
-                    pending.append(self._pool.submit(read_wav, p))
-                while pending:
-                    yield pending.popleft().result()
-            finally:
-                for fut in pending:
-                    fut.cancel()
-                self.close()
+    def _iter_fallback(self):
+        from collections import deque
+        from concurrent.futures import CancelledError
+
+        pending: deque = deque()
+        try:
+            it = iter(self.paths)
+            # bounded work-ahead, honoring `capacity` like the C++ path
+            for p in it:
+                pending.append(self._pool.submit(read_wav, p))
+                if len(pending) >= self.capacity:
+                    break
+            for p in it:
+                yield pending.popleft().result()
+                pending.append(self._pool.submit(read_wav, p))
+            while pending:
+                yield pending.popleft().result()
+        except (CancelledError, RuntimeError):
+            # concurrent close() cancels pending futures / shuts the pool
+            # down; mirror the native path's clean stop rather than leaking
+            # the pool's internals to the consumer
+            if not self._closed:
+                raise
+        finally:
+            for fut in pending:
+                fut.cancel()
+            self.close()
 
     def close(self):
         self._closed = True
         lib = _load()
-        if self._handle is not None and lib is not None:
-            self._finalizer.detach()  # we destroy now; finalizer must not
-            lib.pf_destroy(self._handle)
-            self._handle = None
+        with self._native_lock:
+            if self._handle is not None and lib is not None:
+                self._finalizer.detach()  # we destroy now; finalizer must not
+                lib.pf_destroy(self._handle)
+                self._handle = None
         if self._fallback:
             self._pool.shutdown(wait=False, cancel_futures=True)
             self._fallback = None

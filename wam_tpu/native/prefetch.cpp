@@ -10,6 +10,11 @@
 //
 // API (C linkage; see wam_tpu/native/__init__.py for the ctypes bindings):
 //   pf_create(paths, n, workers, capacity, max_frames) -> handle (0 on err)
+//   pf_next_size(handle)
+//       -> frames*channels of the NEXT ordinal item (blocking) WITHOUT
+//          consuming it, so the caller can size its buffer exactly;
+//          negative codes as pf_next (the erroneous item stays queued —
+//          the following pf_next consumes and reports it).
 //   pf_next(handle, out, max_samples, &sample_rate, &channels)
 //       -> frames written for the NEXT ordinal item (blocking),
 //          -1 ONLY when the path list is exhausted; per-item failures are
@@ -17,9 +22,17 @@
 //            -11/-12/-13 : wavio decode error (wav error code - 10)
 //            -5          : file longer than max_frames (raise the limit)
 //            -6          : frames*channels exceeds the caller's buffer
+//                          (item NOT consumed — grow and retry)
+//            -8          : pf_destroy ran concurrently (stopping); the
+//                          handle must be considered dead
 //          Truncation is never silent — parity with read_wav's full decode
 //          is an error, not a clamp.
 //   pf_destroy(handle)
+//
+// pf_destroy may race an in-flight pf_next/pf_next_size on the same
+// handle: it wakes blocked consumers (they return -8) and DRAINS them —
+// the delete only happens once every in-flight call has left. Calls
+// STARTED after pf_destroy returns are still undefined (dangling handle).
 //
 // Decoding reuses wavio.cpp's wav_read_f32/wav_info (both sources are
 // compiled into one shared library).
@@ -54,11 +67,25 @@ struct Prefetcher {
   std::mutex mu;
   std::condition_variable cv_space;  // workers wait for queue space
   std::condition_variable cv_ready;  // consumer waits for the next ordinal
+  std::condition_variable cv_drained;  // pf_destroy waits for consumers
   std::map<size_t, Item> ready;      // finished items keyed by index
   size_t next_submit = 0;            // next index a worker should take
   size_t next_consume = 0;           // next index the consumer wants
+  int consumers_in_call = 0;         // pf_next/pf_next_size currently inside
   bool stopping = false;
   std::vector<std::thread> workers;
+
+  // RAII guard counting consumers so pf_destroy can drain them before
+  // deleting. Must be constructed and destructed WITH mu held; everything a
+  // consumer touches after the guard drops must be thread-local.
+  struct ConsumerGuard {
+    Prefetcher* pf;
+    explicit ConsumerGuard(Prefetcher* p) : pf(p) { ++pf->consumers_in_call; }
+    ~ConsumerGuard() {
+      if (--pf->consumers_in_call == 0 && pf->stopping)
+        pf->cv_drained.notify_all();
+    }
+  };
 
   void worker_loop() {
     for (;;) {
@@ -129,25 +156,47 @@ void* pf_create(const char** paths, long n, int n_workers, long capacity,
   return pf;
 }
 
+long pf_next_size(void* handle) {
+  auto* pf = static_cast<Prefetcher*>(handle);
+  std::unique_lock<std::mutex> lk(pf->mu);
+  Prefetcher::ConsumerGuard guard(pf);  // destructs before lk unlocks
+  if (pf->stopping) return -8;
+  if (pf->next_consume >= pf->paths.size()) return -1;  // exhausted
+  size_t want = pf->next_consume;
+  pf->cv_ready.wait(lk, [&] { return pf->stopping || pf->ready.count(want) > 0; });
+  if (pf->stopping) return -8;
+  const Item& item = pf->ready[want];
+  if (item.frames < 0) return item.frames;
+  return item.frames * item.channels;
+}
+
 long pf_next(void* handle, float* out, long max_samples, int* sample_rate,
              int* channels) {
   auto* pf = static_cast<Prefetcher*>(handle);
   Item item;
   {
     std::unique_lock<std::mutex> lk(pf->mu);
+    Prefetcher::ConsumerGuard guard(pf);  // destructs before lk unlocks
+    if (pf->stopping) return -8;
     if (pf->next_consume >= pf->paths.size()) return -1;  // exhausted
     size_t want = pf->next_consume;
-    pf->cv_ready.wait(lk, [&] { return pf->ready.count(want) > 0; });
-    item = std::move(pf->ready[want]);
+    pf->cv_ready.wait(lk, [&] { return pf->stopping || pf->ready.count(want) > 0; });
+    if (pf->stopping) return -8;
+    Item& peek = pf->ready[want];
+    if (peek.frames >= 0 && peek.frames * peek.channels > max_samples) {
+      return -6;  // buffer small; item stays queued — grow and retry
+    }
+    item = std::move(peek);
     pf->ready.erase(want);
     pf->next_consume = want + 1;
+    // notify under the lock: after the guard drops, this thread must not
+    // touch pf again (pf_destroy may be freeing it)
+    pf->cv_space.notify_all();  // consuming freed work-ahead budget
   }
-  pf->cv_space.notify_all();  // consuming freed work-ahead budget
 
   if (item.frames < 0) return item.frames;
   *sample_rate = item.sample_rate;
   *channels = item.channels;
-  if (item.frames * item.channels > max_samples) return -6;  // buffer small
   std::memcpy(out, item.samples.data(),
               static_cast<size_t>(item.frames) * item.channels *
                   sizeof(float));
@@ -157,11 +206,15 @@ long pf_next(void* handle, float* out, long max_samples, int* sample_rate,
 void pf_destroy(void* handle) {
   auto* pf = static_cast<Prefetcher*>(handle);
   {
-    std::lock_guard<std::mutex> lk(pf->mu);
+    std::unique_lock<std::mutex> lk(pf->mu);
     pf->stopping = true;
+    pf->cv_space.notify_all();
+    pf->cv_ready.notify_all();
+    // drain in-flight pf_next/pf_next_size calls: they wake on cv_ready,
+    // observe stopping, return -8, and drop their ConsumerGuard under mu —
+    // only then is deleting pf safe
+    pf->cv_drained.wait(lk, [&] { return pf->consumers_in_call == 0; });
   }
-  pf->cv_space.notify_all();
-  pf->cv_ready.notify_all();
   for (auto& t : pf->workers) t.join();
   delete pf;
 }

@@ -243,17 +243,25 @@ def dwt2_pallas(x: jax.Array, wavelet, mode: str) -> jax.Array:
     `transform._analysis(x, wav, mode, 2)`; differentiable (custom VJP is the
     exact adjoint matmul pair).
 
-    bf16 inputs are accepted as-is (half the HBM read traffic) and upcast
-    inside the kernel; coefficients come back FLOAT32 in every case, so the
-    multi-level approx cascade never re-rounds to bf16 between levels — the
-    round-2 ablation measured that cascade costing cosine 0.9987 → 0.977
-    (VERDICT.md round-2 #6)."""
+    Dtype contract: bf16 inputs are accepted as-is (half the HBM read
+    traffic) and upcast inside the kernel; bf16 and f32 inputs both return
+    FLOAT32 coefficients, so the multi-level approx cascade never re-rounds
+    to bf16 between levels — the round-2 ablation measured that cascade
+    costing cosine 0.9987 → 0.977 (VERDICT.md round-2 #6). Float64 inputs
+    (x64 mode) round-trip to float64-TYPED output for downstream dtype
+    compatibility, but the kernel itself computes in f32 — for genuine f64
+    precision select the conv or matmul impl
+    (`wam_tpu.wavelets.set_dwt2_impl("conv")`), since on TPU the default
+    "auto" impl routes `transform.wavedec2` back to this kernel."""
     h, w = x.shape[-2:]
     A = analysis_matrices(h, wavelet, mode, jnp.float32)
     B = analysis_matrices(w, wavelet, mode, jnp.float32)
     batch_shape = x.shape[:-2]
     x3 = x.reshape((-1, h, w))
+    wide = x3.dtype == jnp.float64
     if x3.dtype != jnp.bfloat16:
         x3 = x3.astype(jnp.float32)
     out = _dwt2_pallas_core(x3, A, B.T)
+    if wide:
+        out = out.astype(x.dtype)
     return out.reshape(batch_shape + out.shape[1:])
