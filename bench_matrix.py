@@ -10,19 +10,50 @@ and prints one JSON line per config, optionally appending to a JSONL file:
   4. wam_3D 3D-ResNet-18, 32^3 volumes, haar, J=2, SmoothGrad n=25
   5. wam_2D ViT-B/16, Integrated Gradients, 64-step path
 
-Usage: python bench_matrix.py [--quick] [--f32] [--out results/matrix.jsonl]
+Every row is a MEDIAN of k repetitions with the IQR recorded (round-3
+verdict weak #2: short tunneled-TPU workloads vary ±10%, so a single min
+cannot adjudicate a 10% delta). `--compare prev.jsonl` diffs each metric
+against the latest same-named row of a previous run and flags a delta as
+significant only when the two [q1, q3] intervals do not overlap.
+
+Usage: python bench_matrix.py [--quick] [--f32] [--repeats K]
+                              [--out results/matrix.jsonl]
+                              [--compare results/matrix_prev.jsonl]
 """
 
 import argparse
 import json
 
 
-def _timed(run, *args, repeats=3, laps=1):
-    from wam_tpu.profiling import bench_time
+def _sampled(run, *, k=7, laps=1):
+    from wam_tpu.profiling import bench_samples
 
     # laps>1 amortizes the tunneled-TPU host round trip (~100 ms measured)
     # over in-order executions — see BASELINE.md round-2 methodology note.
-    return bench_time(run, *args, repeats=repeats, laps=laps)
+    return bench_samples(run, k=k, laps=laps)
+
+
+def _norm_platform(p):
+    """Pre-round-4 rows recorded the probe string ('axon'/'auto') instead of
+    the resolved backend; both mean the tunneled TPU."""
+    return "tpu" if p in ("axon", "auto") else p
+
+
+def _load_compare(path):
+    """Latest row per (metric, platform, dtype) from a previous JSONL (later
+    rows win) — keyed on the full configuration so a CPU-demoted or --f32
+    run never diffs against a TPU/bf16 row."""
+    from wam_tpu.results import read_jsonl
+
+    try:
+        rows = read_jsonl(path)
+    except Exception:
+        return {}
+    return {
+        (r["metric"], _norm_platform(r.get("platform")), r.get("dtype")): r
+        for r in rows
+        if isinstance(r, dict) and "metric" in r
+    }
 
 
 def main():
@@ -30,15 +61,26 @@ def main():
     ap.add_argument("--quick", action="store_true", help="tiny shapes, smoke only")
     ap.add_argument("--f32", action="store_true", help="disable bf16 model compute")
     ap.add_argument("--out", default=None, help="append JSONL records here")
+    ap.add_argument("--compare", default=None,
+                    help="previous JSONL; flag significant deltas per metric")
+    ap.add_argument("--repeats", type=int, default=None,
+                    help="k repetitions per row (default 7 on accelerator, 3 on CPU)")
     args = ap.parse_args()
+    if args.repeats is not None and args.repeats < 1:
+        ap.error("--repeats must be >= 1")  # before the 180 s backend probe
 
     from wam_tpu.config import enable_compilation_cache, ensure_usable_backend
 
-    platform = ensure_usable_backend(timeout_s=180.0)
+    ensure_usable_backend(timeout_s=180.0)
     enable_compilation_cache()
 
     import jax
     import jax.numpy as jnp
+
+    # resolve the backend that will ACTUALLY run (the tunnel is
+    # single-client; a concurrent holder demotes this process to CPU after
+    # a successful probe — memory: axon-tpu-tunnel-gotchas)
+    platform = jax.default_backend()
 
     from wam_tpu import WaveletAttribution1D, WaveletAttribution2D, WaveletAttribution3D
     from wam_tpu.models import bind_inference, resnet3d_18, resnet50
@@ -49,6 +91,8 @@ def main():
     q = args.quick
     on_accel = platform != "cpu"
     dtype = None if args.f32 else jnp.bfloat16
+    k = args.repeats if args.repeats is not None else (7 if on_accel and not q else 3)
+    prev = _load_compare(args.compare) if args.compare else {}
 
     writer = None
     if args.out:
@@ -56,15 +100,39 @@ def main():
 
         writer = JsonlWriter(args.out)
 
-    def record(name, n_items, seconds, unit="items/s"):
+    def record(name, n_items, samples, unit="items/s"):
+        from wam_tpu.profiling import median_iqr
+
+        med, q1, q3, iqr = median_iqr(samples)
         rec = {
             "metric": name,
-            "value": round(n_items / seconds, 3),
+            "value": round(n_items / med, 3),
             "unit": unit,
-            "seconds": round(seconds, 4),
+            "seconds": round(med, 4),
+            "k": len(samples),
+            # throughput-space quartiles: q3 seconds is the SLOW quartile
+            "value_q1": round(n_items / q3, 3),
+            "value_q3": round(n_items / q1, 3),
+            "iqr_pct": round(100.0 * iqr / med, 2) if med else None,
+            "samples_s": [round(s, 4) for s in samples],
             "platform": platform,
             "dtype": "float32" if args.f32 else "bfloat16",
         }
+        old = prev.get((name, rec["platform"], rec["dtype"]))
+        if old and "value" in old:
+            rec["prev_value"] = old["value"]
+            rec["delta_pct"] = round(100.0 * (rec["value"] - old["value"])
+                                     / old["value"], 2)
+            if "value_q1" in old and "value_q3" in old:
+                # significant = the [q1, q3] throughput intervals don't overlap
+                rec["significant"] = bool(
+                    rec["value_q1"] > old["value_q3"]
+                    or rec["value_q3"] < old["value_q1"]
+                )
+            else:
+                # legacy single-min row: no spread to test against — leave
+                # the verdict open instead of flagging tunnel noise
+                rec["significant"] = None
         print(json.dumps(rec), flush=True)
         if writer is not None:
             # written per row so an interrupted sweep keeps finished results
@@ -81,34 +149,38 @@ def main():
 
     # 1. base single-image pass ------------------------------------------------
     image = 64 if q else 224
-    # --f32 disables the PARAMETER rewrites (fold_bn / stem_s2d) along with
-    # bf16. Execution-form rewrites that are unconditional in the models
+    # --f32 disables the fold_bn parameter rewrite along with bf16.
+    # stem_s2d is OFF to match bench.py's round-3 retirement (a measured tie
+    # under the 128-row schedule that adds model-seam re-tiling copies).
+    # Execution-form rewrites that are unconditional in the models
     # (PatchConv patch embeddings, vit.py/convnext.py) still apply; the
     # pre-rewrite baselines are the recorded round-1 rows in BASELINE.md.
     use_rewrites = not args.f32
-    fn50 = vision_fn(resnet50, image, fold_bn=use_rewrites,
-                     stem_s2d=use_rewrites and image % 2 == 0)
+    fn50 = vision_fn(resnet50, image, fold_bn=use_rewrites)
     base = BaseWAM2D(fn50, wavelet="haar", J=3, mode="reflect")
     x1 = jax.random.normal(jax.random.PRNGKey(1), (1, 3, image, image), jnp.float32)
     y1 = jnp.zeros((1,), jnp.int32)
     record("wam2d_base_resnet50_single_haar_J3", 1,
-           _timed(lambda: base(x1, y1), laps=laps))
+           _sampled(lambda: base(x1, y1), k=k, laps=laps))
 
     # 2. flagship SmoothGrad ---------------------------------------------------
     batch, n = (4, 3) if q else (32, 25)
-    # round-3 schedule: 128-row sample chunks + bf16 DWT boundary cast
-    # (BASELINE.md scaling study; the other workloads measured fastest at
-    # full sample vmap, so only this row chunks)
+    # Scheduling is the class default ("auto" = 128-row sample chunks +
+    # streamed noise on TPU since round 4) so this row measures exactly what
+    # `WaveletAttribution2D(fn)` gives a user out of the box — the round-3
+    # verdict's library/bench divergence is gone by construction.
     ex2 = WaveletAttribution2D(
         fn50, wavelet="db4", J=3, method="smooth", n_samples=n,
-        sample_batch_size=(4 if not q else n) if on_accel else 1,
         dwt_bf16=on_accel and not args.f32,
-        stream_noise=bool(on_accel),
+        # off-accelerator (tunnel demoted to CPU): "auto" would full-vmap
+        # 25×b rows + materialize the noise buffer — keep the old safe
+        # one-sample-at-a-time CPU schedule instead
+        **({} if on_accel else {"sample_batch_size": 1, "stream_noise": False}),
     )
     x2 = jax.random.normal(jax.random.PRNGKey(2), (batch, 3, image, image), jnp.float32)
     y2 = jnp.arange(batch, dtype=jnp.int32) % 1000
     record(f"wam2d_smoothgrad_resnet50_b{batch}_db4_n{n}", batch,
-           _timed(lambda: ex2(x2, y2), laps=laps), "images/s")
+           _sampled(lambda: ex2(x2, y2), k=k, laps=laps), "images/s")
 
     # Workloads 3-5 are built by bench_workloads.py — the SAME builders the
     # chunk-sweep tuner uses, so tuning always measures this exact config.
@@ -123,14 +195,14 @@ def main():
     ex3, x3, y3 = audio_workload(an if on_accel else 1, b=ab, n=an,
                                  wave_len=wave_len)
     record(f"wam1d_smoothgrad_audiocnn_b{ab}_db6_J5_n{an}", ab,
-           _timed(lambda: ex3(x3, y3), laps=laps), "waveforms/s")
+           _sampled(lambda: ex3(x3, y3), k=k, laps=laps), "waveforms/s")
 
     # 4. 3D SmoothGrad (full sample vmap fastest, round-3 sweep) ---------------
     size = 16 if q else 32
     vb, vn = (2, 3) if q else (8, 25)
     ex4, x4, y4 = vol_workload(vn if on_accel else 1, b=vb, n=vn, size=size)
     record(f"wam3d_smoothgrad_resnet3d18_b{vb}_{size}cube_haar_J2_n{vn}", vb,
-           _timed(lambda: ex4(x4, y4), laps=laps), "volumes/s")
+           _sampled(lambda: ex4(x4, y4), k=k, laps=laps), "volumes/s")
 
     # 5. ViT IG path (chunk 16 marginally fastest, round-3 sweep) --------------
     steps = 4 if q else 64
@@ -139,7 +211,7 @@ def main():
         steps=steps, image=image, compute_dtype=dtype,
     )
     record(f"wam2d_ig_vitb16_path{steps}", 1,
-           _timed(lambda: ex5(x5, y5), laps=laps))
+           _sampled(lambda: ex5(x5, y5), k=k, laps=laps))
 
 
 if __name__ == "__main__":

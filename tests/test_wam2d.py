@@ -92,6 +92,96 @@ def test_unknown_method_raises(model_fn):
         WaveletAttribution2D(model_fn, method="nope")
 
 
+class _NHWCNet(nn.Module):
+    """Genuinely layout-sensitive tiny model: consumes (B, H, W, C)."""
+
+    classes: int = 5
+
+    @nn.compact
+    def __call__(self, x):
+        x = nn.Conv(8, (3, 3), strides=(2, 2))(x)
+        x = nn.relu(x)
+        x = x.mean(axis=(1, 2))
+        return nn.Dense(self.classes)(x)
+
+
+@pytest.fixture(scope="module")
+def nhwc_pair():
+    """(fn_nhwc, fn_nchw): the SAME bound network, consumed channel-last vs
+    with the classic per-call transpose."""
+    model = _NHWCNet()
+    params = model.init(jax.random.PRNGKey(7), jnp.zeros((1, 32, 32, 3)))
+    fn_nhwc = lambda x: model.apply(params, x)
+    fn_nchw = lambda x: fn_nhwc(jnp.transpose(x, (0, 2, 3, 1)))
+    return fn_nhwc, fn_nchw
+
+
+def test_model_layout_nhwc_base_matches_nchw(nhwc_pair):
+    """model_layout="nhwc" (channel-last engine, wavelets.nhwc) must produce
+    the same mosaic/scales/coefficients as the classic NCHW path for the
+    deterministic base pass — same NCHW caller contract, zero per-sample
+    layout copies inside (round-3 verdict #1)."""
+    fn_nhwc, fn_nchw = nhwc_pair
+    x = jnp.asarray(np.random.default_rng(11).standard_normal((2, 3, 32, 32)), jnp.float32)
+    y = jnp.array([1, 3])
+    ref = BaseWAM2D(fn_nchw, wavelet="db2", J=2)
+    got = BaseWAM2D(fn_nhwc, wavelet="db2", J=2, model_layout="nhwc")
+    m_ref, m_got = ref(x, y), got(x, y)
+    np.testing.assert_allclose(np.asarray(m_got), np.asarray(m_ref), atol=2e-5)
+    np.testing.assert_allclose(np.asarray(got.scales), np.asarray(ref.scales), atol=2e-5)
+    # coefficient stash is channel-last: (B, h, w, C) vs (B, C, h, w)
+    a_ref, a_got = ref.wavelet_coeffs[0], got.wavelet_coeffs[0]
+    np.testing.assert_allclose(
+        np.asarray(jnp.moveaxis(a_got, -1, 1)), np.asarray(a_ref), atol=1e-5
+    )
+
+
+def test_model_layout_nhwc_ig_matches_nchw(nhwc_pair):
+    """Integrated gradients is draw-free, so the NHWC path must match the
+    NCHW path numerically, not just statistically."""
+    fn_nhwc, fn_nchw = nhwc_pair
+    x = jnp.asarray(np.random.default_rng(12).standard_normal((1, 3, 32, 32)), jnp.float32)
+    y = jnp.array([2])
+    ref = WaveletAttribution2D(fn_nchw, wavelet="db2", J=2,
+                               method="integratedgrad", n_samples=6)(x, y)
+    got = WaveletAttribution2D(fn_nhwc, wavelet="db2", J=2,
+                               method="integratedgrad", n_samples=6,
+                               model_layout="nhwc")(x, y)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=2e-5)
+
+
+def test_model_layout_nhwc_smoothgrad_statistics(nhwc_pair):
+    """SmoothGrad draws noise in the internal layout, so realizations differ
+    between layouts — assert shape/finiteness and that both paths agree on
+    the deterministic σ=0 limit (stdev_spread=0 makes every draw the input
+    itself)."""
+    fn_nhwc, fn_nchw = nhwc_pair
+    x = jnp.asarray(np.random.default_rng(13).standard_normal((2, 3, 32, 32)), jnp.float32)
+    y = jnp.array([0, 4])
+    got = WaveletAttribution2D(fn_nhwc, J=2, method="smooth", n_samples=4,
+                               model_layout="nhwc")(x, y)
+    assert got.shape[0] == 2 and np.all(np.isfinite(np.asarray(got)))
+    ref0 = WaveletAttribution2D(fn_nchw, J=2, method="smooth", n_samples=3,
+                                stdev_spread=0.0)(x, y)
+    got0 = WaveletAttribution2D(fn_nhwc, J=2, method="smooth", n_samples=3,
+                                stdev_spread=0.0, model_layout="nhwc")(x, y)
+    np.testing.assert_allclose(np.asarray(got0), np.asarray(ref0), atol=2e-5)
+
+
+def test_model_layout_rejects_unknown(model_fn):
+    with pytest.raises(ValueError):
+        BaseWAM2D(model_fn, model_layout="chwn")
+
+
+def test_schedule_params_reject_bad_strings(model_fn):
+    """Only exactly "auto" is accepted as a string: bool("false") is True,
+    so an unvalidated config string would silently invert stream_noise."""
+    with pytest.raises(ValueError):
+        WaveletAttribution2D(model_fn, sample_batch_size="Auto")
+    with pytest.raises(ValueError):
+        WaveletAttribution2D(model_fn, stream_noise="false")
+
+
 def test_sample_batching_equivalence(model_fn):
     """Chunked lax.map must give identical results to unchunked."""
     x = jnp.asarray(np.random.default_rng(5).standard_normal((1, 3, 32, 32)), dtype=jnp.float32)

@@ -129,7 +129,9 @@ class WAM2DConfig:
     n_samples: int = 25
     stdev_spread: float = 0.25
     random_seed: int = 42
-    sample_batch_size: int | None = None
+    # "auto" = the benched TPU schedule (~128 rows/step); see
+    # WaveletAttribution2D's scheduling docstring
+    sample_batch_size: int | None | str = "auto"
     device: str = "auto"
 
 
@@ -177,6 +179,16 @@ class EvalConfig:
     device: str = "auto"
 
 
+def _int_or_str(s: str):
+    """Converter for `int | None | str` fields (e.g. sample_batch_size:
+    4 / "auto"): argparse applies `type` to STRING DEFAULTS too, so a plain
+    int converter would crash parse_args() on the "auto" default."""
+    try:
+        return int(s)
+    except ValueError:
+        return s
+
+
 def add_config_args(parser: argparse.ArgumentParser, cfg_cls, prefix: str = "") -> None:
     """Register every dataclass field as a CLI flag (the thin CLI)."""
     for f in fields(cfg_cls):
@@ -187,7 +199,15 @@ def add_config_args(parser: argparse.ArgumentParser, cfg_cls, prefix: str = "") 
         else:
             typ = {int: int, float: float}.get(f.type, str)
             if isinstance(f.type, str):
-                typ = {"int": int, "float": float, "str": str}.get(f.type.split(" ")[0], str)
+                parts = {p.strip() for p in f.type.replace("|", " ").split()}
+                if "int" in parts and "str" in parts:
+                    typ = _int_or_str
+                elif "int" in parts:
+                    typ = int
+                elif "float" in parts:
+                    typ = float
+                else:
+                    typ = str
             default = f.default if f.default is not dataclasses.MISSING else None
             parser.add_argument(name, type=typ, default=default)
 

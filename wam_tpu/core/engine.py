@@ -48,6 +48,14 @@ class WamEngine:
     front_fn : optional differentiable transform between reconstruction and
         the model (the 1D melspec front-end, `lib/wam_1D.py:117-126`). Its
         gradients can be harvested via ``attribute_with_front_grads``.
+    channel_last : 2D only — inputs/reconstructions are NHWC (B, H, W, C)
+        and ``model_fn`` consumes NHWC directly
+        (``bind_inference(nchw=False)``), so no layout copy sits between
+        the IDWT and the model inside the per-sample step
+        (`wam_tpu.wavelets.nhwc`; round-3 layout-copy audit, BASELINE.md).
+        This path has exactly ONE implementation (axis-aware banded-matrix
+        contractions) — `wavelets.set_dwt2_impl` selects among the
+        last-two-axes impls and does NOT apply here.
     """
 
     def __init__(
@@ -59,22 +67,36 @@ class WamEngine:
         level: int = 3,
         mode: str = "reflect",
         front_fn: Callable[[jax.Array], jax.Array] | None = None,
+        channel_last: bool = False,
     ):
         if ndim not in (1, 2, 3):
             raise ValueError(f"ndim must be 1, 2 or 3, got {ndim}")
+        if channel_last and ndim != 2:
+            raise ValueError("channel_last is only supported for ndim=2")
         self.model_fn = model_fn
         self.ndim = ndim
         self.wavelet = wavelet
         self.level = level
         self.mode = mode
         self.front_fn = front_fn
+        self.channel_last = channel_last
 
     # -- decomposition / reconstruction ------------------------------------
 
     def decompose(self, x: jax.Array):
+        if self.channel_last:
+            from wam_tpu.wavelets.nhwc import wavedec2_nhwc
+
+            return wavedec2_nhwc(x, self.wavelet, self.level, self.mode)
         return _DEC[self.ndim](x, self.wavelet, self.level, self.mode)
 
     def reconstruct(self, coeffs, spatial_shape: Sequence[int]):
+        if self.channel_last:
+            from wam_tpu.wavelets.nhwc import waverec2_nhwc
+
+            rec = waverec2_nhwc(coeffs, self.wavelet)
+            h, w = spatial_shape
+            return rec[..., :h, :w, :]
         rec = _REC[self.ndim](coeffs, self.wavelet)
         # Reconstruction length is >= the original for non-haar filters /
         # odd sizes; crop to the model's expected spatial shape.
@@ -94,10 +116,16 @@ class WamEngine:
         the per-coefficient attribution."""
         return jax.grad(lambda cs: self._loss_from_coeffs(cs, y, spatial_shape))(coeffs)
 
+    def spatial_shape(self, x_shape) -> tuple:
+        """The transform's spatial dims of an input shape (layout-aware)."""
+        if self.channel_last:
+            return tuple(x_shape[-3:-1])
+        return tuple(x_shape[-self.ndim :])
+
     def attribute(self, x: jax.Array, y: jax.Array | None):
         """Full single pass: decompose → grads. Returns (coeffs, grads)."""
         coeffs = self.decompose(x)
-        grads = self.grads_from_coeffs(coeffs, y, x.shape[-self.ndim :])
+        grads = self.grads_from_coeffs(coeffs, y, self.spatial_shape(x.shape))
         return coeffs, grads
 
     def attribute_with_front_grads(self, x: jax.Array, y: jax.Array | None):

@@ -29,10 +29,13 @@
 //          is an error, not a clamp.
 //   pf_destroy(handle)
 //
-// pf_destroy may race an in-flight pf_next/pf_next_size on the same
-// handle: it wakes blocked consumers (they return -8) and DRAINS them —
-// the delete only happens once every in-flight call has left. Calls
-// STARTED after pf_destroy returns are still undefined (dangling handle).
+// pf_destroy may race an ALREADY-IN-FLIGHT pf_next/pf_next_size on the
+// same handle: it wakes blocked consumers (they return -8) and DRAINS
+// them — the delete only happens once every in-flight call has left. The
+// drain cannot see a call that has not yet locked the mutex, so the
+// caller must still guarantee no NEW pf_next/pf_next_size call starts
+// once pf_destroy has been CALLED (the Python wrapper serializes call
+// starts against close() with a lock for exactly this reason).
 //
 // Decoding reuses wavio.cpp's wav_read_f32/wav_info (both sources are
 // compiled into one shared library).

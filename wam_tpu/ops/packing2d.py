@@ -32,33 +32,37 @@ def _norm(a: jax.Array, enabled: bool) -> jax.Array:
     return a / jnp.where(m == 0, 1.0, m)
 
 
-def _prep(block: jax.Array, normalize: bool) -> jax.Array:
+def _prep(block: jax.Array, normalize: bool, channel_axis: int = 1) -> jax.Array:
     """abs → channel-mean → optional global-max normalization.
 
     Matches the reference order (mean over channels, then abs, then /max —
     `lib/wam_2D.py:243-256`); abs∘mean ≠ mean∘abs so the order matters.
+    ``channel_axis=-1`` handles NHWC coefficient leaves (B, h, w, C) from
+    the channel-last engine path (`wam_tpu.wavelets.nhwc`).
     """
-    return _norm(jnp.abs(block.mean(axis=1)), normalize)
+    return _norm(jnp.abs(block.mean(axis=channel_axis)), normalize)
 
 
-def mosaic_size(coeffs) -> int:
+def mosaic_size(coeffs, channel_axis: int = 1) -> int:
     """Mosaic side = 2 × finest-level detail size (lib/wam_2D.py:217)."""
-    return int(2 * coeffs[-1].horizontal.shape[-1])
+    axis = -1 if channel_axis == 1 else -2
+    return int(2 * coeffs[-1].horizontal.shape[axis])
 
 
-def mosaic2d(coeffs, normalize: bool = True) -> jax.Array:
+def mosaic2d(coeffs, normalize: bool = True, channel_axis: int = 1) -> jax.Array:
     """Pack per-coefficient values [cA, Detail2D_J..Detail2D_1] (each
-    (B, C, h, w)) into the dyadic mosaic (B, S, S).
+    (B, C, h, w), or (B, h, w, C) with ``channel_axis=-1``) into the dyadic
+    mosaic (B, S, S).
 
     Channel axis is averaged; each orientation block and the approximation
     are (optionally) normalized by their global max, reproducing
     `normalize_coeffs=True` semantics.
     """
-    size = mosaic_size(coeffs)
+    size = mosaic_size(coeffs, channel_axis)
     batch = coeffs[0].shape[0]
     out = jnp.zeros((batch, size, size), dtype=coeffs[0].dtype)
 
-    approx = _prep(coeffs[0], normalize)
+    approx = _prep(coeffs[0], normalize, channel_axis)
     ha = min(approx.shape[-2], size)
     wa = min(approx.shape[-1], size)
     out = out.at[:, :ha, :wa].set(approx[:, :ha, :wa])
@@ -72,9 +76,9 @@ def mosaic2d(coeffs, normalize: bool = True) -> jax.Array:
         # Off-diagonal blocks are (b, start)/(start, b): for non-dyadic
         # mosaic sizes (long filters) start != b, unlike the reference's
         # square-only assumption.
-        h = _prep(det.horizontal, normalize)[:, :start, :b]
-        v = _prep(det.vertical, normalize)[:, :b, :start]
-        d = _prep(det.diagonal, normalize)[:, :b, :b]
+        h = _prep(det.horizontal, normalize, channel_axis)[:, :start, :b]
+        v = _prep(det.vertical, normalize, channel_axis)[:, :b, :start]
+        d = _prep(det.diagonal, normalize, channel_axis)[:, :b, :b]
         out = out.at[:, start:end, start:end].set(d)
         out = out.at[:, start:end, :start].set(v)
         out = out.at[:, :start, start:end].set(h)
@@ -106,19 +110,21 @@ def reproject_mosaic(avg: jax.Array, levels: int, approx_coeffs: bool = False) -
     return jnp.stack(maps, axis=1)
 
 
-def disentangle_scales(coeffs, approx_coeffs: bool = False, size: int | None = None) -> jax.Array:
+def disentangle_scales(coeffs, approx_coeffs: bool = False, size: int | None = None,
+                       channel_axis: int = 1) -> jax.Array:
     """Per-level pixel-domain importance maps straight from coefficient
-    grads: (B, J(+1), S, S), finest level first (lib/wam_2D.py:133-198)."""
+    grads: (B, J(+1), S, S), finest level first (lib/wam_2D.py:133-198).
+    ``channel_axis=-1`` for NHWC coefficient leaves."""
     if size is None:
-        size = mosaic_size(coeffs)
+        size = mosaic_size(coeffs, channel_axis)
     maps = []
     for det in coeffs[1:][::-1]:
         total = (
-            _resize_bilinear(_prep(det.horizontal, True), size)
-            + _resize_bilinear(_prep(det.vertical, True), size)
-            + _resize_bilinear(_prep(det.diagonal, True), size)
+            _resize_bilinear(_prep(det.horizontal, True, channel_axis), size)
+            + _resize_bilinear(_prep(det.vertical, True, channel_axis), size)
+            + _resize_bilinear(_prep(det.diagonal, True, channel_axis), size)
         )
         maps.append(total)
     if approx_coeffs:
-        maps.append(_resize_bilinear(_prep(coeffs[0], True), size))
+        maps.append(_resize_bilinear(_prep(coeffs[0], True, channel_axis), size))
     return jnp.stack(maps, axis=1)

@@ -11,7 +11,8 @@ from collections import defaultdict
 
 import jax
 
-__all__ = ["trace", "StageTimer", "start_server", "profile_to", "device_sync", "bench_time"]
+__all__ = ["trace", "StageTimer", "start_server", "profile_to", "device_sync",
+           "bench_time", "bench_samples", "median_iqr"]
 
 
 def device_sync(out) -> None:
@@ -47,6 +48,35 @@ def bench_time(fn, *args, repeats: int = 3, laps: int = 1) -> float:
         device_sync(out)
         times.append((time.perf_counter() - t0) / laps)
     return min(times)
+
+
+def bench_samples(fn, *args, k: int = 7, laps: int = 1, warmup: int = 1) -> list[float]:
+    """``k`` independent lap-amortized wall-clock samples (seconds/call).
+
+    Same regions as `bench_time` but ALL samples are returned instead of the
+    min, so the caller can report median + IQR — short workloads on the
+    tunneled TPU vary ±10% run to run, and a single min cannot adjudicate a
+    10% regression (VERDICT.md round-3 weak #2)."""
+    for _ in range(max(1, warmup)):
+        device_sync(fn(*args))
+    times = []
+    for _ in range(k):
+        t0 = time.perf_counter()
+        out = None
+        for _ in range(laps):
+            out = fn(*args)
+        device_sync(out)
+        times.append((time.perf_counter() - t0) / laps)
+    return times
+
+
+def median_iqr(samples: list[float]) -> tuple[float, float, float, float]:
+    """(median, q1, q3, iqr) of a sample list (linear-interpolated quartiles)."""
+    import numpy as np
+
+    a = np.asarray(sorted(samples), dtype=np.float64)
+    q1, med, q3 = np.quantile(a, [0.25, 0.5, 0.75])
+    return float(med), float(q1), float(q3), float(q3 - q1)
 
 
 @contextlib.contextmanager

@@ -1,0 +1,110 @@
+"""Channel-last (NHWC) 2D DWT/IDWT — the flagship layout-seam killer.
+
+The standard `transform.wavedec2` operates on the LAST two axes, so the 2D
+engine historically ran NCHW and `bind_inference(nchw=True)` transposed the
+reconstruction to NHWC inside every mapped sample-chunk — the
+`%copy.179/.184` layout copies in the round-3 op-level audit (BASELINE.md),
+~3.5% of the flagship step plus the mirrored cotangent copies on the way
+back. Here the analysis/synthesis run directly over axes (-3, -2) of an
+NHWC tensor as per-axis banded-matrix contractions (the
+`wavelets.matmul` formulation, reused): channels ride along as a trailing
+vectorized dim, the model consumes the reconstruction with ZERO layout
+conversion, and the coefficient gradients come back NHWC for channel-mean
+mosaic packing (`ops.packing2d.mosaic2d(channel_axis=-1)`).
+
+Boundary modes, filters, and values are identical to the NCHW path
+(`tests/test_dwt.py::test_nhwc_matches_nchw_*` — same matrices, different
+contraction axes). dtype policy is the framework-wide bf16-in /
+f32-accumulate: bf16 inputs contract with f32 accumulation
+(`preferred_element_type`), coefficients come back float32.
+
+Reference being replaced: the torch NCHW pipeline of `lib/wam_2D.py:96-116`
+(ptwt is NCHW-only; TPU convs are NHWC-native, so the layout boundary moves
+from per-sample to never).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from wam_tpu.wavelets.matmul import analysis_matrices, synthesis_matrices
+from wam_tpu.wavelets.transform import Detail2D, _resolve
+
+__all__ = ["dwt2_nhwc", "idwt2_nhwc", "wavedec2_nhwc", "waverec2_nhwc"]
+
+
+def _contract_rows(M: jax.Array, x: jax.Array) -> jax.Array:
+    """einsum('pH,...HWc->...pWc') with f32 accumulation."""
+    return jnp.einsum(
+        "pH,...HWc->...pWc", M, x,
+        precision=lax.Precision.HIGHEST, preferred_element_type=jnp.float32,
+    )
+
+
+def _contract_cols(x: jax.Array, M: jax.Array) -> jax.Array:
+    """einsum('...HWc,qW->...Hqc') with f32 accumulation."""
+    return jnp.einsum(
+        "...HWc,qW->...Hqc", x, M,
+        precision=lax.Precision.HIGHEST, preferred_element_type=jnp.float32,
+    )
+
+
+def dwt2_nhwc(x: jax.Array, wavelet, mode: str = "reflect"):
+    """Single-level 2D DWT over axes (-3, -2) of (..., H, W, C).
+
+    Returns (cA, Detail2D), every leaf (..., H', W', C) float32 — the same
+    values and subband convention as `transform.dwt2` on the transposed
+    input (horizontal = row-detail block, vertical = col-detail block)."""
+    wav = _resolve(wavelet)
+    h, w = x.shape[-3], x.shape[-2]
+    A = analysis_matrices(h, wav, mode, jnp.float32)
+    B = analysis_matrices(w, wav, mode, jnp.float32)
+    y = _contract_cols(_contract_rows(A, x), B)  # (..., 2h', 2w', C) blocks
+    hp, wp = A.shape[0] // 2, B.shape[0] // 2
+    aa = y[..., :hp, :wp, :]
+    ad = y[..., :hp, wp:, :]
+    da = y[..., hp:, :wp, :]
+    dd = y[..., hp:, wp:, :]
+    return aa, Detail2D(horizontal=da, vertical=ad, diagonal=dd)
+
+
+def idwt2_nhwc(cA: jax.Array, detail: Detail2D, wavelet, out_shape=None):
+    """Inverse of `dwt2_nhwc`: (..., H', W', C) leaves -> (..., H, W, C)."""
+    wav = _resolve(wavelet)
+    n0, n1 = cA.shape[-3], cA.shape[-2]
+    L = wav.filt_len
+    target = (2 * n0 - L + 2, 2 * n1 - L + 2) if out_shape is None else tuple(out_shape)
+    top = jnp.concatenate([cA, detail.vertical], axis=-2)
+    bot = jnp.concatenate([detail.horizontal, detail.diagonal], axis=-2)
+    y = jnp.concatenate([top, bot], axis=-3)  # (..., 2h', 2w', C) blocks
+    S_r = synthesis_matrices(n0, wav, jnp.float32)
+    S_c = synthesis_matrices(n1, wav, jnp.float32)
+    out = _contract_cols(_contract_rows(S_r, y), S_c)
+    return out[..., : target[0], : target[1], :]
+
+
+def wavedec2_nhwc(x: jax.Array, wavelet, level: int, mode: str = "reflect"):
+    """Multi-level NHWC 2D DWT: [cA_J, Detail2D_J, ..., Detail2D_1], each
+    leaf (..., h, w, C) — `transform.wavedec2`'s structure, channel-last."""
+    wav = _resolve(wavelet)
+    coeffs = []
+    a = x
+    for _ in range(level):
+        a, det = dwt2_nhwc(a, wav, mode)
+        coeffs.append(det)
+    coeffs.append(a)
+    return coeffs[::-1]
+
+
+def waverec2_nhwc(coeffs, wavelet):
+    """Inverse of `wavedec2_nhwc`."""
+    wav = _resolve(wavelet)
+    a = coeffs[0]
+    for det in coeffs[1:]:
+        tgt = det.horizontal.shape[-3:-1]
+        a = a[..., : tgt[0], : tgt[1], :]
+        L = wav.filt_len
+        a = idwt2_nhwc(a, det, wav, out_shape=(2 * tgt[0] - L + 2, 2 * tgt[1] - L + 2))
+    return a
