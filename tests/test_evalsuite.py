@@ -726,3 +726,81 @@ def test_lrp_under_bf16_evaluator_runs_f32(img_model_fn):
     # params were cast to bf16 at evaluator init (lossy) before the walker
     # upcasts — agreement is bounded by that one rounding, not exactness
     np.testing.assert_allclose(np.asarray(rbf), np.asarray(r32), atol=3e-4)
+
+
+def test_eval1dwam_auc_mesh_matches_single_device():
+    """Eval1DWAM (previously untested directly) through both targets, and
+    the mesh path must reproduce the single-device batched runner — the
+    round-4 one-dispatch shard_map fan (no per-sample loop on-mesh)."""
+    from wam_tpu.evalsuite.eval1d import Eval1DWAM
+    from wam_tpu.parallel import make_mesh
+
+    if len(jax.devices()) < 2:
+        pytest.skip("requires 2 virtual devices")
+
+    model = TinyAudioModel()
+    # waveform length 2048 -> melspec frames under the tiny config below
+    n_fft, n_mels, sr = 256, 12, 8000
+    import wam_tpu.ops.melspec as ms
+
+    probe = ms.melspectrogram(jnp.zeros((1, 2048)), sample_rate=sr,
+                              n_fft=n_fft, n_mels=n_mels)
+    t_frames = probe.shape[-2]
+    variables = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 1, t_frames, n_mels)))
+    model_fn = lambda m: model.apply(variables, m)
+
+    rng = np.random.default_rng(31)
+    x = jnp.asarray(rng.standard_normal((3, 2048)), dtype=jnp.float32)
+    y = [0, 2, 1]
+
+    # fixed fake explainer with the documented interface:
+    # (mel grads (B, T, M), coefficient-grad list)
+    from wam_tpu.wavelets import wavedec
+
+    coeffs = wavedec(x, "db2", level=3, mode="reflect")
+    mel_grads = jnp.asarray(rng.standard_normal((3, t_frames, n_mels)), jnp.float32)
+    coeff_grads = [jnp.asarray(rng.standard_normal(c.shape), jnp.float32) for c in coeffs]
+    explainer = lambda xx, yy: (mel_grads, coeff_grads)
+
+    def build(mesh=None):
+        return Eval1DWAM(model_fn, explainer, wavelet="db2", J=3,
+                         n_mels=n_mels, n_fft=n_fft, sample_rate=sr,
+                         batch_size=16, mesh=mesh)
+
+    ev = build()
+    mesh = make_mesh({"data": 2}, devices=jax.devices()[:2])
+    evm = build(mesh)
+
+    for target in ("wavelet", "melspec"):
+        ins = ev.insertion(x, y, target=target, n_iter=4)
+        ins_m = evm.insertion(x, y, target=target, n_iter=4)
+        np.testing.assert_allclose(ins, ins_m, atol=1e-5, err_msg=target)
+    fid = ev.input_fidelity(x, y)
+    fid_m = evm.input_fidelity(x, y)
+    assert fid == fid_m
+
+
+def test_eval2dwam_auc_mesh_matches_single_device(img_model_fn):
+    """Insertion/deletion through Eval2DWAM's mesh path (now the sharded
+    one-dispatch runner) must equal the single-device scores, including a
+    batch size that does not divide the mesh axis (cyclic pad + slice)."""
+    from wam_tpu.evalsuite.eval2d import Eval2DWAM
+    from wam_tpu.parallel import make_mesh
+
+    if len(jax.devices()) < 2:
+        pytest.skip("requires 2 virtual devices")
+
+    rng = np.random.default_rng(33)
+    fixed = jnp.asarray(rng.standard_normal((3, 32, 32)), dtype=jnp.float32)
+    explainer = lambda x, y: fixed
+    x = jnp.asarray(rng.standard_normal((3, 3, 32, 32)), dtype=jnp.float32)  # 3 % 2 != 0
+    y = [1, 4, 0]
+
+    ev = Eval2DWAM(img_model_fn, explainer, wavelet="haar", J=2, batch_size=16)
+    mesh = make_mesh({"data": 2}, devices=jax.devices()[:2])
+    evm = Eval2DWAM(img_model_fn, explainer, wavelet="haar", J=2, batch_size=16,
+                    mesh=mesh)
+    for metric in ("insertion", "deletion"):
+        a = getattr(ev, metric)(x, y, n_iter=4)
+        b = getattr(evm, metric)(x, y, n_iter=4)
+        np.testing.assert_allclose(a, b, atol=1e-5, err_msg=metric)

@@ -247,3 +247,63 @@ def test_eval_baselines_sharded_inference():
     s_single = single.insertion(x, y, n_iter=16)
     s_sharded = sharded.insertion(x, y, n_iter=16)
     np.testing.assert_allclose(s_sharded, s_single, atol=1e-5)
+
+
+@pytest.mark.slow
+def test_sharded_smoothgrad_hlo_audit():
+    """Interrogate the COMPILED sharded flagship graph (round-4 verdict #5):
+    the (n_samples, B, H, W, C) noise buffer must never materialize
+    unsharded on a device, the sample mean must be a cross-device
+    all-reduce, and per-device temp memory must stay within the v5e budget.
+    Fails if a future change silently replicates the noise buffer.
+
+    Also pins the KNOWN propagation limit discovered by this audit: vmap's
+    conv batching rule merges the (sample, data) axes into one model-batch
+    dim whose product sharding XLA cannot represent, so the data axis is
+    all-gathered at the model input (model compute replicated across data
+    shards; see parallel/sharded.py). If that gather DISAPPEARS, this test
+    fails too — delete the pin and close the shard_map-redesign task."""
+    _need_devices(8)
+    from wam_tpu.models import bind_inference, resnet18
+
+    N, B, IM = 8, 8, 64
+    model = resnet18(num_classes=10)
+    variables = model.init(jax.random.PRNGKey(0), jnp.zeros((1, IM, IM, 3)))
+    fn = bind_inference(model, variables, nchw=False)
+    eng = WamEngine(fn, ndim=2, wavelet="db4", level=3, mode="reflect",
+                    channel_last=True)
+    y = jnp.arange(B, dtype=jnp.int32) % 10
+
+    def step(noisy):
+        _, grads = eng.attribute(noisy, y)
+        return mosaic2d(grads, True, -1)
+
+    mesh = make_mesh({"sample": 4, "data": 2})
+    runner = sharded_smoothgrad(step, mesh, n_samples=N, stdev_spread=0.25)
+    x = jnp.zeros((B, IM, IM, 3))
+    compiled = runner.lower(x, jax.random.PRNGKey(0)).compile()
+    txt = compiled.as_text()
+
+    # 1. the full noise/noisy buffer never materializes on one device
+    for tok in (f"[{N},{B},{IM},{IM},3]", f"[{N},{B},3,{IM},{IM}]"):
+        assert tok not in txt, f"unsharded noise-sized buffer {tok} in HLO"
+
+    # 2. cross-device reductions exist (the sample-mean psum and the
+    # batch-global normalization maxes)
+    assert "all-reduce" in txt, "no cross-device reduction — mean not sharded?"
+
+    # 3. per-device temp memory within budget (v5e HBM is 16 GB; this tiny
+    # config must be far under it — catches accidental whole-fan buffers)
+    ma = compiled.memory_analysis()
+    if ma is not None and getattr(ma, "temp_size_in_bytes", 0):
+        assert ma.temp_size_in_bytes < 4 * 1024**3, (
+            f"per-device temp {ma.temp_size_in_bytes/2**30:.2f} GiB "
+            "exceeds budget"
+        )
+
+    # 4. pin the known data-axis gather (sample-local shape [N/4, B, ...])
+    has_gather = "all-gather" in txt
+    assert has_gather, (
+        "model-input data-axis all-gather gone — propagation limit fixed? "
+        "Update parallel/sharded.py docs and remove this pin."
+    )

@@ -54,10 +54,16 @@ def get_diagonal(grad_wam: np.ndarray, J: int) -> dict:
     return out
 
 
-def _resize_bilinear_np(a: np.ndarray, size: int) -> np.ndarray:
-    import jax
+def _zoom_linear_np(a: np.ndarray, target: int) -> np.ndarray:
+    """The reference's exact resize primitive for the variance experiment:
+    `scipy.ndimage.zoom(lvl, target/n, order=1)` then crop (`utils.py:74-78`).
+    zoom's origin-aligned sampling differs from half-pixel bilinear
+    (cv2/jax.image) at the edges, so matching the published
+    `results_variance.csv` numbers requires zoom itself."""
+    from scipy.ndimage import zoom
 
-    return np.asarray(jax.image.resize(jnp.asarray(a), (size, size), method="bilinear"))
+    scale = target / a.shape[0]
+    return zoom(np.asarray(a, dtype=np.float64), scale, order=1)[:target, :target]
 
 
 def get_mean_pixelwise_variance(grad_wam: np.ndarray, J: int, size: str = "maximal"):
@@ -72,7 +78,7 @@ def get_mean_pixelwise_variance(grad_wam: np.ndarray, J: int, size: str = "maxim
         target = min(sizes)
     else:
         raise ValueError("size must be 'maximal' or 'minimal'")
-    stack = np.stack([_resize_bilinear_np(d, target) for d in details])
+    stack = np.stack([_zoom_linear_np(d, target) for d in details])
     var_map = stack.var(axis=0)
     return float(var_map.mean()), var_map
 
@@ -149,17 +155,21 @@ def cross_wavelet_reprojection_maps(
     J: int,
 ) -> list[np.ndarray]:
     """One reprojection pixel map per wavelet for `image` — the expensive,
-    p-independent half of the cross-wavelet IoU experiment. Maps are cropped
-    to the input resolution — longer filters grow the mosaic past the image
-    size by boundary extension (the reference instead hard-crops to 224,
-    `lib/wam_2D.py:448`)."""
+    p-independent half of the cross-wavelet IoU experiment. Following the
+    reference exactly, the mosaic is HARD-CROPPED to the input resolution
+    BEFORE reprojection (`lib/wam_2D.py:448` crops the gradient path to 224
+    and reprojects at 224) — longer filters grow the mosaic past the image
+    size by boundary extension, and crop-first vs crop-last changes every
+    block boundary, so matching `results/iou.csv` requires this order
+    (pinned cross-framework by
+    `tests/test_oracle_torch.py::test_iou_experiment_pipeline_matches_torch`)."""
     x = preprocess(image)  # (1, C, H, W) contract
     hw = np.asarray(x).shape[-2:]
     y = int(np.asarray(model_fn(x)).argmax())  # class is wavelet-independent
     maps = []
     for wave in wavelets:
         expl = np.asarray(make_explainer(wave)(x, [y])).squeeze()
-        maps.append(reprojection_map(expl, J)[: hw[0], : hw[1]])
+        maps.append(reprojection_map(expl[: hw[0], : hw[1]], J))
     return maps
 
 

@@ -16,13 +16,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from wam_tpu.evalsuite.metrics import (
-    compute_auc,
-    generate_masks,
-    make_probs_fn,
-    run_cached_auc,
-    softmax_probs,
-)
+from wam_tpu.evalsuite.metrics import generate_masks, run_cached_auc
 from wam_tpu.evalsuite.packing import array_to_coeffs1d, coeffs_to_array1d
 from wam_tpu.ops.melspec import melspectrogram
 from wam_tpu.wam1d import normalize_waveforms
@@ -63,7 +57,7 @@ class Eval1DWAM:
         self.sample_rate = sample_rate
         self.batch_size = batch_size
         self.mesh = mesh
-        self._probs_fn = make_probs_fn(model_fn, batch_size, mesh, data_axis)
+        self.data_axis = data_axis
         self._auc_runners: dict = {}
         self.grad_wams = None
         self.insertion_curves = []
@@ -82,9 +76,6 @@ class Eval1DWAM:
             wave, sample_rate=self.sample_rate, n_fft=self.n_fft, n_mels=self.n_mels
         )
         return mel[:, None, :, :]  # (B, 1, T, M)
-
-    def _probs_for(self, inputs: jax.Array, label: int) -> jax.Array:
-        return self._probs_fn(inputs, label)
 
     # -- perturbation families --------------------------------------------
 
@@ -138,30 +129,24 @@ class Eval1DWAM:
         else:
             raise ValueError(f"Unknown target {target!r}")
 
-        if self.mesh is None or argmax:
-            # one jit dispatch for the whole batch (VERDICT.md round-1 #6);
-            # the argmax (input-fidelity) variant returns raw logit rows
-            return run_cached_auc(
-                self._auc_runners,
-                (mode, target),
-                inputs_fn,
-                self.model_fn,
-                self.batch_size,
-                n_iter,
-                x,
-                expl,
-                y,
-                return_logits=argmax,
-            )
-
-        scores, curves = [], []
-        for s in range(x.shape[0]):
-            expl_s = jax.tree_util.tree_map(lambda a: a[s], expl)
-            inputs = inputs_fn(x[s], expl_s)
-            probs = self._probs_for(inputs, int(y[s]))
-            scores.append(float(compute_auc(probs)))
-            curves.append(np.asarray(probs))
-        return scores, curves
+        # one jit dispatch for the whole batch (VERDICT.md round-1 #6);
+        # the argmax (input-fidelity) variant returns raw logit rows. With a
+        # mesh, the sample axis is sharded inside the same runner — no
+        # per-sample host loop in any configuration (r4 verdict #4).
+        return run_cached_auc(
+            self._auc_runners,
+            (mode, target),
+            inputs_fn,
+            self.model_fn,
+            self.batch_size,
+            n_iter,
+            x,
+            expl,
+            y,
+            return_logits=argmax,
+            mesh=self.mesh,
+            data_axis=self.data_axis,
+        )
 
     def insertion(self, x, y, target: str = "wavelet", n_iter: int = 64):
         scores, curves = self.evaluate_auc(x, y, "insertion", target, n_iter)

@@ -18,8 +18,8 @@ __all__ = [
     "generate_masks",
     "minmax_normalize",
     "spearman",
-    "make_probs_fn",
     "batched_auc_runner",
+    "make_sharded_runner",
     "run_cached_auc",
     "fan_chunk_geometry",
     "make_chunked_forward",
@@ -119,12 +119,52 @@ def make_chunked_forward(model_fn, fan_chunk: int | None):
     return forward
 
 
+def _pad_to_multiple(tree, n: int):
+    """Cyclically pad every leaf's axis 0 to a multiple of ``n``; returns
+    (padded_tree, original_len). Per-image metrics ignore the pad rows."""
+    lead = jax.tree_util.tree_leaves(tree)[0].shape[0]
+    pad = (-lead) % n
+    if pad == 0:
+        return tree, lead
+    return (
+        jax.tree_util.tree_map(
+            lambda a: jnp.resize(a, (lead + pad,) + a.shape[1:]), tree
+        ),
+        lead,
+    )
+
+
+def make_sharded_runner(body, mesh, data_axis: str = "data"):
+    """jit(shard_map(body)) sharding axis 0 of every positional arg over
+    ``data_axis``, with cyclic padding to the axis size and slice-back of
+    every output leaf — the one-dispatch on-mesh evaluation shape shared by
+    the AUC and μ-fidelity runners (round-4 verdict #4)."""
+    from functools import partial
+
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    sharded = jax.jit(
+        partial(shard_map, mesh=mesh, in_specs=P(data_axis),
+                out_specs=P(data_axis))(body)
+    )
+
+    def run(*args):
+        args, lead = _pad_to_multiple(args, mesh.shape[data_axis])
+        out = sharded(*args)
+        return jax.tree_util.tree_map(lambda a: a[:lead], out)
+
+    return run
+
+
 def batched_auc_runner(
     inputs_fn,
     model_fn,
     images_per_chunk: int,
     return_logits: bool = False,
     fan_chunk: int | None = None,
+    mesh=None,
+    data_axis: str = "data",
 ):
     """One-jit-dispatch insertion/deletion evaluation across an image batch.
 
@@ -143,12 +183,18 @@ def batched_auc_runner(
     lax.map) for when the fan alone exceeds the caller's batch-size memory
     cap. ``return_logits=True`` returns raw logits rows (the 1D
     input-fidelity argmax path) instead of (scores, prob_curves).
+
+    With ``mesh``, the image batch is sharded over ``data_axis`` via
+    `shard_map` — each device runs the identical per-image body on its
+    shard (params replicated, no cross-device traffic inside a fan), so the
+    on-mesh evaluation is STILL one dispatch (round-4 verdict #4; replaces
+    the reference's per-image fan loop, `src/evaluators.py:605-647`). The
+    batch is cyclically padded to the axis size and sliced back.
     """
 
     forward = make_chunked_forward(model_fn, fan_chunk)
 
-    @jax.jit
-    def run(xb, explb, yb):
+    def body(xb, explb, yb):
         def one(args):
             xs, es, lab = args
             logits = forward(inputs_fn(xs, es))
@@ -161,7 +207,9 @@ def batched_auc_runner(
             return out
         return compute_auc(out), out
 
-    return run
+    if mesh is None:
+        return jax.jit(body)
+    return make_sharded_runner(body, mesh, data_axis)
 
 
 def run_cached_auc(
@@ -175,12 +223,15 @@ def run_cached_auc(
     expl,
     y,
     return_logits: bool = False,
+    mesh=None,
+    data_axis: str = "data",
 ):
     """Memoized `batched_auc_runner` invocation shared by the evaluators.
 
     Chunk geometry honors the caller's ``batch_size`` memory cap in both
     regimes: several images per chunk when the fan is small, an inner
-    fan-chunked forward when one sample's fan alone exceeds it."""
+    fan-chunked forward when one sample's fan alone exceeds it. ``mesh``
+    shards the image batch (see `batched_auc_runner`)."""
     import numpy as np
 
     images_per_chunk, fan_chunk = fan_chunk_geometry(batch_size, n_iter + 1)
@@ -188,7 +239,8 @@ def run_cached_auc(
     runner = cache.get(key)
     if runner is None:
         runner = batched_auc_runner(
-            inputs_fn, model_fn, images_per_chunk, return_logits, fan_chunk
+            inputs_fn, model_fn, images_per_chunk, return_logits, fan_chunk,
+            mesh, data_axis,
         )
         cache[key] = runner
     out = runner(x, expl, jnp.asarray(y))
@@ -196,52 +248,3 @@ def run_cached_auc(
         return list(np.asarray(out))
     scores, ps = out
     return [float(v) for v in scores], [np.asarray(p) for p in ps]
-
-
-def make_probs_fn(model_fn, batch_size: int = 128, mesh=None, data_axis: str = "data"):
-    """Build a `probs(inputs, label) -> (M,)` class-probability extractor.
-
-    Without a mesh: single-device, chunked by ``batch_size``. With a mesh:
-    the whole perturbation batch runs as ONE forward sharded over
-    ``data_axis`` (the SURVEY.md §2.10 evaluation fan-out), cyclically
-    padded to the axis multiple and sliced back.
-    """
-    if mesh is None:
-
-        def probs_fn(inputs, label):
-            chunks = []
-            for i in range(0, inputs.shape[0], batch_size):
-                logits = model_fn(inputs[i : i + batch_size])
-                chunks.append(softmax_probs(logits)[:, label])
-            return jnp.concatenate(chunks)
-
-        return probs_fn
-
-    from jax.sharding import NamedSharding, PartitionSpec
-
-    @jax.jit
-    def run(padded, lab):
-        return jnp.take(softmax_probs(model_fn(padded)), lab, axis=1)
-
-    n = mesh.shape[data_axis]
-    # Per-dispatch cap: batch_size per shard (a huge fan — e.g. μ-fidelity
-    # with a large sample_size — must not exceed per-device memory just
-    # because a mesh is attached; round-1 ADVICE.md item 1).
-    chunk = max(batch_size, 1) * n
-
-    def probs_fn(inputs, label):
-        lab = jnp.asarray(label)
-        sharding = NamedSharding(mesh, PartitionSpec(data_axis))
-        outs = []
-        for i in range(0, inputs.shape[0], chunk):
-            part = inputs[i : i + chunk]
-            m = part.shape[0]
-            pad = (-m) % n
-            if pad:
-                # cyclic tiling handles pad > m (mesh wider than the batch)
-                part = jnp.resize(part, (m + pad,) + part.shape[1:])
-            part = jax.device_put(part, sharding)
-            outs.append(run(part, lab)[:m])
-        return outs[0] if len(outs) == 1 else jnp.concatenate(outs)
-
-    return probs_fn

@@ -57,6 +57,23 @@ def sharded_smoothgrad(
             noisy, _constraint(mesh, sample_axis, data_axis)
         )
         outs = jax.vmap(step_fn)(noisy)
+        # anchor the per-sample outputs too (input + output + post-mean all
+        # constrained). KNOWN LIMIT (round-4 HLO audit,
+        # tests/test_parallel.py::test_sharded_smoothgrad_hlo_audit): the
+        # noise buffer and outputs stay fully sharded and the sample mean is
+        # a psum, but vmap's conv batching rule merges the (sample, data)
+        # axes into one model-batch dim, whose product sharding XLA cannot
+        # represent — it all-gathers the DATA axis at the model input, so
+        # model compute is replicated across data shards. Exact
+        # reference semantics (batch-global mosaic normalization) are
+        # preserved; a shard_map redesign with an explicit-label step
+        # contract is the planned fix.
+        outs = jax.tree_util.tree_map(
+            lambda a: jax.lax.with_sharding_constraint(
+                a, _constraint(mesh, sample_axis, data_axis)
+            ),
+            outs,
+        )
         mean = jax.tree_util.tree_map(lambda a: a.mean(axis=0), outs)
         return jax.tree_util.tree_map(
             lambda a: jax.lax.with_sharding_constraint(a, _constraint(mesh, data_axis)), mean
