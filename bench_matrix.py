@@ -231,8 +231,13 @@ def main():
     # (ESC-50). Full sample vmap measured fastest (round-3 chunk sweep).
     wave_len = 65536 if q else 220500
     ab, an = (2, 4) if q else (8, 50)
+    # compute_dtype matches the row's recorded dtype label: the pre-round-4
+    # audio rows were labeled bfloat16 but ran the CNN in f32 — the trace
+    # breakdown caught it; bf16 measures +20% (43.7 vs 36.4 wf/s) at
+    # melspec-attribution cosine 0.979 vs f32 (tiny σ=0.001 noise doesn't
+    # mask bf16 rounding the way the vision σ=0.25 does, BASELINE.md r4)
     ex3, x3, y3 = audio_workload(an if on_accel else 1, b=ab, n=an,
-                                 wave_len=wave_len)
+                                 wave_len=wave_len, compute_dtype=dtype)
     record(f"wam1d_smoothgrad_audiocnn_b{ab}_db6_J5_n{an}", ab,
            _sampled(lambda: ex3(x3, y3), k=k, laps=laps), "waveforms/s")
 

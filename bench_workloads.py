@@ -10,7 +10,8 @@ import jax
 import jax.numpy as jnp
 
 
-def audio_workload(chunk, *, b: int = 8, n: int = 50, wave_len: int = 220500):
+def audio_workload(chunk, *, b: int = 8, n: int = 50, wave_len: int = 220500,
+                   compute_dtype=None):
     """WAM-1D SmoothGrad on the ESC-50-shaped AudioCNN (BASELINE.json #3).
     Returns (explainer, x, y)."""
     from wam_tpu.models.audio import AudioCNN, bind_audio_inference
@@ -20,7 +21,8 @@ def audio_workload(chunk, *, b: int = 8, n: int = 50, wave_len: int = 220500):
     mel_t = wave_len // 512 + 1
     avars = amodel.init(jax.random.PRNGKey(0), jnp.zeros((1, 1, mel_t, 128)))
     ex = WaveletAttribution1D(
-        bind_audio_inference(amodel, avars), wavelet="db6", J=5,
+        bind_audio_inference(amodel, avars, compute_dtype=compute_dtype),
+        wavelet="db6", J=5,
         method="smooth", n_samples=n, stdev_spread=0.001,
         sample_batch_size=chunk,
     )

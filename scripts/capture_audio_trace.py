@@ -1,0 +1,39 @@
+"""Capture a profiler trace of the WAM-1D audio step (round-4 verdict #8:
+what share of the post-fold 36 wf/s step is CNN vs melspec vs DWT?). Run:
+    python scripts/capture_audio_trace.py /tmp/trace_audio
+then aggregate per-op device time with
+    python scripts/xplane_ops.py /tmp/trace_audio 40
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    logdir = sys.argv[1] if len(sys.argv) > 1 else "/tmp/trace_audio"
+
+    from wam_tpu.config import enable_compilation_cache, ensure_usable_backend
+
+    ensure_usable_backend(timeout_s=180.0)
+    enable_compilation_cache()
+
+    import jax
+
+    from bench_workloads import audio_workload
+
+    # the exact benched config: b8, n=50, 220500 samples, db6 J=5, full vmap
+    ex, x, y = audio_workload(50)
+    out = ex(x, y)
+    jax.block_until_ready(out)  # compile outside the trace
+
+    with jax.profiler.trace(logdir):
+        for _ in range(3):
+            out = ex(x, y)
+        jax.block_until_ready(out)
+    print(f"trace written to {logdir}")
+
+
+if __name__ == "__main__":
+    main()

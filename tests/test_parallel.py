@@ -307,3 +307,70 @@ def test_sharded_smoothgrad_hlo_audit():
         "model-input data-axis all-gather gone — propagation limit fixed? "
         "Update parallel/sharded.py docs and remove this pin."
     )
+
+
+def test_sharded_smoothgrad_spmd_exact_parity_unnormalized():
+    """The shard_map variant must reproduce the single-device materialized
+    smoothgrad BIT-for-draw (same key, same noise tensor, shard-local step):
+    with normalize=False there is no cross-batch coupling, so the sharded
+    mean equals the full mean exactly (round-4: the guaranteed
+    data-parallel estimator — no model-input all-gather)."""
+    _need_devices(8)
+    from wam_tpu.parallel import sharded_smoothgrad_spmd
+
+    rng = np.random.default_rng(3)
+    W = jnp.asarray(rng.standard_normal((16 * 16, 5)), dtype=jnp.float32)
+    eng = WamEngine(_linear_model(W), ndim=2, wavelet="haar", level=2, mode="reflect")
+    x = jnp.asarray(rng.standard_normal((4, 1, 16, 16)), dtype=jnp.float32)
+    y = jnp.array([0, 1, 2, 3])
+    key = jax.random.PRNGKey(11)
+
+    def step_local(noisy, y_l, grad_scale):
+        _, grads = eng.attribute(noisy, y_l)
+        grads = jax.tree_util.tree_map(lambda g: g * grad_scale, grads)
+        return mosaic2d(grads, normalize=False)
+
+    mesh = make_mesh({"sample": 2, "data": 4})
+    runner = sharded_smoothgrad_spmd(step_local, mesh, n_samples=4, stdev_spread=0.15)
+    out_sharded = runner(x, y, key)
+
+    def step_full(noisy):
+        _, grads = eng.attribute(noisy, y)
+        return mosaic2d(grads, normalize=False)
+
+    out_single = smoothgrad(step_full, x, key, n_samples=4, stdev_spread=0.15)
+    np.testing.assert_allclose(np.asarray(out_sharded), np.asarray(out_single),
+                               atol=1e-5)
+
+
+@pytest.mark.slow
+def test_sharded_smoothgrad_spmd_hlo_has_no_model_gather():
+    """The spmd variant's compiled HLO must contain NO all-gather at all:
+    model compute stays local to each (sample, data) shard and the only
+    collective is the sample-mean psum (contrast with
+    test_sharded_smoothgrad_hlo_audit, which pins the propagation
+    variant's known gather)."""
+    _need_devices(8)
+    from wam_tpu.models import bind_inference, resnet18
+    from wam_tpu.parallel import sharded_smoothgrad_spmd
+
+    N, B, IM = 8, 8, 64
+    model = resnet18(num_classes=10)
+    variables = model.init(jax.random.PRNGKey(0), jnp.zeros((1, IM, IM, 3)))
+    fn = bind_inference(model, variables, nchw=False)
+    eng = WamEngine(fn, ndim=2, wavelet="db4", level=3, mode="reflect",
+                    channel_last=True)
+
+    def step(noisy, y_l, grad_scale):
+        _, grads = eng.attribute(noisy, y_l)
+        grads = jax.tree_util.tree_map(lambda g: g * grad_scale, grads)
+        return mosaic2d(grads, normalize=False, channel_axis=-1)
+
+    mesh = make_mesh({"sample": 4, "data": 2})
+    runner = sharded_smoothgrad_spmd(step, mesh, n_samples=N, stdev_spread=0.25)
+    x = jnp.zeros((B, IM, IM, 3))
+    y = jnp.arange(B, dtype=jnp.int32) % 10
+    compiled = runner.lower(x, y, jax.random.PRNGKey(0)).compile()
+    txt = compiled.as_text()
+    assert "all-gather" not in txt, "spmd variant must not gather the model input"
+    assert "all-reduce" in txt, "sample-mean psum missing"

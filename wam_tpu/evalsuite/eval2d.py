@@ -299,4 +299,4 @@ class Eval2DWAM:
             runner = self._make_mu_runner(grid_size, sample_size)
             self._mu_runners[key] = runner
         out = runner(x, wams, jnp.asarray(y), rand_all, onehot_all)
-        return [float(v) for v in out]
+        return [float(v) for v in np.asarray(out)]  # one device fetch

@@ -104,12 +104,20 @@ class _BaseEvalBaselines:
         self.model_fn = model_fn
         self._auc_runners: dict = {}
         self._mu_runners: dict = {}
+        # one jit around the whole explanation: the method bodies
+        # (baselines.py) are plain traced JAX, and dispatching them eagerly
+        # costs the tunneled TPU's ~100 ms host RTT PER OP — the round-3
+        # methods_tpu.jsonl rows measured 6-23 s "explain" times that were
+        # almost entirely dispatch (see the LRP 216 s → 0.1 s diagnosis,
+        # BASELINE.md round-4)
+        self._explain_jit = jax.jit(self._explain_impl)
 
     def compute_explanations(self, x, y) -> jax.Array:
         """(B, H, W) maps in the perturbation domain
         (`src/evaluators.py:904-959`)."""
-        x = jnp.asarray(x)
-        y = jnp.asarray(y)
+        return self._explain_jit(jnp.asarray(x), jnp.asarray(y))
+
+    def _explain_impl(self, x, y) -> jax.Array:
         m = self.method
         if m == "saliency":
             return B.saliency(self.model_fn, x, y)
@@ -283,7 +291,7 @@ class EvalImageBaselines(_BaseEvalBaselines):
             runner = self._make_mu_runner(grid_size, sample_size, tuple(x.shape[-2:]))
             self._mu_runners[key] = runner
         out = runner(x, expl, jnp.asarray(y), onehot_all)
-        return [float(v) for v in out]
+        return [float(v) for v in np.asarray(out)]  # one device fetch
 
 
 class EvalAudioBaselines(_BaseEvalBaselines):

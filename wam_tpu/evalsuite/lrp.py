@@ -110,6 +110,15 @@ def _bn_bias(params, name):
     return params[name]["bias"]
 
 
+# jitted-walker cache: the walker body is ~260 conv/VJP ops; dispatched
+# eagerly over the tunneled TPU each op pays the ~100 ms host RTT, which is
+# where the round-3 "216 s per LRP explain" went (compile-inclusive row in
+# methods_tpu.jsonl). One jit turns that into a single dispatch; keyed per
+# (model-config, composite, eps, nchw) with jax.jit's own shape cache
+# underneath.
+_JIT_CACHE: dict = {}
+
+
 def lrp_resnet(
     model,
     variables,
@@ -128,7 +137,7 @@ def lrp_resnet(
     Gradient attributor seeded with a one-hot at `:950-952`).
     composite="epsilon" applies the ε-rule everywhere instead (no ZPlus/Flat).
     """
-    from wam_tpu.models.resnet import BasicBlock, Bottleneck, ResNet, _fold_bn_variables
+    from wam_tpu.models.resnet import ResNet
 
     if not isinstance(model, ResNet):
         raise ValueError(
@@ -136,6 +145,20 @@ def lrp_resnet(
         )
     if model.stem_s2d:
         model = model.clone(stem_s2d=False)  # walker assumes the 7x7 stem form
+    key = (model, composite, float(eps), bool(nchw))
+    fn = _JIT_CACHE.get(key)
+    if fn is None:
+        fn = jax.jit(
+            lambda v, xx, yy: _lrp_resnet_body(
+                model, v, xx, yy, eps=eps, composite=composite, nchw=nchw
+            )
+        )
+        _JIT_CACHE[key] = fn
+    return fn(variables, x, jnp.asarray(y))
+
+
+def _lrp_resnet_body(model, variables, x, y, *, eps, composite, nchw):
+    from wam_tpu.models.resnet import Bottleneck, _fold_bn_variables
     # LRP is an f32-only computation: the ε-stabilizer (1e-6 relative to
     # O(1) activations) vanishes in bf16's 8-bit mantissa, and the walker
     # drives lax.conv directly with raw kernels (no flax promotion). If the

@@ -247,4 +247,10 @@ def run_cached_auc(
     if return_logits:
         return list(np.asarray(out))
     scores, ps = out
-    return [float(v) for v in scores], [np.asarray(p) for p in ps]
+    # ONE device fetch per result tensor: per-element float(v)/np.asarray(p)
+    # cost a ~100 ms tunnel round trip EACH — 16 sequential RTTs made a
+    # 108 ms-device insertion call take 1.6 s wall (round-4 eval ceiling
+    # trace, BASELINE.md)
+    scores = np.asarray(scores)
+    ps = np.asarray(ps)
+    return [float(v) for v in scores], list(ps)

@@ -69,7 +69,24 @@ class AudioCNN(nn.Module):
         return x
 
 
-def bind_audio_inference(model: nn.Module, variables) -> Callable[[jax.Array], jax.Array]:
+def bind_audio_inference(model: nn.Module, variables,
+                         compute_dtype=None) -> Callable[[jax.Array], jax.Array]:
     """Pure `(B, 1, T, M) -> (B, K)` function (the FtEx-wrapper role,
-    `src/helpers.py:289-325`)."""
+    `src/helpers.py:289-325`).
+
+    compute_dtype=jnp.bfloat16 runs the CNN fwd/VJP at the MXU's native
+    precision (params cast once, melspec input cast at the boundary,
+    logits back in f32) — the round-4 audio trace showed the conv stack
+    running f32 activations at ~45% of the attribution step
+    (BASELINE.md round-4 audio breakdown)."""
+    if compute_dtype is not None:
+        variables = jax.tree_util.tree_map(
+            lambda a: a.astype(compute_dtype)
+            if hasattr(a, "dtype") and jnp.issubdtype(a.dtype, jnp.floating)
+            else a,
+            variables,
+        )
+        return lambda x: model.apply(
+            variables, x.astype(compute_dtype)
+        ).astype(jnp.float32)
     return lambda x: model.apply(variables, x)

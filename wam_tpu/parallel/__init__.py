@@ -6,13 +6,14 @@ from wam_tpu.parallel.halo import (
 )
 from wam_tpu.parallel.mesh import P, data_sample_mesh, make_mesh
 from wam_tpu.parallel.multihost import hybrid_mesh, init_distributed, process_local_batch
-from wam_tpu.parallel.sharded import sharded_integrated_path, sharded_smoothgrad
+from wam_tpu.parallel.sharded import sharded_integrated_path, sharded_smoothgrad, sharded_smoothgrad_spmd
 
 __all__ = [
     "make_mesh",
     "data_sample_mesh",
     "P",
     "sharded_smoothgrad",
+    "sharded_smoothgrad_spmd",
     "sharded_integrated_path",
     "init_distributed",
     "hybrid_mesh",

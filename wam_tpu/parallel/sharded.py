@@ -13,15 +13,17 @@ only cross-device reduction — it rides ICI, never the host.
 
 from __future__ import annotations
 
+from functools import partial
 from typing import Any, Callable
 
 import jax
 import jax.numpy as jnp
+from jax import lax, shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from wam_tpu.core.estimators import noise_sigma, trapezoid
 
-__all__ = ["sharded_smoothgrad", "sharded_integrated_path"]
+__all__ = ["sharded_smoothgrad", "sharded_smoothgrad_spmd", "sharded_integrated_path"]
 
 
 def _constraint(mesh: Mesh, *axes):
@@ -78,6 +80,96 @@ def sharded_smoothgrad(
         return jax.tree_util.tree_map(
             lambda a: jax.lax.with_sharding_constraint(a, _constraint(mesh, data_axis)), mean
         )
+
+    return jax.jit(run)
+
+
+def sharded_smoothgrad_spmd(
+    step_fn: Callable[[jax.Array, jax.Array, float], Any],
+    mesh: Mesh,
+    *,
+    n_samples: int,
+    stdev_spread: float,
+    data_axis: str = "data",
+    sample_axis: str = "sample",
+) -> Callable[[jax.Array, jax.Array, jax.Array], Any]:
+    """`sharded_smoothgrad` with a GUARANTEED data-parallel graph.
+
+    The propagation-based `sharded_smoothgrad` preserves exact
+    single-device semantics but lets vmap's conv batching rule merge the
+    (sample, data) axes, which XLA resolves by ALL-GATHERING the data axis
+    at the model input — model compute replicated across data shards
+    (round-4 HLO audit). This variant runs the step under `shard_map`, so
+    each device computes ONLY its (n_samples/sample_shards, B/data_shards)
+    block and the sole collective is the sample-mean `psum` over ICI — the
+    scaling-correct multi-chip estimator (SURVEY.md §2.10 / scaling-book
+    recipe: pick the mesh, keep compute local, reduce once).
+
+    Contract changes vs `sharded_smoothgrad`:
+    - ``step_fn(noisy_local, y_local, grad_scale)`` receives the LOCAL
+      batch rows, their labels (passed to the runner, not closed over),
+      and the loss-mean rescale factor described below;
+    - the runner signature is ``run(x, y, key)``;
+    - any batch-global reduction inside ``step_fn`` (e.g. the mosaic's
+      normalize-by-max) is computed PER DATA SHARD. With
+      ``mosaic2d(..., normalize=False)`` (or any shard-local step) results
+      are bit-identical to the single-device materialized `smoothgrad` —
+      asserted by tests/test_parallel.py; with normalization the maps
+      differ by the per-shard normalizer exactly as documented.
+
+    Loss-mean rescale: the engine's diag-logit loss takes the MEAN over the
+    batch it sees, so a shard computing B/data_shards rows produces
+    gradients data_shards× larger than the full-batch run. The runner
+    passes ``grad_scale = 1/data_shards`` as the step's third argument; the
+    step must multiply its COEFFICIENT GRADIENTS by it before any
+    (scale-invariant) normalization:
+
+        def step(noisy_local, y_local, grad_scale):
+            _, grads = engine.attribute(noisy_local, y_local)
+            grads = jax.tree_util.tree_map(lambda g: g * grad_scale, grads)
+            return mosaic2d(grads, normalize, channel_axis)
+
+    With that, normalize=False is bit-identical to the single-device
+    materialized `smoothgrad` (asserted in tests/test_parallel.py) and
+    normalize=True differs only by the documented per-shard normalizer.
+
+    Requires n_samples % sample_shards == 0 and B % data_shards == 0.
+    """
+    n_sample_shards = mesh.shape[sample_axis]
+    if n_samples % n_sample_shards:
+        raise ValueError(
+            f"n_samples={n_samples} not divisible by {sample_axis}={n_sample_shards}"
+        )
+
+    def run(x, y, key):
+        if x.shape[0] % mesh.shape[data_axis]:
+            raise ValueError(
+                f"batch {x.shape[0]} not divisible by "
+                f"{data_axis}={mesh.shape[data_axis]}"
+            )
+        sigma = noise_sigma(x, stdev_spread)
+        sigma = sigma.reshape(sigma.shape + (1,) * (x.ndim - 1))
+        # same draws as the materialized single-device path (same key →
+        # same (n_samples, B, ...) normal tensor), then sharded as input
+        noise = jax.random.normal(key, (n_samples,) + x.shape, dtype=x.dtype) * sigma
+        noisy = x[None] + noise
+
+        grad_scale = 1.0 / mesh.shape[data_axis]
+
+        @partial(
+            shard_map,
+            mesh=mesh,
+            in_specs=(P(sample_axis, data_axis), P(data_axis)),
+            out_specs=P(data_axis),
+        )
+        def local(noisy_l, y_l):
+            outs = jax.vmap(lambda nb: step_fn(nb, y_l, grad_scale))(noisy_l)
+            sums = jax.tree_util.tree_map(lambda a: a.sum(axis=0), outs)
+            return jax.tree_util.tree_map(
+                lambda a: lax.psum(a, sample_axis) / n_samples, sums
+            )
+
+        return local(noisy, jnp.asarray(y))
 
     return jax.jit(run)
 
