@@ -1,5 +1,6 @@
-"""Capture a profiler trace of the flagship step (new round-3 schedule) for
-the layout-copy audit (VERDICT r2 #5): run with
+"""Capture a profiler trace of the flagship step for the layout-copy
+audit (round-3 schedule originally, VERDICT r2 #5; since round 4 this
+captures the SHIPPED channel-last config). Run with
     python scripts/capture_flagship_trace.py /tmp/trace_flagship
 then aggregate per-op device time with
     python scripts/xplane_ops.py /tmp/trace_flagship 40
@@ -28,12 +29,18 @@ def main():
     from wam_tpu.ops.packing2d import mosaic2d
 
     batch, n_samples, image = 32, 25, 224
-    model = resnet50(num_classes=1000, stem_s2d=True)
+    # the SHIPPED round-4 flagship config: channel-last engine, no s2d stem
+    # (retired round 3), fold_bn on — bench.py's graph except the input is
+    # fed NHWC directly (bench.py accepts NCHW and transposes ONCE per run
+    # call, outside the sample map; that single per-call transpose is
+    # intentionally outside this capture's scope)
+    model = resnet50(num_classes=1000)
     variables = model.init(jax.random.PRNGKey(0), jnp.zeros((1, image, image, 3)))
-    model_fn = bind_inference(model, variables, nchw=True,
+    model_fn = bind_inference(model, variables, nchw=False,
                               compute_dtype=jnp.bfloat16, fold_bn=True)
-    engine = WamEngine(model_fn, ndim=2, wavelet="db4", level=3, mode="reflect")
-    x = jax.random.normal(jax.random.PRNGKey(1), (batch, 3, image, image), jnp.float32)
+    engine = WamEngine(model_fn, ndim=2, wavelet="db4", level=3,
+                       mode="reflect", channel_last=True)
+    x = jax.random.normal(jax.random.PRNGKey(1), (batch, image, image, 3), jnp.float32)
     y = jnp.arange(batch, dtype=jnp.int32) % 1000
 
     @jax.jit
@@ -41,7 +48,7 @@ def main():
         def step(noisy):
             noisy = noisy.astype(jnp.bfloat16)
             _, grads = engine.attribute(noisy, y)
-            return mosaic2d(grads, True)
+            return mosaic2d(grads, True, -1)
 
         return smoothgrad(step, x, key, n_samples=n_samples, stdev_spread=0.25,
                           batch_size=4, materialize_noise=False)
