@@ -8,6 +8,14 @@ the driver's multi-chip dry-run use), so the sharding can be exercised on a
 laptop.
 
     python examples/sharded_attribution.py --virtual 8
+    python examples/sharded_attribution.py --virtual 8 --spmd
+
+--spmd uses `sharded_smoothgrad_spmd` — the shard_map form whose compiled
+graph is guaranteed gather-free (each device computes only its
+(sample, data) block; the one collective is the sample-mean psum). Prefer
+it for real multi-chip runs; the default propagation form preserves exact
+single-device semantics but replicates model compute across the data axis
+(see wam_tpu/parallel/sharded.py and BASELINE.md round-4).
 """
 
 import argparse
@@ -26,6 +34,8 @@ def main():
     parser.add_argument("--size", type=int, default=64)
     parser.add_argument("--wavelet", default="db4")
     parser.add_argument("--levels", type=int, default=3)
+    parser.add_argument("--spmd", action="store_true",
+                        help="use the gather-free shard_map estimator")
     args = parser.parse_args()
 
     if args.virtual:
@@ -44,7 +54,12 @@ def main():
     from wam_tpu.core.engine import WamEngine
     from wam_tpu.models import bind_inference, resnet18
     from wam_tpu.ops.packing2d import mosaic2d
-    from wam_tpu.parallel import data_sample_mesh, init_distributed, sharded_smoothgrad
+    from wam_tpu.parallel import (
+        data_sample_mesh,
+        init_distributed,
+        sharded_smoothgrad,
+        sharded_smoothgrad_spmd,
+    )
 
     info = init_distributed()
     mesh = data_sample_mesh()
@@ -58,13 +73,25 @@ def main():
                        mode="reflect")
     y = jnp.arange(args.batch, dtype=jnp.int32) % 10
 
-    def step(noisy):
-        _, grads = engine.attribute(noisy, y)
-        return mosaic2d(grads, True)
-
-    runner = sharded_smoothgrad(step, mesh, n_samples=args.samples, stdev_spread=0.25)
     x = jax.random.normal(jax.random.PRNGKey(1), (args.batch, 3, args.size, args.size))
-    mosaic = runner(x, jax.random.PRNGKey(42))
+    if args.spmd:
+        def step_local(noisy, y_l, grad_scale):
+            _, grads = engine.attribute(noisy, y_l)
+            grads = jax.tree_util.tree_map(lambda g: g * grad_scale, grads)
+            return mosaic2d(grads, True)
+
+        runner = sharded_smoothgrad_spmd(step_local, mesh,
+                                         n_samples=args.samples,
+                                         stdev_spread=0.25)
+        mosaic = runner(x, y, jax.random.PRNGKey(42))
+    else:
+        def step(noisy):
+            _, grads = engine.attribute(noisy, y)
+            return mosaic2d(grads, True)
+
+        runner = sharded_smoothgrad(step, mesh, n_samples=args.samples,
+                                    stdev_spread=0.25)
+        mosaic = runner(x, jax.random.PRNGKey(42))
     jax.block_until_ready(mosaic)
     print(f"attribution mosaics: {mosaic.shape}, sharded over "
           f"{len(mosaic.sharding.device_set)} devices")
