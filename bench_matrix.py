@@ -236,15 +236,19 @@ def main():
     # breakdown caught it; bf16 measures +20% (43.7 vs 36.4 wf/s) at
     # melspec-attribution cosine 0.979 vs f32 (tiny σ=0.001 noise doesn't
     # mask bf16 rounding the way the vision σ=0.25 does, BASELINE.md r4)
-    ex3, x3, y3 = audio_workload(an if on_accel else 1, b=ab, n=an,
+    # "auto" = the class default (~128 rows/step); round 4's median-of-k
+    # sweep overturned the round-3 "audio prefers full vmap" single-min
+    # artifact (77.2 wf/s at chunk 16 vs 62-67 full-vmap)
+    ex3, x3, y3 = audio_workload("auto" if on_accel else 1, b=ab, n=an,
                                  wave_len=wave_len, compute_dtype=dtype)
     record(f"wam1d_smoothgrad_audiocnn_b{ab}_db6_J5_n{an}", ab,
            _sampled(lambda: ex3(x3, y3), k=k, laps=laps), "waveforms/s")
 
-    # 4. 3D SmoothGrad (full sample vmap fastest, round-3 sweep) ---------------
+    # 4. 3D SmoothGrad ("auto" chunking since round 4: the 128-row law
+    # measured 109.8 vol/s at chunk 16 vs 90.3 full vmap) ----------------------
     size = 16 if q else 32
     vb, vn = (2, 3) if q else (8, 25)
-    ex4, x4, y4 = vol_workload(vn if on_accel else 1, b=vb, n=vn, size=size)
+    ex4, x4, y4 = vol_workload("auto" if on_accel else 1, b=vb, n=vn, size=size)
     record(f"wam3d_smoothgrad_resnet3d18_b{vb}_{size}cube_haar_J2_n{vn}", vb,
            _sampled(lambda: ex4(x4, y4), k=k, laps=laps), "volumes/s")
 

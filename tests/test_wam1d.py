@@ -199,3 +199,19 @@ def test_stream_noise_1d_matches_engine_composition(model_fn):
     np.testing.assert_allclose(np.asarray(g_mel), np.asarray(want[0]), atol=1e-6)
     for a, b in zip(g_coeffs, want[1]):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
+
+
+def test_auto_schedule_matches_explicit_chunk(model_fn):
+    """1D counterpart of the round-4 "auto" default: numerically identical
+    to an explicit chunk; bad strings rejected eagerly."""
+    x = jnp.asarray(np.random.default_rng(8).standard_normal((2, WLEN)),
+                    jnp.float32)
+    y = jnp.array([0, 2])
+    kw = dict(wavelet="db4", J=3, n_samples=4, stdev_spread=0.001,
+              n_mels=NMELS, n_fft=NFFT, sample_rate=SR)
+    m1, _ = WaveletAttribution1D(model_fn, **kw)(x, y)  # "auto" default
+    m2, _ = WaveletAttribution1D(model_fn, sample_batch_size=2, **kw)(x, y)
+    np.testing.assert_allclose(np.asarray(m1), np.asarray(m2), atol=1e-5)
+
+    with pytest.raises(ValueError):
+        WaveletAttribution1D(model_fn, sample_batch_size="none")

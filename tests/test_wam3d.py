@@ -133,3 +133,31 @@ def test_visualize_cube_channels():
     assert viz.shape == (1, 4, 16, 16, 16)
     # all channels max-normalized to <= 1
     assert float(jnp.nanmax(viz)) <= 1.0 + 1e-5
+
+
+def test_auto_schedule_matches_explicit_chunk():
+    """sample_batch_size="auto" (the round-4 default, shared
+    resolve_sample_chunk law) must equal an explicit chunk numerically, and
+    bad strings must be rejected eagerly."""
+    import flax.linen as nn
+
+    class Tiny3D(nn.Module):
+        @nn.compact
+        def __call__(self, v):
+            x = jnp.transpose(v, (0, 2, 3, 4, 1))
+            x = nn.Conv(4, (3, 3, 3), strides=(2, 2, 2))(x)
+            x = nn.relu(x).mean(axis=(1, 2, 3))
+            return nn.Dense(5)(x)
+
+    m = Tiny3D()
+    variables = m.init(jax.random.PRNGKey(0), jnp.zeros((1, 1, 16, 16, 16)))
+    fn = lambda v: m.apply(variables, v)
+    x = jnp.asarray(np.random.default_rng(9).standard_normal((2, 1, 16, 16, 16)),
+                    jnp.float32)
+    y = jnp.array([1, 3])
+    a = WaveletAttribution3D(fn, J=2, n_samples=4)(x, y)  # "auto" default
+    b = WaveletAttribution3D(fn, J=2, n_samples=4, sample_batch_size=2)(x, y)
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+
+    with pytest.raises(ValueError):
+        WaveletAttribution3D(fn, sample_batch_size="Auto")

@@ -148,7 +148,7 @@ class WAM1DConfig:
     n_samples: int = 25
     stdev_spread: float = 0.001
     random_seed: int = 42
-    sample_batch_size: int | None = None
+    sample_batch_size: int | None | str = "auto"
     device: str = "auto"
 
 
@@ -164,7 +164,7 @@ class WAM3DConfig:
     n_samples: int = 25
     stdev_spread: float = 1e-4
     random_seed: int = 42
-    sample_batch_size: int | None = None
+    sample_batch_size: int | None | str = "auto"
     device: str = "auto"
 
 

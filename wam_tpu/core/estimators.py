@@ -22,7 +22,36 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-__all__ = ["noise_sigma", "smoothgrad", "integrated_path", "trapezoid"]
+__all__ = ["noise_sigma", "smoothgrad", "integrated_path", "trapezoid",
+           "resolve_sample_chunk", "validate_sample_batch_size"]
+
+
+def validate_sample_batch_size(value) -> None:
+    """Reject any string other than exactly "auto" (bool("false") is True —
+    an unvalidated config string would silently change the schedule)."""
+    if isinstance(value, str) and value != "auto":
+        raise ValueError(
+            f"sample_batch_size must be an int, None or 'auto', got {value!r}"
+        )
+
+
+# The v5e scheduling law all three modalities obey (BASELINE.md round-3
+# scaling study + the round-4 median-of-k re-sweeps that overturned the
+# "audio/3D prefer full vmap" single-min artifact): ~128 model rows per
+# mapped sample step.
+_AUTO_TARGET_ROWS = 128
+
+
+def resolve_sample_chunk(sample_batch_size, batch: int, n_samples: int):
+    """Trace-time resolution of sample_batch_size="auto": chunk the sample
+    map so chunk·batch ≈ 128 model rows on TPU, full vmap elsewhere.
+    Explicit ints/None pass through."""
+    if sample_batch_size != "auto":
+        return sample_batch_size
+    if jax.default_backend() != "tpu":
+        return None
+    chunk = max(1, _AUTO_TARGET_ROWS // max(1, int(batch)))
+    return None if chunk >= n_samples else chunk
 
 
 def noise_sigma(x: jax.Array, stdev_spread: float) -> jax.Array:

@@ -22,7 +22,12 @@ import jax.numpy as jnp
 import numpy as np
 
 from wam_tpu.core.engine import WamEngine
-from wam_tpu.core.estimators import smoothgrad, trapezoid
+from wam_tpu.core.estimators import (
+    resolve_sample_chunk,
+    smoothgrad,
+    trapezoid,
+    validate_sample_batch_size,
+)
 from wam_tpu.ops.melspec import melspectrogram, mel_to_stft_magnitude, stft_power
 from wam_tpu.wavelets import wavedec, waverec
 
@@ -162,7 +167,7 @@ class WaveletAttribution1D(BaseWAM1D):
         n_samples: int = 25,
         stdev_spread: float = 0.001,
         random_seed: int = 42,
-        sample_batch_size: int | None = None,
+        sample_batch_size: int | None | str = "auto",
         stream_noise: bool = False,
     ):
         super().__init__(
@@ -177,10 +182,16 @@ class WaveletAttribution1D(BaseWAM1D):
         )
         if method not in ("smooth", "integratedgrad"):
             raise ValueError(f"Unknown method {method!r}")
+        validate_sample_batch_size(sample_batch_size)
         self.method = method
         self.n_samples = n_samples
         self.stdev_spread = stdev_spread
         self.random_seed = random_seed
+        # "auto" = ~128 model rows per mapped step on TPU, full vmap
+        # elsewhere. Round 3's "audio prefers full sample vmap" was a
+        # single-min noise artifact: the round-4 median-of-k sweep measured
+        # chunk 16 (128 rows at b8) at 77.2 wf/s vs full vmap's 62-67
+        # (+24%) — the flagship's 128-row law holds here too (BASELINE.md).
         self.sample_batch_size = sample_batch_size
         # stream_noise: draw SmoothGrad noise inside the sample map instead
         # of materializing the (n_samples, N, W) buffer (different, equally
@@ -192,6 +203,9 @@ class WaveletAttribution1D(BaseWAM1D):
         # surface, SURVEY.md §5.6).
         self._jit_smooth = jax.jit(self._smooth_impl)
         self._jit_ig = jax.jit(self._ig_impl)
+
+    def _resolve_chunk(self, batch: int) -> int | None:
+        return resolve_sample_chunk(self.sample_batch_size, batch, self.n_samples)
 
     def _tap_grads(self, x, y):
         """(mel grads, coeff grads) for one (possibly perturbed) batch."""
@@ -219,7 +233,7 @@ class WaveletAttribution1D(BaseWAM1D):
             key,
             n_samples=self.n_samples,
             stdev_spread=self.stdev_spread,
-            batch_size=self.sample_batch_size,
+            batch_size=self._resolve_chunk(x.shape[0]),
             materialize_noise=not self.stream_noise,
         )
 
@@ -241,7 +255,7 @@ class WaveletAttribution1D(BaseWAM1D):
             scaled = jax.tree_util.tree_map(lambda c: c * alpha, coeffs)
             return self._tap_grads_from_coeffs(scaled, y, x.shape[-1])
 
-        path = jax.lax.map(one, alphas, batch_size=self.sample_batch_size)
+        path = jax.lax.map(one, alphas, batch_size=self._resolve_chunk(x.shape[0]))
         integ = jax.tree_util.tree_map(trapezoid, path)
         mel_attr = baseline_mel * integ[0]
         coeff_attr = [c * g for c, g in zip(coeffs, integ[1])]

@@ -19,7 +19,12 @@ import jax
 import jax.numpy as jnp
 
 from wam_tpu.core.engine import WamEngine
-from wam_tpu.core.estimators import integrated_path, smoothgrad
+from wam_tpu.core.estimators import (
+    integrated_path,
+    resolve_sample_chunk,
+    smoothgrad,
+    validate_sample_batch_size,
+)
 from wam_tpu.ops.packing2d import disentangle_scales, mosaic2d, reproject_mosaic
 
 __all__ = ["BaseWAM2D", "WaveletAttribution2D"]
@@ -155,10 +160,7 @@ class WaveletAttribution2D(BaseWAM2D):
         )
         if method not in ("smooth", "integratedgrad"):
             raise ValueError(f"Unknown method {method!r}")
-        if isinstance(sample_batch_size, str) and sample_batch_size != "auto":
-            raise ValueError(
-                f"sample_batch_size must be an int, None or 'auto', got {sample_batch_size!r}"
-            )
+        validate_sample_batch_size(sample_batch_size)
         if isinstance(stream_noise, str) and stream_noise != "auto":
             # reject e.g. "false" from a config string: bool("false") is True
             raise ValueError(
@@ -179,14 +181,10 @@ class WaveletAttribution2D(BaseWAM2D):
     def _resolve_chunk(self, x_shape) -> int | None:
         """Trace-time resolution of sample_batch_size="auto": target ~128
         model rows per mapped step on TPU (chunk · batch ≈ 128, the v5e
-        sweet spot), full vmap elsewhere — exactly the schedule bench.py
-        records, now the class default."""
-        if self.sample_batch_size != "auto":
-            return self.sample_batch_size
-        if jax.default_backend() != "tpu":
-            return None
-        chunk = max(1, 128 // max(1, int(x_shape[0])))
-        return None if chunk >= self.n_samples else chunk
+        sweet spot — the shared law in `core.estimators.resolve_sample_chunk`),
+        full vmap elsewhere — exactly the schedule bench.py records."""
+        return resolve_sample_chunk(self.sample_batch_size, x_shape[0],
+                                    self.n_samples)
 
     def _resolve_stream(self, x_shape) -> bool:
         """stream_noise="auto": stream only when the materialized

@@ -27,7 +27,12 @@ import jax.numpy as jnp
 import numpy as np
 
 from wam_tpu.core.engine import WamEngine, target_loss
-from wam_tpu.core.estimators import smoothgrad, trapezoid
+from wam_tpu.core.estimators import (
+    resolve_sample_chunk,
+    smoothgrad,
+    trapezoid,
+    validate_sample_batch_size,
+)
 from wam_tpu.ops.packing3d import cube3d, visualize_cube
 from wam_tpu.wavelets import wavedec, waverec, waverec3
 
@@ -198,7 +203,7 @@ class WaveletAttribution3D(BaseWAM3D):
         n_samples: int = 25,
         stdev_spread: float = 1e-4,
         random_seed: int = 42,
-        sample_batch_size: int | None = None,
+        sample_batch_size: int | None | str = "auto",
         stream_noise: bool = False,
     ):
         super().__init__(
@@ -213,10 +218,16 @@ class WaveletAttribution3D(BaseWAM3D):
         )
         if method not in ("smooth", "integratedgrad"):
             raise ValueError(f"Unknown method {method!r}")
+        validate_sample_batch_size(sample_batch_size)
         self.method = method
         self.n_samples = n_samples
         self.stdev_spread = stdev_spread
         self.random_seed = random_seed
+        # "auto" = ~128 model rows per mapped step on TPU, full vmap
+        # elsewhere. Round 3's "3D prefers full sample vmap" was a
+        # single-min noise artifact: the round-4 median-of-k sweep measured
+        # chunk 13 (104 rows at b8) at 109.5 vol/s vs full vmap's 90.3
+        # (+21%) — the flagship's 128-row law holds here too (BASELINE.md).
         self.sample_batch_size = sample_batch_size
         # stream_noise: see core.estimators.smoothgrad(materialize_noise=False)
         self.stream_noise = stream_noise
@@ -226,6 +237,9 @@ class WaveletAttribution3D(BaseWAM3D):
         # caches die with the instance — no process-global registry.
         self._jit_smooth = functools.cache(self._build_smooth)
         self._jit_ig = functools.cache(self._build_ig)
+
+    def _resolve_chunk(self, batch: int) -> int | None:
+        return resolve_sample_chunk(self.sample_batch_size, batch, self.n_samples)
 
     def _cube_step(self, vol, y):
         coeffs = self.engine.decompose(vol)
@@ -244,7 +258,7 @@ class WaveletAttribution3D(BaseWAM3D):
             key,
             n_samples=self.n_samples,
             stdev_spread=self.stdev_spread,
-            batch_size=self.sample_batch_size,
+            batch_size=self._resolve_chunk(vol.shape[0]),
             materialize_noise=not self.stream_noise,
         )
 
@@ -280,7 +294,7 @@ class WaveletAttribution3D(BaseWAM3D):
 
             return cube3d(jax.grad(loss)(scaled))
 
-        path = jax.lax.map(one, alphas, batch_size=self.sample_batch_size)
+        path = jax.lax.map(one, alphas, batch_size=self._resolve_chunk(v.shape[0]))
         return baseline * trapezoid(path)
 
     def _build_ig(self, has_label: bool):
