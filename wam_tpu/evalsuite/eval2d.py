@@ -103,6 +103,7 @@ class Eval2DWAM:
         self.data_axis = data_axis
         self._auc_runners: dict = {}
         self._mu_runners: dict = {}
+        self._mu_draw_cache: dict = {}
         self.grad_wams = None
         self.insertion_curves = []
         self.deletion_curves = []
@@ -196,25 +197,14 @@ class Eval2DWAM:
 
     def _mu_random_draws(self, n_images: int, grid_size: int, sample_size: int,
                          subset_size: int):
-        """Host-side config randomness for μ-fidelity, in the reference's
-        per-image draw order (continuous baseline-search masks first, then
-        the feature subsets) so results are independent of batching."""
-        rng = np.random.default_rng(self.random_seed)
-        rand_masks, onehots = [], []
-        for _ in range(n_images):
-            rand_masks.append(
-                rng.uniform(size=(sample_size, grid_size, grid_size)).astype(np.float32)
-            )
-            subsets = np.stack(
-                [
-                    rng.choice(grid_size * grid_size, size=subset_size, replace=False)
-                    for _ in range(sample_size)
-                ]
-            )  # (sample_size, subset_size)
-            onehot = np.zeros((sample_size, grid_size * grid_size), dtype=np.float32)
-            np.put_along_axis(onehot, subsets, 1.0, axis=1)
-            onehots.append(onehot)
-        return jnp.asarray(np.stack(rand_masks)), jnp.asarray(np.stack(onehots))
+        """Shared cached μ randomness (metrics.mu_fidelity_draws), WITH the
+        per-image continuous baseline-search masks this evaluator needs."""
+        from wam_tpu.evalsuite.metrics import mu_fidelity_draws
+
+        return mu_fidelity_draws(
+            self._mu_draw_cache, self.random_seed, n_images, grid_size,
+            sample_size, subset_size, with_rand_masks=True,
+        )
 
     def _make_mu_runner(self, grid_size: int, sample_size: int):
         """ONE-jit-dispatch μ-fidelity for the whole batch (VERDICT.md

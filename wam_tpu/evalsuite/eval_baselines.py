@@ -104,6 +104,7 @@ class _BaseEvalBaselines:
         self.model_fn = model_fn
         self._auc_runners: dict = {}
         self._mu_runners: dict = {}
+        self._mu_draw_cache: dict = {}
         # one jit around the whole explanation: the method bodies
         # (baselines.py) are plain traced JAX, and dispatching them eagerly
         # costs the tunneled TPU's ~100 ms host RTT PER OP — the round-3
@@ -271,19 +272,12 @@ class EvalImageBaselines(_BaseEvalBaselines):
         x = jnp.asarray(x)
         y = np.asarray(y)
         expl = self.precompute(x, y)
-        rng = np.random.default_rng(self.random_seed)
-        onehots = []
-        for _ in range(x.shape[0]):
-            subsets = np.stack(
-                [
-                    rng.choice(grid_size * grid_size, size=subset_size, replace=False)
-                    for _ in range(sample_size)
-                ]
-            )
-            onehot = np.zeros((sample_size, grid_size * grid_size), dtype=np.float32)
-            np.put_along_axis(onehot, subsets, 1.0, axis=1)
-            onehots.append(onehot)
-        onehot_all = jnp.asarray(np.stack(onehots))
+        from wam_tpu.evalsuite.metrics import mu_fidelity_draws
+
+        onehot_all = mu_fidelity_draws(
+            self._mu_draw_cache, self.random_seed, x.shape[0], grid_size,
+            sample_size, subset_size, with_rand_masks=False,
+        )
 
         key = (grid_size, sample_size, tuple(x.shape[1:]), tuple(expl.shape[1:]))
         runner = self._mu_runners.get(key)
