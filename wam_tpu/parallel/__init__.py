@@ -4,6 +4,14 @@ from wam_tpu.parallel.halo import (
     sharded_wavedec3_per,
     sharded_wavedec_per,
 )
+from wam_tpu.parallel.halo_modes import (
+    TailedLeaf,
+    gather_coeffs,
+    gather_leaf,
+    sharded_wavedec2_mode,
+    sharded_wavedec3_mode,
+    sharded_wavedec_mode,
+)
 from wam_tpu.parallel.mesh import P, data_sample_mesh, make_mesh
 from wam_tpu.parallel.multihost import hybrid_mesh, init_distributed, process_local_batch
 from wam_tpu.parallel.sharded import sharded_integrated_path, sharded_smoothgrad, sharded_smoothgrad_spmd
@@ -22,4 +30,10 @@ __all__ = [
     "sharded_wavedec_per",
     "sharded_wavedec2_per",
     "sharded_wavedec3_per",
+    "TailedLeaf",
+    "gather_leaf",
+    "gather_coeffs",
+    "sharded_wavedec_mode",
+    "sharded_wavedec2_mode",
+    "sharded_wavedec3_mode",
 ]

@@ -11,13 +11,13 @@ This is the scaling story for sequences far beyond one core's memory
 (the reference processes its longest input, a 220k-sample waveform, whole —
 `src/dataloader.py:83-97`; this path removes that ceiling).
 
-MODE RESTRICTION — periodized only. Every entry point here is `*_per`.
-Reflect/symmetric/zero modes produce (n+L−1)//2 coefficients per level
-(boundary windows add outputs), which is generally not divisible across
-shards, and `shard_map` requires identical static shapes per shard — so the
-non-expansive periodized transform is the one whose output tiles uniformly.
-Engines default to reflect (2D) / symmetric (1D/3D) on a single device;
-when sequence-sharding, configure the periodized transform explicitly.
+This module is periodized-only by design: with the `*_per` transforms the
+ring wrap IS the boundary condition and every leaf tiles evenly. The
+engines' default expansive modes (reflect 2D, symmetric 1D/3D) produce
+(n+L−1)//2 coefficients per level, which does not tile — those are covered
+by `halo_modes.sharded_wavedec{,2,3}_mode`, which keeps the evenly-sharded
+core on this same one-ppermute-per-level schedule and carries the O(L)
+boundary coefficients in a small replicated tail.
 """
 
 from __future__ import annotations
