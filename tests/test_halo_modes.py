@@ -140,10 +140,13 @@ def _audit_hlo(run, x, mesh, spec, gather_cap):
     hlo = run._apply.lower(xs).compile().as_text()
     assert " collective-permute(" in hlo  # the ring halo
     offenders = []
-    for m in re.finditer(r"= \S+?\[([\d,]*)\][^=]*? all-gather\(", hlo):
-        dims = [int(d) for d in m.group(1).split(",") if d] or [1]
-        if int(np.prod(dims)) > gather_cap:
-            offenders.append(m.group(0)[:120])
+    # match sync and async variants; scan EVERY shape in the (possibly
+    # tuple-typed) result so a bundled gather cannot hide behind element 0
+    for m in re.finditer(r"= (\S+?(?:\([^)]*\))?) all-gather(?:-start)?\(", hlo):
+        for shape in re.finditer(r"\[([\d,]*)\]", m.group(1)):
+            dims = [int(d) for d in shape.group(1).split(",") if d] or [1]
+            if int(np.prod(dims)) > gather_cap:
+                offenders.append(m.group(0)[:120])
     assert not offenders, f"signal-sized all-gather(s) in sharded wavedec HLO: {offenders}"
 
 

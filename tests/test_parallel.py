@@ -374,3 +374,129 @@ def test_sharded_smoothgrad_spmd_hlo_has_no_model_gather():
     txt = compiled.as_text()
     assert "all-gather" not in txt, "spmd variant must not gather the model input"
     assert "all-reduce" in txt, "sample-mean psum missing"
+
+
+@pytest.mark.parametrize("wavelet", ["haar", "db4"])
+def test_sharded_waverec_roundtrip_1d(wavelet):
+    _need_devices(8)
+    from wam_tpu.parallel.halo import sharded_waverec_per, sharded_wavedec_per
+
+    mesh = make_mesh({"data": 8})
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, 1024))
+    coeffs = sharded_wavedec_per(mesh, wavelet, level=3)(x)
+    rec = sharded_waverec_per(mesh, wavelet)(coeffs)
+    np.testing.assert_allclose(np.asarray(rec), np.asarray(x), atol=1e-5)
+    # reconstruction stays sharded over the sequence axis
+    assert len(rec.sharding.device_set) == 8
+
+
+def test_sharded_waverec_matches_single_device_1d():
+    _need_devices(8)
+    from wam_tpu.parallel.halo import sharded_waverec_per
+    from wam_tpu.wavelets.periodized import wavedec_per, waverec_per
+
+    mesh = make_mesh({"data": 8})
+    x = jax.random.normal(jax.random.PRNGKey(1), (2, 3, 512))
+    coeffs = wavedec_per(x, "db3", 2)
+    got = sharded_waverec_per(mesh, "db3")(coeffs)
+    want = waverec_per(coeffs, "db3")
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-5)
+
+
+@pytest.mark.parametrize("wavelet", ["haar", "db2"])
+def test_sharded_waverec_roundtrip_2d(wavelet):
+    _need_devices(8)
+    from wam_tpu.parallel.halo import sharded_waverec2_per, sharded_wavedec2_per
+
+    mesh = make_mesh({"data": 8})
+    x = jax.random.normal(jax.random.PRNGKey(2), (2, 64, 32))
+    coeffs = sharded_wavedec2_per(mesh, wavelet, level=2)(x)
+    rec = sharded_waverec2_per(mesh, wavelet)(coeffs)
+    np.testing.assert_allclose(np.asarray(rec), np.asarray(x), atol=1e-5)
+
+
+def test_sharded_waverec_roundtrip_3d():
+    _need_devices(8)
+    from wam_tpu.parallel.halo import sharded_waverec3_per, sharded_wavedec3_per
+
+    mesh = make_mesh({"data": 8})
+    x = jax.random.normal(jax.random.PRNGKey(3), (2, 32, 8, 8))
+    coeffs = sharded_wavedec3_per(mesh, "db2", level=2)(x)
+    rec = sharded_waverec3_per(mesh, "db2")(coeffs)
+    np.testing.assert_allclose(np.asarray(rec), np.asarray(x), atol=1e-5)
+
+
+def test_sharded_waverec_differentiable():
+    """The engine computes VJPs of coeffs -> model(waverec(coeffs)); the
+    sharded reconstruction must therefore be differentiable through
+    shard_map (transpose of the transposed ppermute)."""
+    _need_devices(8)
+    from wam_tpu.parallel.halo import sharded_waverec_per
+    from wam_tpu.wavelets.periodized import wavedec_per, waverec_per
+
+    mesh = make_mesh({"data": 8})
+    x = jax.random.normal(jax.random.PRNGKey(4), (1, 512))
+    coeffs = wavedec_per(x, "db2", 2)
+    rec_fn = sharded_waverec_per(mesh, "db2")
+    w = jax.random.normal(jax.random.PRNGKey(5), (512,))
+
+    def loss_sharded(cs):
+        return jnp.sum(rec_fn(cs) * w)
+
+    def loss_single(cs):
+        return jnp.sum(waverec_per(cs, "db2") * w)
+
+    g_sharded = jax.grad(loss_sharded)(coeffs)
+    g_single = jax.grad(loss_single)(coeffs)
+    for gs, g1 in zip(g_sharded, g_single):
+        np.testing.assert_allclose(np.asarray(gs), np.asarray(g1), atol=1e-5)
+
+
+def test_sharded_coeff_grads_end_to_end_long_context():
+    """The complete long-context WAM gradient loop — sequence-sharded
+    decompose, reconstruct, model forward, per-coefficient backward — in one
+    jit over the mesh, matching the single-device pipeline exactly. The toy
+    model is a conv + global pool, i.e. sequence-partitionable the way the
+    audio CNN is."""
+    _need_devices(8)
+    from wam_tpu.parallel.halo import sharded_coeff_grads_per
+    from wam_tpu.wavelets.periodized import wavedec_per, waverec_per
+
+    mesh = make_mesh({"data": 8})
+    kern = jax.random.normal(jax.random.PRNGKey(0), (4, 1, 9)) * 0.3
+
+    def model_fn(wf):  # (B, N) -> (B, 4)
+        out = jax.lax.conv_general_dilated(
+            wf[:, None, :], kern, window_strides=(1,), padding=[(4, 4)],
+            dimension_numbers=jax.lax.conv_dimension_numbers(
+                (1, 1, 1), (1, 1, 1), ("NCH", "OIH", "NCH")),
+        )
+        return jnp.tanh(out).mean(axis=-1)
+
+    x = jax.random.normal(jax.random.PRNGKey(1), (2, 2048))
+    y = jnp.array([1, 3])
+    step = sharded_coeff_grads_per(mesh, "db3", 3, model_fn)
+    got = step(x, y)
+
+    def single(x):
+        coeffs = wavedec_per(x, "db3", 3)
+
+        def objective(cs):
+            out = model_fn(waverec_per(cs, "db3"))
+            return jnp.take_along_axis(out, y[:, None], axis=1).sum()
+
+        return jax.grad(objective)(coeffs)
+
+    want = single(x)
+    assert len(got) == len(want)
+    for g, w in zip(got, want):
+        assert len(g.sharding.device_set) == 8  # grads stay sequence-sharded
+        np.testing.assert_allclose(np.asarray(g), np.asarray(w), atol=1e-5)
+
+    # representation mode (y=None), the engines' NeRF/feature-model path
+    got_rep = step(x, None)
+    def objective_rep(cs):
+        return model_fn(waverec_per(cs, "db3")).mean()
+    want_rep = jax.grad(objective_rep)(wavedec_per(x, "db3", 3))
+    for g, w in zip(got_rep, want_rep):
+        np.testing.assert_allclose(np.asarray(g), np.asarray(w), atol=1e-5)

@@ -1,8 +1,12 @@
 from wam_tpu.parallel.halo import (
+    sharded_coeff_grads_per,
     sharded_dwt_per,
     sharded_wavedec2_per,
     sharded_wavedec3_per,
     sharded_wavedec_per,
+    sharded_waverec2_per,
+    sharded_waverec3_per,
+    sharded_waverec_per,
 )
 from wam_tpu.parallel.halo_modes import (
     TailedLeaf,
@@ -30,6 +34,10 @@ __all__ = [
     "sharded_wavedec_per",
     "sharded_wavedec2_per",
     "sharded_wavedec3_per",
+    "sharded_waverec_per",
+    "sharded_waverec2_per",
+    "sharded_waverec3_per",
+    "sharded_coeff_grads_per",
     "TailedLeaf",
     "gather_leaf",
     "gather_coeffs",

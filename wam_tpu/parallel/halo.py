@@ -33,7 +33,16 @@ from jax import shard_map
 from wam_tpu.wavelets.filters import build_wavelet
 from wam_tpu.wavelets.periodized import dwt_per, separable_dwt2, separable_dwt3
 
-__all__ = ["sharded_dwt_per", "sharded_wavedec_per", "sharded_wavedec2_per", "sharded_wavedec3_per"]
+__all__ = [
+    "sharded_dwt_per",
+    "sharded_wavedec_per",
+    "sharded_wavedec2_per",
+    "sharded_wavedec3_per",
+    "sharded_waverec_per",
+    "sharded_waverec2_per",
+    "sharded_waverec3_per",
+    "sharded_coeff_grads_per",
+]
 
 
 def _local_dwt_with_halo(x_local: jax.Array, wavelet: str, axis_name: str):
@@ -181,3 +190,119 @@ def sharded_wavedec3_per(mesh: Mesh, wavelet: str, level: int, seq_axis: str = "
         )
 
     return _sharded_wavedec_nd(mesh, level, seq_axis, 3, level_fn)
+
+
+# ---------------------------------------------------------------------------
+# Inverse (synthesis) direction — completes the long-context engine loop:
+# decompose → perturb coefficients → reconstruct → model, all sharded.
+# ---------------------------------------------------------------------------
+
+
+def _sharded_waverec_nd(mesh: Mesh, seq_axis: str, ndim: int, level_fn):
+    """Shared multi-level builder for the sharded reconstructions.
+
+    The single-device `idwt*_per` invert via `jax.linear_transpose` of the
+    forward (the transform is orthogonal, so adjoint = inverse). The same
+    identity holds per shard: transposing the forward level kernel INSIDE
+    `shard_map` flips its `lax.ppermute` (the transpose of a permutation is
+    the inverse permutation), so the synthesis halo travels the opposite
+    ring direction automatically and the result is the exact inverse of the
+    sharded decomposition — one collective per level, no gathers.
+
+    `check_vma=False`: the transposed kernel's cotangents are device-varying
+    (they carry the mesh-axis variance annotation), which the
+    `linear_transpose` expectation — traced from a plain ShapeDtypeStruct —
+    cannot express; the variance check is disabled and correctness is
+    pinned by the round-trip/parity tests instead."""
+    spec = P(*((None, seq_axis) + (None,) * (ndim - 1)))
+
+    @partial(shard_map, mesh=mesh, in_specs=spec, out_specs=spec, check_vma=False)
+    def run(coeffs):
+        a = coeffs[0]
+        for det in coeffs[1:]:
+            spatial = tuple(2 * s for s in a.shape[-ndim:])
+            x_spec = jax.ShapeDtypeStruct(a.shape[:-ndim] + spatial, a.dtype)
+            transpose = jax.linear_transpose(level_fn, x_spec)
+            (a,) = transpose((a, det))
+        return a
+
+    @jax.jit
+    def apply(coeffs):
+        leaves = jax.tree_util.tree_leaves(coeffs)
+        lead = leaves[0].shape[: leaves[0].ndim - ndim]
+        flat = jax.tree_util.tree_map(
+            lambda t: t.reshape((-1,) + t.shape[t.ndim - ndim :]), coeffs
+        )
+        out = run(flat)
+        return out.reshape(lead + out.shape[1:])
+
+    return apply
+
+
+def sharded_waverec_per(mesh: Mesh, wavelet: str, seq_axis: str = "data"):
+    """Inverse of `sharded_wavedec_per`: [cA_J, cD_J, ..., cD_1] — every
+    leaf (..., n) sharded over ``seq_axis`` on its last axis — back to the
+    (..., N) signal with the same sharding. Exact adjoint inverse,
+    bit-compatible with `wam_tpu.wavelets.periodized.waverec_per`."""
+    return _sharded_waverec_nd(
+        mesh, seq_axis, 1, lambda t: _local_dwt_with_halo(t, wavelet, seq_axis)
+    )
+
+
+def sharded_waverec2_per(mesh: Mesh, wavelet: str, seq_axis: str = "data"):
+    """Inverse of `sharded_wavedec2_per` (rows sharded). Bit-compatible
+    with `waverec2_per`."""
+
+    def level_fn(x_local):
+        return separable_dwt2(
+            x_local,
+            dwt1_w=lambda t: dwt_per(t, wavelet),
+            dwt1_h=lambda t: _local_dwt_with_halo(t, wavelet, seq_axis),
+        )
+
+    return _sharded_waverec_nd(mesh, seq_axis, 2, level_fn)
+
+
+def sharded_waverec3_per(mesh: Mesh, wavelet: str, seq_axis: str = "data"):
+    """Inverse of `sharded_wavedec3_per` (depth sharded). Bit-compatible
+    with `waverec3_per`."""
+
+    def level_fn(x_local):
+        one = lambda t: dwt_per(t, wavelet)
+        return separable_dwt3(
+            x_local, one, one, lambda t: _local_dwt_with_halo(t, wavelet, seq_axis)
+        )
+
+    return _sharded_waverec_nd(mesh, seq_axis, 3, level_fn)
+
+
+def sharded_coeff_grads_per(mesh: Mesh, wavelet: str, level: int, model_fn, seq_axis: str = "data"):
+    """End-to-end long-context WAM gradient core over a sequence-sharded
+    waveform: decompose -> reconstruct -> model -> per-coefficient gradients,
+    every stage sharded over ``seq_axis`` (reference gradient loop being
+    replaced: `lib/wam_1D.py:88-150`, which back-props through
+    waverec on a whole in-memory waveform).
+
+    `model_fn` maps the reconstructed (B, N) signal to (B, classes) logits
+    and must itself be XLA-partitionable over the sequence axis (convs and
+    reductions are; GSPMD inserts the model-side halos/all-reduces). The
+    returned step computes `grad over coeffs of sum(logits[b, y[b]])` — or
+    of `mean(logits)` when ``y is None``, the engines' representation mode —
+    and every gradient leaf keeps the coefficient sharding, so the WAM
+    packing/analysis stages downstream can stay sharded too."""
+    dec = sharded_wavedec_per(mesh, wavelet, level, seq_axis)
+    rec = sharded_waverec_per(mesh, wavelet, seq_axis)
+
+    @jax.jit
+    def step(x, y=None):
+        coeffs = dec(x)
+
+        def objective(cs):
+            out = model_fn(rec(cs))
+            if y is None:
+                return out.mean()
+            return jnp.take_along_axis(out, y[:, None], axis=1).sum()
+
+        return jax.grad(objective)(coeffs)
+
+    return step
