@@ -36,6 +36,10 @@ def main():
     parser.add_argument("--levels", type=int, default=3)
     parser.add_argument("--spmd", action="store_true",
                         help="use the gather-free shard_map estimator")
+    parser.add_argument("--long-context", type=int, default=0, metavar="N",
+                        help="instead of the 2D estimator, run the sequence-"
+                             "sharded 1D attribution loop on an N-sample "
+                             "waveform (N divisible by devices*2^levels)")
     args = parser.parse_args()
 
     if args.virtual:
@@ -65,6 +69,27 @@ def main():
     mesh = data_sample_mesh()
     print(f"processes: {info['process_count']}  devices: {info['global_devices']}  "
           f"mesh: {dict(mesh.shape)}")
+
+    if args.long_context:
+        # Long-context: the waveform's SEQUENCE axis is sharded end to end —
+        # sharded wavedec (ring halo) → sharded waverec (transposed ring) →
+        # sequence-partitionable model → per-coefficient gradients. No device
+        # ever holds the whole waveform (reference ceiling being removed:
+        # src/dataloader.py:83-97 loads its 220k-sample clips whole).
+        from wam_tpu.models.audio import toy_wave_model
+        from wam_tpu.parallel import make_mesh, sharded_coeff_grads_per
+
+        n = args.long_context
+        seq_mesh = make_mesh({"data": info["global_devices"]})
+        wf = jax.random.normal(jax.random.PRNGKey(3), (args.batch, n))
+        step = sharded_coeff_grads_per(seq_mesh, args.wavelet, args.levels,
+                                       toy_wave_model(jax.random.PRNGKey(2)))
+        grads = step(wf, jnp.arange(args.batch, dtype=jnp.int32) % 4)
+        jax.block_until_ready(grads)
+        print(f"long-context coefficient gradients: "
+              f"{[tuple(g.shape) for g in grads]}, every leaf sharded over "
+              f"{len(grads[0].sharding.device_set)} devices")
+        return
 
     model = resnet18(num_classes=10)
     variables = model.init(jax.random.PRNGKey(0), jnp.zeros((1, args.size, args.size, 3)))

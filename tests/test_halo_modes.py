@@ -140,9 +140,10 @@ def _audit_hlo(run, x, mesh, spec, gather_cap):
     hlo = run._apply.lower(xs).compile().as_text()
     assert " collective-permute(" in hlo  # the ring halo
     offenders = []
-    # match sync and async variants; scan EVERY shape in the (possibly
-    # tuple-typed) result so a bundled gather cannot hide behind element 0
-    for m in re.finditer(r"= (\S+?(?:\([^)]*\))?) all-gather(?:-start)?\(", hlo):
+    # match sync and async variants; the result type of an async start is a
+    # TUPLE containing spaces, so capture either a parenthesized tuple type
+    # or a plain one, then scan EVERY shape inside it
+    for m in re.finditer(r"= (\([^)]*\)|\S+) all-gather(?:-start)?\(", hlo):
         for shape in re.finditer(r"\[([\d,]*)\]", m.group(1)):
             dims = [int(d) for d in shape.group(1).split(",") if d] or [1]
             if int(np.prod(dims)) > gather_cap:

@@ -459,20 +459,12 @@ def test_sharded_coeff_grads_end_to_end_long_context():
     model is a conv + global pool, i.e. sequence-partitionable the way the
     audio CNN is."""
     _need_devices(8)
+    from wam_tpu.models.audio import toy_wave_model
     from wam_tpu.parallel.halo import sharded_coeff_grads_per
     from wam_tpu.wavelets.periodized import wavedec_per, waverec_per
 
     mesh = make_mesh({"data": 8})
-    kern = jax.random.normal(jax.random.PRNGKey(0), (4, 1, 9)) * 0.3
-
-    def model_fn(wf):  # (B, N) -> (B, 4)
-        out = jax.lax.conv_general_dilated(
-            wf[:, None, :], kern, window_strides=(1,), padding=[(4, 4)],
-            dimension_numbers=jax.lax.conv_dimension_numbers(
-                (1, 1, 1), (1, 1, 1), ("NCH", "OIH", "NCH")),
-        )
-        return jnp.tanh(out).mean(axis=-1)
-
+    model_fn = toy_wave_model(jax.random.PRNGKey(0))  # (B, N) -> (B, 4)
     x = jax.random.normal(jax.random.PRNGKey(1), (2, 2048))
     y = jnp.array([1, 3])
     step = sharded_coeff_grads_per(mesh, "db3", 3, model_fn)

@@ -159,12 +159,10 @@ def _sharded_wavedec_nd(mesh: Mesh, level: int, seq_axis: str, ndim: int, level_
     return apply
 
 
-def sharded_wavedec2_per(mesh: Mesh, wavelet: str, level: int, seq_axis: str = "data"):
-    """Multi-level 2D sharded decomposition for images/feature maps whose
-    row axis exceeds one core's memory: x (..., H, W) — any leading dims —
-    with H sharded over ``seq_axis``; every output leaf keeps that sharding.
-    Bit-compatible with `wam_tpu.wavelets.periodized.wavedec2_per`. Requires
-    H divisible by shards·2^level and W divisible by 2^level."""
+def _level_fn_2d(wavelet: str, seq_axis: str):
+    """One 2D analysis level with the row axis halo-sharded. Shared by the
+    forward (`sharded_wavedec2_per`) and the inverse (`sharded_waverec2_per`
+    transposes exactly this function) so the two cannot drift."""
 
     def level_fn(x_local):
         return separable_dwt2(
@@ -173,7 +171,29 @@ def sharded_wavedec2_per(mesh: Mesh, wavelet: str, level: int, seq_axis: str = "
             dwt1_h=lambda t: _local_dwt_with_halo(t, wavelet, seq_axis),
         )
 
-    return _sharded_wavedec_nd(mesh, level, seq_axis, 2, level_fn)
+    return level_fn
+
+
+def _level_fn_3d(wavelet: str, seq_axis: str):
+    """One 3D analysis level with the depth axis halo-sharded (see
+    `_level_fn_2d` for the forward/inverse sharing contract)."""
+
+    def level_fn(x_local):
+        one = lambda t: dwt_per(t, wavelet)
+        return separable_dwt3(
+            x_local, one, one, lambda t: _local_dwt_with_halo(t, wavelet, seq_axis)
+        )
+
+    return level_fn
+
+
+def sharded_wavedec2_per(mesh: Mesh, wavelet: str, level: int, seq_axis: str = "data"):
+    """Multi-level 2D sharded decomposition for images/feature maps whose
+    row axis exceeds one core's memory: x (..., H, W) — any leading dims —
+    with H sharded over ``seq_axis``; every output leaf keeps that sharding.
+    Bit-compatible with `wam_tpu.wavelets.periodized.wavedec2_per`. Requires
+    H divisible by shards·2^level and W divisible by 2^level."""
+    return _sharded_wavedec_nd(mesh, level, seq_axis, 2, _level_fn_2d(wavelet, seq_axis))
 
 
 def sharded_wavedec3_per(mesh: Mesh, wavelet: str, level: int, seq_axis: str = "data"):
@@ -182,14 +202,7 @@ def sharded_wavedec3_per(mesh: Mesh, wavelet: str, level: int, seq_axis: str = "
     sharded over ``seq_axis``. Bit-compatible with
     `wam_tpu.wavelets.periodized.wavedec3_per`. Requires D divisible by
     shards·2^level and H, W divisible by 2^level."""
-
-    def level_fn(x_local):
-        one = lambda t: dwt_per(t, wavelet)
-        return separable_dwt3(
-            x_local, one, one, lambda t: _local_dwt_with_halo(t, wavelet, seq_axis)
-        )
-
-    return _sharded_wavedec_nd(mesh, level, seq_axis, 3, level_fn)
+    return _sharded_wavedec_nd(mesh, level, seq_axis, 3, _level_fn_3d(wavelet, seq_axis))
 
 
 # ---------------------------------------------------------------------------
@@ -252,28 +265,13 @@ def sharded_waverec_per(mesh: Mesh, wavelet: str, seq_axis: str = "data"):
 def sharded_waverec2_per(mesh: Mesh, wavelet: str, seq_axis: str = "data"):
     """Inverse of `sharded_wavedec2_per` (rows sharded). Bit-compatible
     with `waverec2_per`."""
-
-    def level_fn(x_local):
-        return separable_dwt2(
-            x_local,
-            dwt1_w=lambda t: dwt_per(t, wavelet),
-            dwt1_h=lambda t: _local_dwt_with_halo(t, wavelet, seq_axis),
-        )
-
-    return _sharded_waverec_nd(mesh, seq_axis, 2, level_fn)
+    return _sharded_waverec_nd(mesh, seq_axis, 2, _level_fn_2d(wavelet, seq_axis))
 
 
 def sharded_waverec3_per(mesh: Mesh, wavelet: str, seq_axis: str = "data"):
     """Inverse of `sharded_wavedec3_per` (depth sharded). Bit-compatible
     with `waverec3_per`."""
-
-    def level_fn(x_local):
-        one = lambda t: dwt_per(t, wavelet)
-        return separable_dwt3(
-            x_local, one, one, lambda t: _local_dwt_with_halo(t, wavelet, seq_axis)
-        )
-
-    return _sharded_waverec_nd(mesh, seq_axis, 3, level_fn)
+    return _sharded_waverec_nd(mesh, seq_axis, 3, _level_fn_3d(wavelet, seq_axis))
 
 
 def sharded_coeff_grads_per(mesh: Mesh, wavelet: str, level: int, model_fn, seq_axis: str = "data"):
