@@ -79,9 +79,16 @@ def main():
         from wam_tpu.models.audio import toy_wave_model
         from wam_tpu.parallel import make_mesh, sharded_coeff_grads_per
 
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
         n = args.long_context
         seq_mesh = make_mesh({"data": info["global_devices"]})
-        wf = jax.random.normal(jax.random.PRNGKey(3), (args.batch, n))
+        # materialize the waveform ALREADY sharded — creating it unsharded
+        # on one device would defeat the memory point of the sharded loop
+        wf = jax.jit(
+            lambda key: jax.random.normal(key, (args.batch, n)),
+            out_shardings=NamedSharding(seq_mesh, P(None, "data")),
+        )(jax.random.PRNGKey(3))
         step = sharded_coeff_grads_per(seq_mesh, args.wavelet, args.levels,
                                        toy_wave_model(jax.random.PRNGKey(2)))
         grads = step(wf, jnp.arange(args.batch, dtype=jnp.int32) % 4)

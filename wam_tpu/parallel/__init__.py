@@ -12,9 +12,11 @@ from wam_tpu.parallel.halo_modes import (
     TailedLeaf,
     gather_coeffs,
     gather_leaf,
+    sharded_coeff_grads_mode,
     sharded_wavedec2_mode,
     sharded_wavedec3_mode,
     sharded_wavedec_mode,
+    sharded_waverec_mode,
 )
 from wam_tpu.parallel.mesh import P, data_sample_mesh, make_mesh
 from wam_tpu.parallel.multihost import hybrid_mesh, init_distributed, process_local_batch
@@ -44,4 +46,6 @@ __all__ = [
     "sharded_wavedec_mode",
     "sharded_wavedec2_mode",
     "sharded_wavedec3_mode",
+    "sharded_waverec_mode",
+    "sharded_coeff_grads_mode",
 ]

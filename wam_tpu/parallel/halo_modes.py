@@ -58,6 +58,7 @@ from wam_tpu.wavelets.transform import (
     _pad_axes,
     _resolve,
     _subband_kernel,
+    _synthesis,
     DETAIL3D_KEYS,
     Detail2D,
 )
@@ -69,6 +70,8 @@ __all__ = [
     "sharded_wavedec_mode",
     "sharded_wavedec2_mode",
     "sharded_wavedec3_mode",
+    "sharded_waverec_mode",
+    "sharded_coeff_grads_mode",
 ]
 
 
@@ -80,7 +83,14 @@ class TailedLeaf(NamedTuple):
 
 
 def gather_leaf(leaf: TailedLeaf, axis: int = -1) -> jax.Array:
-    """Concatenate core and tail into the full coefficient array."""
+    """Concatenate core and tail into the full coefficient array.
+
+    The empty-tail case returns the core directly: besides being a no-op,
+    a concat with a zero-size operand trips an XLA SPMD-partitioner reshape
+    verifier bug when the core is sharded (observed on the one-jit
+    decompose→reconstruct→model gradient graph)."""
+    if leaf.tail.shape[axis] == 0:
+        return leaf.core
     return jnp.concatenate([leaf.core, leaf.tail], axis=axis)
 
 
@@ -238,6 +248,7 @@ def sharded_wavedec_mode(
     k = mesh.shape[seq_axis]
     core_run = _build_core_run(mesh, wav, mode, seq_axis)
     sh = NamedSharding(mesh, P(None, seq_axis))
+    repl = NamedSharding(mesh, P(None, None))
 
     @jax.jit
     def apply(x):
@@ -249,8 +260,9 @@ def sharded_wavedec_mode(
         leaves = []
         for _ in range(level):
             (core, tail_a), (d_core, d_tail) = _level_1d(core, tail, core_run, wav, mode)
-            leaves.append(TailedLeaf(d_core, d_tail))
-            tail = tail_a
+            # keep the O(L) tails replicated — see sharded_waverec_mode
+            leaves.append(TailedLeaf(d_core, lax.with_sharding_constraint(d_tail, repl)))
+            tail = lax.with_sharding_constraint(tail_a, repl)
         leaves.append(TailedLeaf(core, tail))
         coeffs = leaves[::-1]
         return [
@@ -391,3 +403,156 @@ def sharded_wavedec3_mode(
 
     run._apply = apply  # jitted body, exposed for HLO audits (tests)
     return run
+
+
+# ---------------------------------------------------------------------------
+# Inverse (synthesis) direction for the expansive modes — completes the
+# DEFAULT-mode long-context loop: decompose → perturb → reconstruct → model.
+# ---------------------------------------------------------------------------
+
+
+def _synth_core_local(subs_local: jax.Array, halo_src: jax.Array, wav: Wavelet, seq_axis: str) -> jax.Array:
+    """Per-shard synthesis kernel: (B, 2, m) local subbands -> (B, 2m) local
+    reconstruction. Output sample t depends on coefficients
+    j ∈ [⌈(t-1)/2⌉, ⌊(t+L-2)/2⌋], i.e. the halo travels from the SUCCESSOR
+    (the reversed ring of the analysis direction); the last shard's
+    successor-halo is the replicated tail's head, passed in as ``halo_src``."""
+    L = wav.filt_len
+    m = subs_local.shape[-1]
+    h = (L - 1) // 2
+    if h > 0:
+        k = lax.axis_size(seq_axis)
+        perm = [(i, (i - 1) % k) for i in range(k)]
+        ring = lax.ppermute(subs_local[..., :h], seq_axis, perm=perm)
+        last = lax.axis_index(seq_axis) == k - 1
+        ext = jnp.concatenate([subs_local, jnp.where(last, halo_src, ring)], axis=-1)
+    else:
+        ext = subs_local
+    # trimming to 2m keeps exactly this shard's outputs (the [0, 2m) window
+    # of the block reconstruction equals the global samples [2sm, 2(s+1)m))
+    flat = ext.reshape((-1,) + ext.shape[-2:])
+    out = _synthesis(flat, wav, 1, (2 * m,))
+    return out.reshape(ext.shape[:-2] + (2 * m,))
+
+
+def _level_inv_1d(coreA, tailA, coreD, tailD, synth_run, wav):
+    """One synthesis level on TailedLeaf pieces (flattened (B, ·) arrays):
+    returns (core_out (B, 2C) sharded, tail_out (B, 2T-L+2) replicated).
+    Tail outputs t >= 2C depend ONLY on tail coefficients (jmin(2C) = C), so
+    they synthesize replicated from the tails alone."""
+    L = wav.filt_len
+    T = tailA.shape[-1]
+    h = (L - 1) // 2
+    if T < h:
+        raise ValueError(
+            f"tail length {T} < {h} coefficients: the last shard's synthesis "
+            "halo must come from the tail; feed leaves produced by "
+            "sharded_wavedec_mode (its tails always satisfy this)"
+        )
+    subs = jnp.stack([coreA, coreD], axis=-2)          # (B, 2, C)
+    tail_subs = jnp.stack([tailA, tailD], axis=-2)     # (B, 2, T)
+    core_out = synth_run(subs, tail_subs[..., :h])
+    t_len = max(2 * T - L + 2, 0)
+    if t_len == 0:  # haar chains (T=0) and the exact-h tails of deep chains
+        return core_out, tailA[..., :0]
+    tail_out = _synthesis(tail_subs, wav, 1, (t_len,))
+    return core_out, tail_out
+
+
+def _build_synth_run(mesh: Mesh, wav: Wavelet, seq_axis: str):
+    return shard_map(
+        partial(_synth_core_local, wav=wav, seq_axis=seq_axis),
+        mesh=mesh,
+        in_specs=(P(None, None, seq_axis), P(None, None, None)),
+        out_specs=P(None, seq_axis),
+    )
+
+
+def sharded_waverec_mode(mesh: Mesh, wavelet, seq_axis: str = "data"):
+    """Inverse of `sharded_wavedec_mode`: the TailedLeaf coefficient list
+    back to the (..., N) signal as a `TailedLeaf` (core (..., 2C_top)
+    sharded, tail replicated; `gather_leaf` yields the full signal).
+    Matches `transform.waverec` exactly — including its trim-to-detail
+    convention, which in core+tail form touches only the replicated tail."""
+    wav = _resolve(wavelet)
+    synth_run = _build_synth_run(mesh, wav, seq_axis)
+    # pin every tail op replicated: left to propagation, the partitioner may
+    # try to shard a length-~L tail conv over the mesh, producing zero-size
+    # partitions and an invalid reshape ("failed after spmd-partitioning")
+    repl = NamedSharding(mesh, P(None, None))
+
+    @jax.jit
+    def apply(coeffs):
+        lead = coeffs[0].core.shape[:-1]
+        b = int(np.prod(lead)) if lead else 1
+        flat = [
+            TailedLeaf(
+                c.core.reshape((b, c.core.shape[-1])),
+                c.tail.reshape((b, c.tail.shape[-1])),
+            )
+            for c in coeffs
+        ]
+        a = flat[0]
+        for d in flat[1:]:
+            if a.tail.shape[-1] > d.tail.shape[-1]:
+                a = TailedLeaf(a.core, a.tail[..., : d.tail.shape[-1]])
+            core, tail = _level_inv_1d(a.core, a.tail, d.core, d.tail, synth_run, wav)
+            a = TailedLeaf(core, lax.with_sharding_constraint(tail, repl))
+        return TailedLeaf(
+            a.core.reshape(lead + a.core.shape[1:]),
+            a.tail.reshape(lead + a.tail.shape[1:]),
+        )
+
+    k = mesh.shape[seq_axis]
+
+    def run(coeffs):
+        for c in coeffs:
+            C = c.core.shape[-1]
+            if C % k:
+                raise ValueError(
+                    f"coefficient core length {C} is not divisible by "
+                    f"shards={k}: these leaves were not produced by "
+                    f"sharded_wavedec_mode on this mesh"
+                )
+        return apply(coeffs)
+
+    run._apply = apply  # jitted body, exposed for HLO audits (tests)
+    return run
+
+
+def sharded_coeff_grads_mode(
+    mesh: Mesh, wavelet, level: int, model_fn, mode: str = "symmetric", seq_axis: str = "data"
+):
+    """End-to-end long-context WAM gradient core in the engines' DEFAULT
+    boundary modes (the periodized variant is
+    `halo.sharded_coeff_grads_per`): sequence-sharded decompose →
+    reconstruct → model → per-coefficient gradients, one jit over the mesh.
+    `model_fn` maps the reconstructed (B, N) signal to (B, classes) logits
+    (sequence-partitionable); gradients come back in the TailedLeaf
+    structure of the coefficients."""
+    wav = _resolve(wavelet)
+    dec = sharded_wavedec_mode(mesh, wav, level, mode, seq_axis)
+    rec = sharded_waverec_mode(mesh, wav, seq_axis)
+
+    def _objective(cs, y):
+        out = model_fn(gather_leaf(rec(cs)))
+        if y is None:
+            return out.mean()
+        return jnp.take_along_axis(out, y[:, None], axis=1).sum()
+
+    # Two dispatches (decompose, then grads), not one: fusing them into a
+    # single jit trips an XLA SPMD-partitioner verifier bug ("reshape
+    # element count mismatch, failed after spmd-partitioning") on the
+    # zero-size tail buffers the chain carries; each half compiles and
+    # partitions cleanly on its own, and the split costs one extra host
+    # round trip per step on workloads dominated by device compute.
+    grads_labeled = jax.jit(lambda cs, y: jax.grad(_objective)(cs, y))
+    grads_rep = jax.jit(lambda cs: jax.grad(_objective)(cs, None))
+
+    def step(x, y=None):
+        coeffs = dec(x)
+        return grads_labeled(coeffs, y) if y is not None else grads_rep(coeffs)
+
+    step._dec = dec  # jitted halves, exposed for HLO audits (tests)
+    step._grads = grads_labeled
+    return step
