@@ -40,6 +40,10 @@ def main():
                         help="instead of the 2D estimator, run the sequence-"
                              "sharded 1D attribution loop on an N-sample "
                              "waveform (N divisible by devices*2^levels)")
+    parser.add_argument("--boundary", default="periodization",
+                        help="boundary mode for --long-context: periodization "
+                             "(ring wrap, default) or an expansive pywt mode "
+                             "(symmetric/reflect/zero) via the core+tail path")
     args = parser.parse_args()
 
     if args.virtual:
@@ -77,7 +81,11 @@ def main():
         # ever holds the whole waveform (reference ceiling being removed:
         # src/dataloader.py:83-97 loads its 220k-sample clips whole).
         from wam_tpu.models.audio import toy_wave_model
-        from wam_tpu.parallel import make_mesh, sharded_coeff_grads_per
+        from wam_tpu.parallel import (
+            make_mesh,
+            sharded_coeff_grads_mode,
+            sharded_coeff_grads_per,
+        )
 
         from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -89,13 +97,20 @@ def main():
             lambda key: jax.random.normal(key, (args.batch, n)),
             out_shardings=NamedSharding(seq_mesh, P(None, "data")),
         )(jax.random.PRNGKey(3))
-        step = sharded_coeff_grads_per(seq_mesh, args.wavelet, args.levels,
-                                       toy_wave_model(jax.random.PRNGKey(2)))
+        model = toy_wave_model(jax.random.PRNGKey(2))
+        if args.boundary == "periodization":
+            step = sharded_coeff_grads_per(seq_mesh, args.wavelet, args.levels, model)
+        else:
+            step = sharded_coeff_grads_mode(seq_mesh, args.wavelet, args.levels,
+                                            model, args.boundary)
         grads = step(wf, jnp.arange(args.batch, dtype=jnp.int32) % 4)
         jax.block_until_ready(grads)
-        print(f"long-context coefficient gradients: "
-              f"{[tuple(g.shape) for g in grads]}, every leaf sharded over "
-              f"{len(grads[0].sharding.device_set)} devices")
+        leaves = jax.tree_util.tree_leaves(grads)
+        shown = [tuple(g.shape) for g in leaves[:4]]
+        more = "..." if len(leaves) > 4 else ""
+        print(f"long-context coefficient gradients ({args.boundary}): "
+              f"{shown}{more}, every leaf sharded over "
+              f"{len(leaves[0].sharding.device_set)} devices")
         return
 
     model = resnet18(num_classes=10)

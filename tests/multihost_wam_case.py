@@ -56,3 +56,30 @@ def build_case():
         "smoothgrad_runner": smoothgrad_runner,
         "insertion_runner": insertion_runner,
     }
+
+
+def build_halo_case():
+    """Sequence-sharded long-context machinery for the 2-process test: the
+    analysis ring ppermute, the reversed synthesis ring, and the replicated
+    tails all CROSS the DCN process boundary on a {"data": 8} hybrid mesh.
+    Deterministic seeds make single-process golden vs cluster comparisons
+    meaningful (same convention as build_case)."""
+    from wam_tpu.models.audio import toy_wave_model
+
+    rng = np.random.default_rng(7)
+    x = jnp.asarray(rng.standard_normal((2, 2048)), dtype=jnp.float32)
+    model = toy_wave_model(jax.random.PRNGKey(0))
+    y = jnp.array([1, 3])
+
+    def dec_runner(mesh):
+        from wam_tpu.parallel import sharded_wavedec_per
+
+        return sharded_wavedec_per(mesh, "db3", 3, seq_axis="data")(x)
+
+    def mode_grads_runner(mesh):
+        from wam_tpu.parallel import sharded_coeff_grads_mode
+
+        step = sharded_coeff_grads_mode(mesh, "db3", 3, model, "symmetric")
+        return step(x, y)
+
+    return {"dec_runner": dec_runner, "mode_grads_runner": mode_grads_runner}

@@ -128,6 +128,31 @@ _WAM_WORKER = textwrap.dedent(
     # 1.8e-7); everything else in the step is identical
     np.testing.assert_allclose(full, golden["mosaic"], atol=1e-5, rtol=1e-5)
     np.testing.assert_allclose(np.asarray(ins), golden["ins"], atol=1e-6)
+
+    # long-context machinery across the DCN boundary: the analysis ring
+    # ppermute, the reversed synthesis ring, and the replicated tails of the
+    # default-mode gradient loop all span the two processes on a pure
+    # {{"data": 8}} mesh; every process checks its addressable shards
+    # against the single-process golden slices
+    from tests.multihost_wam_case import build_halo_case
+
+    seq_mesh = hybrid_mesh({{"data": -1}}, dcn_axis="data")
+    assert dict(seq_mesh.shape) == {{"data": 8}}
+    halo = build_halo_case()
+    for i, leaf in enumerate(halo["dec_runner"](seq_mesh)):
+        want = golden[f"dec_{{i}}"]
+        for shard in leaf.addressable_shards:
+            np.testing.assert_allclose(
+                np.asarray(shard.data), want[shard.index], atol=1e-6
+            )
+    for i, g in enumerate(halo["mode_grads_runner"](seq_mesh)):
+        wc, wt = golden[f"gcore_{{i}}"], golden[f"gtail_{{i}}"]
+        for shard in g.core.addressable_shards:
+            np.testing.assert_allclose(
+                np.asarray(shard.data), wc[shard.index], atol=1e-5
+            )
+        for shard in g.tail.addressable_shards:
+            np.testing.assert_allclose(np.asarray(shard.data), wt, atol=1e-5)
     print(f"WAMWORKER{{pid}}_OK", flush=True)
     """
 )
@@ -142,12 +167,22 @@ def test_two_process_real_wam_matches_single_process(tmp_path):
     from wam_tpu.parallel import hybrid_mesh
 
     # golden: same global mesh shape, one process, 8 devices
+    from tests.multihost_wam_case import build_halo_case
+
     case = build_case()
     mesh = hybrid_mesh({"data": 4, "sample": 2})
     golden_mosaic = np.asarray(case["smoothgrad_runner"](mesh))
     golden_ins = np.asarray(case["insertion_runner"](mesh))
+    halo = build_halo_case()
+    seq_mesh = hybrid_mesh({"data": 8})
+    extras = {}
+    for i, leaf in enumerate(halo["dec_runner"](seq_mesh)):
+        extras[f"dec_{i}"] = np.asarray(leaf)
+    for i, g in enumerate(halo["mode_grads_runner"](seq_mesh)):
+        extras[f"gcore_{i}"] = np.asarray(g.core)
+        extras[f"gtail_{i}"] = np.asarray(g.tail)
     golden_path = tmp_path / "golden.npz"
-    np.savez(golden_path, mosaic=golden_mosaic, ins=golden_ins)
+    np.savez(golden_path, mosaic=golden_mosaic, ins=golden_ins, **extras)
 
     coord = f"127.0.0.1:{_free_port()}"
     code = _WAM_WORKER.format(repo=str(_REPO), coord=coord)

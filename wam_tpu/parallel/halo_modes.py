@@ -717,8 +717,8 @@ def sharded_waverec3_mode(mesh: Mesh, wavelet, seq_axis: str = "data"):
             ac = jnp.concatenate([pp.core for pp in a_pieces], axis=0)
             at = jnp.concatenate([pp.tail for pp in a_pieces], axis=0)
             dc = jnp.concatenate([pp.core for pp in d_pieces], axis=0)
-            dt = jnp.concatenate([pp.tail for pp in d_pieces], axis=0)
-            cc, tt = _axis_level_inv((ac, at), (dc, dt), -3, synth_run, wav, repl2)
+            dtl = jnp.concatenate([pp.tail for pp in d_pieces], axis=0)
+            cc, tt = _axis_level_inv((ac, at), (dc, dtl), -3, synth_run, wav, repl2)
             hw = {kk: (cc[i * b : (i + 1) * b], tt[i * b : (i + 1) * b])
                   for i, kk in enumerate(order)}
             # H and W axes second (local): fused 4-channel 2D synthesis
