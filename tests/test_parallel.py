@@ -492,3 +492,35 @@ def test_sharded_coeff_grads_end_to_end_long_context():
     want_rep = jax.grad(objective_rep)(wavedec_per(x, "db3", 3))
     for g, w in zip(got_rep, want_rep):
         np.testing.assert_allclose(np.asarray(g), np.asarray(w), atol=1e-5)
+
+
+@pytest.mark.parametrize("ndim,shape", [(2, (2, 128, 24)), (3, (2, 64, 12, 8))])
+def test_sharded_coeff_grads_per_2d_3d(ndim, shape):
+    """The periodized end-to-end loop generalizes to image rows and volume
+    depth via the ndim parameter."""
+    _need_devices(8)
+    from wam_tpu.models.toy import toy_conv_model
+    from wam_tpu.parallel.halo import sharded_coeff_grads_per
+    from wam_tpu.wavelets import periodized as per
+
+    mesh = make_mesh({"data": 8})
+    model_fn = toy_conv_model(jax.random.PRNGKey(0), ndim=ndim)
+    x = jax.random.normal(jax.random.PRNGKey(1), shape)
+    y = jnp.array([1, 3])
+    got = sharded_coeff_grads_per(mesh, "db2", 2, model_fn, ndim=ndim)(x, y)
+
+    dec = {2: per.wavedec2_per, 3: per.wavedec3_per}[ndim]
+    rec = {2: per.waverec2_per, 3: per.waverec3_per}[ndim]
+
+    def objective(cs):
+        out = model_fn(rec(cs, "db2"))
+        return jnp.take_along_axis(out, y[:, None], axis=1).sum()
+
+    want = jax.grad(objective)(dec(x, "db2", 2))
+    got_leaves = jax.tree_util.tree_leaves(got)
+    want_leaves = jax.tree_util.tree_leaves(want)
+    assert len(got_leaves) == len(want_leaves)
+    for g, w in zip(got_leaves, want_leaves):
+        assert g.shape == w.shape
+        assert len(g.sharding.device_set) == 8
+        np.testing.assert_allclose(np.asarray(g), np.asarray(w), atol=1e-5)

@@ -93,23 +93,9 @@ def bind_audio_inference(model: nn.Module, variables,
 
 
 def toy_wave_model(key=None, classes: int = 4, taps: int = 9):
-    """Tiny sequence-partitionable waveform classifier for demos, tests, and
-    the driver's multi-chip dry-run: one 1D conv (stride 1, SAME) + tanh +
-    global mean over time, (B, N) -> (B, classes). Every op is local or a
-    plain reduction along the sequence axis, so GSPMD shards it over the
-    same mesh axis as the sharded DWT (the halo/all-reduce pattern a real
-    audio CNN exhibits, at a scale that compiles in milliseconds)."""
-    if key is None:
-        key = jax.random.PRNGKey(3)
-    kern = jax.random.normal(key, (classes, 1, taps), jnp.float32) * 0.3
+    """Tiny sequence-partitionable waveform classifier, (B, N) ->
+    (B, classes): the 1D instance of `wam_tpu.models.toy.toy_conv_model`
+    (see there for the demo/dry-run rationale)."""
+    from wam_tpu.models.toy import toy_conv_model
 
-    def model_fn(wf):
-        out = jax.lax.conv_general_dilated(
-            wf[:, None, :], kern, window_strides=(1,),
-            padding=[(taps // 2, taps // 2)],
-            dimension_numbers=jax.lax.conv_dimension_numbers(
-                (1, 1, 1), (1, 1, 1), ("NCH", "OIH", "NCH")),
-        )
-        return jnp.tanh(out).mean(axis=-1)
-
-    return model_fn
+    return toy_conv_model(key, ndim=1, classes=classes, taps=taps)

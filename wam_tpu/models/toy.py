@@ -1,0 +1,36 @@
+"""Tiny sequence-partitionable conv classifiers for demos, tests, and the
+driver's multi-chip dry-run: one SAME conv + tanh + global mean over the
+spatial axes, (B, spatial...) -> (B, classes). Every op is local or a plain
+reduction along any spatial axis, so GSPMD shards these over the same mesh
+axis as the sharded DWT — the halo/all-reduce pattern a real CNN exhibits,
+at a scale that compiles in milliseconds."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["toy_conv_model"]
+
+
+def toy_conv_model(key=None, ndim: int = 2, classes: int = 4, taps: int = 5):
+    """(B, S1..Sn) -> (B, classes); ``ndim`` spatial dims (1=waveform,
+    2=single-channel image, 3=volume)."""
+    if key is None:
+        key = jax.random.PRNGKey(3)
+    kern = jax.random.normal(key, (classes, 1) + (taps,) * ndim, jnp.float32) * 0.3
+    spatial = "HWD"[:ndim]
+    dn = lax.conv_dimension_numbers(
+        (1, 1) + (1,) * ndim, (1, 1) + (1,) * ndim,
+        ("NC" + spatial, "OI" + spatial, "NC" + spatial),
+    )
+    pad = [(taps // 2, taps // 2)] * ndim
+
+    def model_fn(x):
+        out = lax.conv_general_dilated(
+            x[:, None], kern, (1,) * ndim, pad, dimension_numbers=dn
+        )
+        return jnp.tanh(out).mean(axis=tuple(range(2, 2 + ndim)))
+
+    return model_fn

@@ -274,22 +274,36 @@ def sharded_waverec3_per(mesh: Mesh, wavelet: str, seq_axis: str = "data"):
     return _sharded_waverec_nd(mesh, seq_axis, 3, _level_fn_3d(wavelet, seq_axis))
 
 
-def sharded_coeff_grads_per(mesh: Mesh, wavelet: str, level: int, model_fn, seq_axis: str = "data"):
+def sharded_coeff_grads_per(
+    mesh: Mesh, wavelet: str, level: int, model_fn, seq_axis: str = "data", ndim: int = 1
+):
     """End-to-end long-context WAM gradient core over a sequence-sharded
-    waveform: decompose -> reconstruct -> model -> per-coefficient gradients,
+    input: decompose -> reconstruct -> model -> per-coefficient gradients,
     every stage sharded over ``seq_axis`` (reference gradient loop being
     replaced: `lib/wam_1D.py:88-150`, which back-props through
     waverec on a whole in-memory waveform).
 
-    `model_fn` maps the reconstructed (B, N) signal to (B, classes) logits
-    and must itself be XLA-partitionable over the sequence axis (convs and
+    ``ndim`` selects the modality: 1 = waveform last axis, 2 = image ROW
+    axis (x (..., H, W)), 3 = volume DEPTH axis (x (..., D, H, W)).
+    `model_fn` maps the reconstructed signal to (B, classes) logits and
+    must itself be XLA-partitionable over the sequence axis (convs and
     reductions are; GSPMD inserts the model-side halos/all-reduces). The
     returned step computes `grad over coeffs of sum(logits[b, y[b]])` — or
     of `mean(logits)` when ``y is None``, the engines' representation mode —
     and every gradient leaf keeps the coefficient sharding, so the WAM
     packing/analysis stages downstream can stay sharded too."""
-    dec = sharded_wavedec_per(mesh, wavelet, level, seq_axis)
-    rec = sharded_waverec_per(mesh, wavelet, seq_axis)
+    if ndim not in (1, 2, 3):
+        raise ValueError(f"ndim must be 1, 2, or 3; got {ndim!r}")
+    dec = {
+        1: sharded_wavedec_per,
+        2: sharded_wavedec2_per,
+        3: sharded_wavedec3_per,
+    }[ndim](mesh, wavelet, level, seq_axis)
+    rec = {
+        1: sharded_waverec_per,
+        2: sharded_waverec2_per,
+        3: sharded_waverec3_per,
+    }[ndim](mesh, wavelet, seq_axis)
 
     @jax.jit
     def step(x, y=None):

@@ -543,21 +543,34 @@ def sharded_waverec_mode(mesh: Mesh, wavelet, seq_axis: str = "data"):
 
 
 def sharded_coeff_grads_mode(
-    mesh: Mesh, wavelet, level: int, model_fn, mode: str = "symmetric", seq_axis: str = "data"
+    mesh: Mesh, wavelet, level: int, model_fn, mode: str = "symmetric",
+    seq_axis: str = "data", ndim: int = 1
 ):
     """End-to-end long-context WAM gradient core in the engines' DEFAULT
     boundary modes (the periodized variant is
     `halo.sharded_coeff_grads_per`): sequence-sharded decompose →
     reconstruct → model → per-coefficient gradients, one jit over the mesh.
-    `model_fn` maps the reconstructed (B, N) signal to (B, classes) logits
+    ``ndim`` selects the modality (1 = waveform, 2 = image rows, 3 = volume
+    depth). `model_fn` maps the reconstructed signal to (B, classes) logits
     (sequence-partitionable); gradients come back in the TailedLeaf
-    structure of the coefficients."""
+    structure of the coefficients. The reconstruction handed to the model
+    is evenly sharded: the top-level tail is empty by construction."""
     wav = _resolve(wavelet)
-    dec = sharded_wavedec_mode(mesh, wav, level, mode, seq_axis)
-    rec = sharded_waverec_mode(mesh, wav, seq_axis)
+    if ndim not in (1, 2, 3):
+        raise ValueError(f"ndim must be 1, 2, or 3; got {ndim!r}")
+    dec = {
+        1: sharded_wavedec_mode,
+        2: sharded_wavedec2_mode,
+        3: sharded_wavedec3_mode,
+    }[ndim](mesh, wav, level, mode, seq_axis)
+    rec = {
+        1: sharded_waverec_mode,
+        2: sharded_waverec2_mode,
+        3: sharded_waverec3_mode,
+    }[ndim](mesh, wav, seq_axis)
 
     def _objective(cs, y):
-        out = model_fn(gather_leaf(rec(cs)))
+        out = model_fn(gather_leaf(rec(cs), axis=-ndim))
         if y is None:
             return out.mean()
         return jnp.take_along_axis(out, y[:, None], axis=1).sum()
