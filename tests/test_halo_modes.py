@@ -383,3 +383,21 @@ def test_sharded_coeff_grads_mode_2d_3d(ndim, shape, wavelet, level):
     for g, w in zip(got_leaves, want_leaves):
         assert g.shape == w.shape
         np.testing.assert_allclose(np.asarray(g), np.asarray(w), atol=1e-5)
+
+
+def test_sharded_waverec3_mode_hlo_no_signal_sized_gather():
+    _need_devices(8)
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from wam_tpu.parallel.halo_modes import sharded_waverec3_mode
+
+    mesh = make_mesh({"data": 8})
+    dec = sharded_wavedec3_mode(mesh, "db2", 2, "symmetric")
+    rec = sharded_waverec3_mode(mesh, "db2")
+    x = jax.device_put(jnp.zeros((2, 512, 16, 16), jnp.float32),
+                       NamedSharding(mesh, P(None, "data", None, None)))
+    coeffs = dec(x)
+    rec(coeffs)  # executes
+    hlo = rec._apply.lower(coeffs).compile().as_text()
+    assert " collective-permute(" in hlo
+    offenders = _scan_gathers(hlo, 8192)
+    assert not offenders, f"signal-sized all-gather(s) in waverec3: {offenders}"

@@ -225,59 +225,79 @@ def _lrp_resnet_body(model, variables, x, y, *, eps, composite, nchw):
     stem_relu = jax.nn.relu(stem_bn_out)
     stem_pool = nn.max_pool(stem_relu, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))
 
-    def block_input(s, i):
-        if i > 0:
-            return blocks_out[(s, i - 1)]
+    def stage_input(s):
         if s > 0:
             return blocks_out[(s - 1, model.stage_sizes[s - 1] - 1)]
         return stem_pool
 
+    def _block_step(x_in, bp, acts, stride, R):
+        """Relevance through one residual block. ``acts`` holds the captured
+        bn outputs; ``bp`` the folded conv params (+ downsample when the
+        block has one)."""
+        a1 = jax.nn.relu(acts["bn1"])
+        if is_bottleneck:
+            a2 = jax.nn.relu(acts["bn2"])
+            main_out = acts["bn3"]
+        else:
+            main_out = acts["bn2"]
+        res_out = acts["downsample_bn"] if "downsample_conv" in bp else x_in
+
+        # block output = relu(main + res); relevance passes the relu
+        R_main, R_res = _add_split(main_out, res_out, R, eps)
+        if is_bottleneck:
+            R_main = _conv_site(a2, bp["conv3"]["kernel"], _bn_bias(bp, "bn3"),
+                                1, R_main, conv_rule, eps)
+            R_main = _conv_site(a1, bp["conv2"]["kernel"], _bn_bias(bp, "bn2"),
+                                stride, R_main, conv_rule, eps)
+            R_main = _conv_site(x_in, bp["conv1"]["kernel"], _bn_bias(bp, "bn1"),
+                                1, R_main, conv_rule, eps)
+        else:
+            R_main = _conv_site(a1, bp["conv2"]["kernel"], _bn_bias(bp, "bn2"),
+                                1, R_main, conv_rule, eps)
+            R_main = _conv_site(x_in, bp["conv1"]["kernel"], _bn_bias(bp, "bn1"),
+                                stride, R_main, conv_rule, eps)
+        if "downsample_conv" in bp:
+            R_res = _conv_site(x_in, bp["downsample_conv"]["kernel"],
+                               _bn_bias(bp, "downsample_bn"),
+                               stride, R_res, conv_rule, eps)
+        return R_main + R_res
+
     for s in range(n_stages - 1, -1, -1):
-        for i in range(model.stage_sizes[s] - 1, -1, -1):
-            name = f"layer{s + 1}_{i}"
-            bp = params[name]
-            x_in = block_input(s, i)
-            stride = 2 if s > 0 and i == 0 else 1
+        size = model.stage_sizes[s]
+        if size > 1:
+            # blocks i >= 1 are homogeneous (stride 1, no downsample, same
+            # shapes), so their relevance steps run as ONE lax.scan — the
+            # block subgraph compiles once per stage instead of once per
+            # block, which is what made the first LRP call ~3x the compile
+            # cost of a plain fwd+bwd (BASELINE.md round-4 LRP section)
+            idxs = list(range(size - 1, 0, -1))  # reversed relevance order
 
-            # forward activations inside the block (recomputed cheaply from
-            # captured conv/bn outputs)
-            bn1 = out_of(name, "bn1")
-            a1 = jax.nn.relu(bn1)
-            bn2 = out_of(name, "bn2")
-            if is_bottleneck:
-                a2 = jax.nn.relu(bn2)
-                bn3 = out_of(name, "bn3")
-                main_out = bn3
-            else:
-                main_out = bn2
-            if "downsample_conv" in bp:
-                res_out = out_of(name, "downsample_bn")
-            else:
-                res_out = x_in
+            def stacked(fn):
+                return jnp.stack([fn(i) for i in idxs])
 
-            # block output = relu(main + res); relevance passes the relu
-            R_main, R_res = _add_split(main_out, res_out, R, eps)
+            names = [f"layer{s + 1}_{i}" for i in idxs]
+            acts_keys = ("bn1", "bn2", "bn3") if is_bottleneck else ("bn1", "bn2")
+            xs = {
+                "x_in": stacked(lambda i: blocks_out[(s, i - 1)]),
+                "acts": {k: stacked(lambda i: out_of(f"layer{s + 1}_{i}", k))
+                         for k in acts_keys},
+                "bp": jax.tree_util.tree_map(
+                    lambda *leaves: jnp.stack(leaves), *(params[n] for n in names)
+                ),
+            }
 
-            # main branch
-            if is_bottleneck:
-                R_main = _conv_site(a2, bp["conv3"]["kernel"], _bn_bias(bp, "bn3"),
-                                    1, R_main, conv_rule, eps)
-                R_main = _conv_site(a1, bp["conv2"]["kernel"], _bn_bias(bp, "bn2"),
-                                    stride, R_main, conv_rule, eps)
-                R_main = _conv_site(x_in, bp["conv1"]["kernel"], _bn_bias(bp, "bn1"),
-                                    1, R_main, conv_rule, eps)
-            else:
-                R_main = _conv_site(a1, bp["conv2"]["kernel"], _bn_bias(bp, "bn2"),
-                                    1, R_main, conv_rule, eps)
-                R_main = _conv_site(x_in, bp["conv1"]["kernel"], _bn_bias(bp, "bn1"),
-                                    stride, R_main, conv_rule, eps)
+            def body(R, t):
+                return _block_step(t["x_in"], t["bp"], t["acts"], 1, R), None
 
-            # shortcut branch
-            if "downsample_conv" in bp:
-                R_res = _conv_site(x_in, bp["downsample_conv"]["kernel"],
-                                   _bn_bias(bp, "downsample_bn"),
-                                   stride, R_res, conv_rule, eps)
-            R = R_main + R_res
+            R, _ = lax.scan(body, R, xs)
+        # first block of the stage: stride-2 entry (stages > 0) + downsample
+        name = f"layer{s + 1}_0"
+        acts = {k: out_of(name, k)
+                for k in (("bn1", "bn2", "bn3") if is_bottleneck else ("bn1", "bn2"))}
+        if "downsample_conv" in params[name]:
+            acts["downsample_bn"] = out_of(name, "downsample_bn")
+        R = _block_step(stage_input(s), params[name], acts,
+                        2 if s > 0 else 1, R)
 
     # ---- stem (7x7/2 conv = _conv_fwd's pad L//2 = 3 at stride 2) ----------
     R = _maxpool_route(stem_relu, R)
