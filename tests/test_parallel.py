@@ -524,3 +524,30 @@ def test_sharded_coeff_grads_per_2d_3d(ndim, shape):
         assert g.shape == w.shape
         assert len(g.sharding.device_set) == 8
         np.testing.assert_allclose(np.asarray(g), np.asarray(w), atol=1e-5)
+
+
+@pytest.mark.parametrize("extra", [
+    [],
+    ["--spmd"],
+    ["--long-context", "16384"],
+    ["--long-context", "16384", "--boundary", "symmetric"],
+])
+def test_sharded_attribution_example_runs(extra):
+    """The sharded-attribution example is the parallel API's front door;
+    run it end to end as a user would (its --virtual flag self-configures
+    the CPU mesh, so the subprocess needs no env surgery)."""
+    import os
+    import subprocess
+    import sys
+    from pathlib import Path
+
+    repo = Path(__file__).resolve().parent.parent
+    env = {k: v for k, v in os.environ.items() if k not in ("JAX_PLATFORMS", "XLA_FLAGS")}
+    out = subprocess.run(
+        [sys.executable, str(repo / "examples" / "sharded_attribution.py"),
+         "--virtual", "8", "--batch", "2", "--samples", "4", "--size", "32",
+         "--wavelet", "db2", "--levels", "2", *extra],
+        cwd=str(repo), env=env, capture_output=True, text=True, timeout=420,
+    )
+    assert out.returncode == 0, out.stdout[-2000:] + out.stderr[-2000:]
+    assert "sharded over 8 devices" in out.stdout, out.stdout[-1000:]
