@@ -18,3 +18,32 @@ if "xla_force_host_platform_device_count" not in _flags:
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+
+# -- shared test helpers ------------------------------------------------------
+
+
+def need_devices(n=8):
+    """Skip unless the (virtual) device count is at least n."""
+    import pytest
+
+    if len(jax.devices()) < n:
+        pytest.skip(f"needs {n} devices")
+
+
+def scan_gathers(hlo, gather_cap):
+    """Offending all-gathers (sync or async-start, tuple-typed or plain)
+    whose any result shape exceeds ``gather_cap`` elements — the shared
+    scanner behind the sharded-DWT HLO audits (a signal-sized all-gather
+    means sequence sharding silently degraded to replication)."""
+    import re
+
+    import numpy as np
+
+    offenders = []
+    for m in re.finditer(r"= (\([^)]*\)|\S+) all-gather(?:-start)?\(", hlo):
+        for shape in re.finditer(r"\[([\d,]*)\]", m.group(1)):
+            dims = [int(d) for d in shape.group(1).split(",") if d] or [1]
+            if int(np.prod(dims)) > gather_cap:
+                offenders.append(m.group(0)[:120])
+    return offenders

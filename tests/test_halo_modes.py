@@ -5,13 +5,12 @@ core+tail sharding contract, and an HLO audit proving the graph never
 all-gathers a signal-sized buffer (the naive GSPMD-constraint formulation
 does — that failure is what motivated the core+tail design)."""
 
-import re
-
 import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
 
+from conftest import need_devices, scan_gathers
 from wam_tpu.parallel import make_mesh
 from wam_tpu.parallel.halo_modes import (
     gather_coeffs,
@@ -22,9 +21,7 @@ from wam_tpu.parallel.halo_modes import (
 from wam_tpu.wavelets.transform import wavedec, wavedec2, wavedec3
 
 
-def _need_devices(n):
-    if len(jax.devices()) < n:
-        pytest.skip(f"needs {n} devices")
+_need_devices = need_devices
 
 
 @pytest.mark.parametrize("wavelet", ["haar", "db4", "sym3"])
@@ -124,16 +121,7 @@ def test_sharded_wavedec3_mode_matches_single_device(wavelet):
             np.testing.assert_allclose(np.asarray(g[k]), np.asarray(w[k]), atol=2e-5)
 
 
-def _scan_gathers(hlo, gather_cap):
-    """Offending all-gathers (sync or async-start, tuple-typed or plain)
-    whose any result shape exceeds ``gather_cap`` elements."""
-    offenders = []
-    for m in re.finditer(r"= (\([^)]*\)|\S+) all-gather(?:-start)?\(", hlo):
-        for shape in re.finditer(r"\[([\d,]*)\]", m.group(1)):
-            dims = [int(d) for d in shape.group(1).split(",") if d] or [1]
-            if int(np.prod(dims)) > gather_cap:
-                offenders.append(m.group(0)[:120])
-    return offenders
+_scan_gathers = scan_gathers  # shared scanner, tests/conftest.py
 
 
 def _audit_hlo(run, x, mesh, spec, gather_cap):

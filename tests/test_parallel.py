@@ -18,9 +18,7 @@ pytestmark = pytest.mark.slow
 
 
 
-def _need_devices(n=8):
-    if len(jax.devices()) < n:
-        pytest.skip(f"requires {n} virtual devices")
+from conftest import need_devices as _need_devices  # shared, tests/conftest.py
 
 
 def _linear_model(W):
@@ -333,6 +331,41 @@ def test_sharded_smoothgrad_spmd_exact_parity_unnormalized():
     mesh = make_mesh({"sample": 2, "data": 4})
     runner = sharded_smoothgrad_spmd(step_local, mesh, n_samples=4, stdev_spread=0.15)
     out_sharded = runner(x, y, key)
+
+    def step_full(noisy):
+        _, grads = eng.attribute(noisy, y)
+        return mosaic2d(grads, normalize=False)
+
+    out_single = smoothgrad(step_full, x, key, n_samples=4, stdev_spread=0.15)
+    np.testing.assert_allclose(np.asarray(out_sharded), np.asarray(out_single),
+                               atol=1e-5)
+
+
+@pytest.mark.parametrize("batch", [2, 3, 5])
+def test_sharded_smoothgrad_spmd_pad_and_mask_parity(batch):
+    """Batches NOT divisible by the data axis are padded by cyclic row
+    repetition and the pad rows sliced off — real rows must stay
+    bit-identical to the single-device materialized smoothgrad (round-5
+    fix for the shipped `--batch 2` crash on a data=4 mesh)."""
+    _need_devices(8)
+    from wam_tpu.parallel import sharded_smoothgrad_spmd
+
+    rng = np.random.default_rng(7)
+    W = jnp.asarray(rng.standard_normal((16 * 16, 5)), dtype=jnp.float32)
+    eng = WamEngine(_linear_model(W), ndim=2, wavelet="haar", level=2, mode="reflect")
+    x = jnp.asarray(rng.standard_normal((batch, 1, 16, 16)), dtype=jnp.float32)
+    y = jnp.arange(batch, dtype=jnp.int32) % 5
+    key = jax.random.PRNGKey(13)
+
+    def step_local(noisy, y_l, grad_scale):
+        _, grads = eng.attribute(noisy, y_l)
+        grads = jax.tree_util.tree_map(lambda g: g * grad_scale, grads)
+        return mosaic2d(grads, normalize=False)
+
+    mesh = make_mesh({"sample": 2, "data": 4})
+    runner = sharded_smoothgrad_spmd(step_local, mesh, n_samples=4, stdev_spread=0.15)
+    out_sharded = runner(x, y, key)
+    assert out_sharded.shape[0] == batch
 
     def step_full(noisy):
         _, grads = eng.attribute(noisy, y)

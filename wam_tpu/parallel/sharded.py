@@ -118,11 +118,11 @@ def sharded_smoothgrad_spmd(
       differ by the per-shard normalizer exactly as documented.
 
     Loss-mean rescale: the engine's diag-logit loss takes the MEAN over the
-    batch it sees, so a shard computing B/data_shards rows produces
-    gradients data_shards× larger than the full-batch run. The runner
-    passes ``grad_scale = 1/data_shards`` as the step's third argument; the
-    step must multiply its COEFFICIENT GRADIENTS by it before any
-    (scale-invariant) normalization:
+    batch it sees, so a shard computing local_b rows produces gradients
+    B/local_b× larger than the full-batch run. The runner passes
+    ``grad_scale = local_b/B`` (= 1/data_shards for a divisible batch) as
+    the step's third argument; the step must multiply its COEFFICIENT
+    GRADIENTS by it before any (scale-invariant) normalization:
 
         def step(noisy_local, y_local, grad_scale):
             _, grads = engine.attribute(noisy_local, y_local)
@@ -133,7 +133,15 @@ def sharded_smoothgrad_spmd(
     materialized `smoothgrad` (asserted in tests/test_parallel.py) and
     normalize=True differs only by the documented per-shard normalizer.
 
-    Requires n_samples % sample_shards == 0 and B % data_shards == 0.
+    Batch divisibility: B need NOT divide the data axis. A non-divisible
+    batch is padded up to the next multiple by cyclically repeating the
+    already-noised real rows, run sharded, and the pad rows sliced off the
+    result — the model is batch-diagonal (inference-mode BN), so the real
+    rows' gradients are untouched and normalize=False stays bit-identical.
+    With normalize=True the per-shard normalizer of a padding shard sees
+    the duplicated rows (same documented per-shard semantics).
+
+    Requires n_samples % sample_shards == 0.
     """
     n_sample_shards = mesh.shape[sample_axis]
     if n_samples % n_sample_shards:
@@ -142,19 +150,27 @@ def sharded_smoothgrad_spmd(
         )
 
     def run(x, y, key):
-        if x.shape[0] % mesh.shape[data_axis]:
-            raise ValueError(
-                f"batch {x.shape[0]} not divisible by "
-                f"{data_axis}={mesh.shape[data_axis]}"
-            )
+        n_data_shards = mesh.shape[data_axis]
+        batch = x.shape[0]
         sigma = noise_sigma(x, stdev_spread)
         sigma = sigma.reshape(sigma.shape + (1,) * (x.ndim - 1))
         # same draws as the materialized single-device path (same key →
         # same (n_samples, B, ...) normal tensor), then sharded as input
         noise = jax.random.normal(key, (n_samples,) + x.shape, dtype=x.dtype) * sigma
         noisy = x[None] + noise
+        y = jnp.asarray(y)
 
-        grad_scale = 1.0 / mesh.shape[data_axis]
+        pad = (-batch) % n_data_shards
+        if pad:
+            # cyclic repetition of the NOISED real rows: every shard sees
+            # genuine inputs (finite normalizers), duplicates are discarded
+            # below, and real rows are untouched (batch-diagonal model)
+            idx = jnp.arange(batch + pad) % batch
+            noisy = noisy[:, idx]
+            y = y[idx]
+
+        local_b = (batch + pad) // n_data_shards
+        grad_scale = local_b / batch
 
         @partial(
             shard_map,
@@ -169,7 +185,10 @@ def sharded_smoothgrad_spmd(
                 lambda a: lax.psum(a, sample_axis) / n_samples, sums
             )
 
-        return local(noisy, jnp.asarray(y))
+        out = local(noisy, y)
+        if pad:
+            out = jax.tree_util.tree_map(lambda a: a[:batch], out)
+        return out
 
     return jax.jit(run)
 
