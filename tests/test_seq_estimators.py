@@ -1,0 +1,316 @@
+"""Sequence-sharded estimator composition (round-5 verdict #2).
+
+The long-context gradient cores (`parallel.halo`, `parallel.halo_modes`)
+compose with SmoothGrad / IG and surface through the class API
+(`WaveletAttribution{1,2,3}D(mesh=, seq_axis=)`). Parity is asserted against
+the single-device estimators on the virtual 8-device mesh; the HLO audits
+mirror tests/test_halo_modes.py (no signal-sized all-gather in the sharded
+gradient step; the noise draw is shard-local — no all-gather at all).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from conftest import need_devices as _need_devices, scan_gathers as _scan_gathers
+from wam_tpu.parallel.mesh import make_mesh
+
+
+def _pool_model_2d(n_classes=5, channels=3, shape=(64, 32), seed=0):
+    """Sequence-partitionable toy vision model with NON-degenerate
+    gradients: per-class spatial templates contracted over (C, H, W) — the
+    contraction over the sharded row axis is an all-reduce, never a gather,
+    and ∂logit/∂x varies spatially so detail-coefficient gradients are
+    nonzero (a global-average-pool model's are ~0, which turns the
+    normalized mosaic into amplified float noise)."""
+    w = jax.random.normal(jax.random.PRNGKey(seed),
+                          (n_classes, channels) + shape)
+
+    def model(x):  # (B, C, H, W)
+        return jnp.einsum("bchw,kchw->bk", x, w)
+
+    return model
+
+
+def _pool_model_3d(n_classes=4, seed=0):
+    w = jax.random.normal(jax.random.PRNGKey(seed), (8, n_classes))
+
+    def model(x):  # (B, 1, D, H, W)
+        pooled = x[:, 0].mean(axis=(2, 3))  # (B, D)
+        feat = pooled.reshape(pooled.shape[0], 8, -1).mean(axis=-1)  # (B, 8)
+        return feat @ w
+
+    return model
+
+
+def _mel_model_1d(n_classes=4, n_mels=32, seed=0):
+    w = jax.random.normal(jax.random.PRNGKey(seed), (n_mels, n_classes))
+
+    def model(mel):  # (N, 1, T, n_mels)
+        return mel[:, 0].mean(axis=1) @ w  # pool time -> (N, n_mels) @ w
+
+    return model
+
+
+def _put_seq(x, mesh, ndim):
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    spec = [None] * x.ndim
+    spec[x.ndim - ndim] = "data"
+    return jax.device_put(x, NamedSharding(mesh, P(*spec)))
+
+
+# ---------------------------------------------------------------------------
+# class-level parity: one call, sequence-sharded, vs the single-device class
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def matmul_stft():
+    """The mesh path pins the matmul STFT (the partitionable form); pin it
+    globally so the single-device twin computes the same values."""
+    from wam_tpu.ops.melspec import get_stft_impl, set_stft_impl
+
+    prev = get_stft_impl()
+    set_stft_impl("matmul")
+    yield
+    set_stft_impl(prev)
+
+
+def test_wam1d_class_mesh_smooth_parity(matmul_stft):
+    _need_devices(8)
+    from wam_tpu.wam1d import WaveletAttribution1D
+
+    mesh = make_mesh({"data": 8})
+    model = _mel_model_1d()
+    kw = dict(wavelet="db2", J=2, mode="symmetric", n_fft=256, n_mels=32,
+              sample_rate=8000, n_samples=3, stdev_spread=0.05,
+              random_seed=7)
+    x = jax.random.normal(jax.random.PRNGKey(3), (2, 2048))
+    y = jnp.array([1, 2])
+
+    sharded = WaveletAttribution1D(model, mesh=mesh, **kw)
+    mel_s, coeff_s = sharded.smooth_wam(_put_seq(x, mesh, 1), y)
+
+    single = WaveletAttribution1D(model, stream_noise=True,
+                                  sample_batch_size=None, **kw)
+    mel_1, coeff_1 = single.smooth_wam(x, y)
+
+    np.testing.assert_allclose(np.asarray(mel_s), np.asarray(mel_1), atol=1e-5)
+    assert len(coeff_s) == len(coeff_1)
+    for g, w in zip(coeff_s, coeff_1):
+        assert g.shape == w.shape
+        assert len(g.sharding.device_set) == 8  # grads stay sharded
+        np.testing.assert_allclose(np.asarray(g), np.asarray(w), atol=1e-5)
+
+
+def test_wam1d_class_mesh_smooth_periodization(matmul_stft):
+    """mode='periodization' is a mesh-path extension (the single-device
+    class is expansive-modes only): parity vs a hand-built periodized
+    single-device smoothgrad twin with the same fold_in noise stream."""
+    _need_devices(8)
+    from wam_tpu.core.estimators import smoothgrad
+    from wam_tpu.ops.melspec import melspectrogram
+    from wam_tpu.wam1d import WaveletAttribution1D, normalize_waveforms
+    from wam_tpu.wavelets.periodized import wavedec_per, waverec_per
+
+    mesh = make_mesh({"data": 8})
+    model = _mel_model_1d()
+    x = jax.random.normal(jax.random.PRNGKey(3), (2, 2048))
+    y = jnp.array([1, 2])
+    kw = dict(wavelet="db2", J=2, mode="periodization", n_fft=256, n_mels=32,
+              sample_rate=8000, n_samples=3, stdev_spread=0.05, random_seed=7)
+
+    sharded = WaveletAttribution1D(model, mesh=mesh, **kw)
+    mel_s, coeff_s = sharded.smooth_wam(_put_seq(x, mesh, 1), y)
+
+    xn = normalize_waveforms(x)
+
+    def front(wave):
+        return melspectrogram(wave, sample_rate=8000, n_fft=256, n_mels=32,
+                              impl="matmul")[:, None]
+
+    def step(noisy):
+        coeffs = wavedec_per(noisy, "db2", 2)
+        tap0 = jnp.zeros(jax.eval_shape(
+            lambda c: front(waverec_per(c, "db2")), coeffs).shape)
+
+        def loss(cs, tap):
+            mel = front(waverec_per(cs, "db2")) + tap
+            out = model(mel)
+            return jnp.take_along_axis(out, y[:, None], axis=1)[:, 0].mean()
+
+        g_cs, g_tap = jax.grad(loss, argnums=(0, 1))(coeffs, tap0)
+        return g_cs, g_tap
+
+    want_cs, want_tap = smoothgrad(
+        step, xn, jax.random.PRNGKey(7), n_samples=3, stdev_spread=0.05,
+        materialize_noise=False)
+    np.testing.assert_allclose(np.asarray(mel_s), np.asarray(want_tap[:, 0]),
+                               atol=1e-5)
+    for g, w in zip(coeff_s, want_cs):
+        np.testing.assert_allclose(np.asarray(g), np.asarray(w), atol=1e-5)
+
+
+def test_wam1d_class_mesh_ig_parity(matmul_stft):
+    _need_devices(8)
+    from wam_tpu.wam1d import WaveletAttribution1D
+
+    mesh = make_mesh({"data": 8})
+    model = _mel_model_1d()
+    kw = dict(wavelet="haar", J=3, mode="symmetric", n_fft=256, n_mels=32,
+              sample_rate=8000, n_samples=4, method="integratedgrad")
+    x = jax.random.normal(jax.random.PRNGKey(5), (2, 2048))
+    y = jnp.array([0, 3])
+
+    sharded = WaveletAttribution1D(model, mesh=mesh, **kw)
+    mel_s, coeff_s = sharded(_put_seq(x, mesh, 1), y)
+    single = WaveletAttribution1D(model, sample_batch_size=None, **kw)
+    mel_1, coeff_1 = single(x, y)
+
+    np.testing.assert_allclose(np.asarray(mel_s), np.asarray(mel_1), atol=1e-5)
+    for g, w in zip(coeff_s, coeff_1):
+        np.testing.assert_allclose(np.asarray(g), np.asarray(w), atol=1e-5)
+
+
+def test_wam2d_class_mesh_smooth_parity():
+    _need_devices(8)
+    from wam_tpu.wam2d import WaveletAttribution2D
+
+    mesh = make_mesh({"data": 8})
+    model = _pool_model_2d()
+    kw = dict(wavelet="haar", J=2, mode="reflect", n_samples=3,
+              stdev_spread=0.1, random_seed=11)
+    x = jax.random.normal(jax.random.PRNGKey(2), (2, 3, 64, 32))
+    y = jnp.array([1, 4])
+
+    sharded = WaveletAttribution2D(model, mesh=mesh, **kw)
+    got = sharded.smooth_wam(_put_seq(x, mesh, 2), y)
+    single = WaveletAttribution2D(model, stream_noise=True,
+                                  sample_batch_size=None, **kw)
+    want = single.smooth_wam(x, y)
+
+    assert got.shape == want.shape
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-5)
+
+
+def test_wam2d_class_mesh_ig_parity():
+    _need_devices(8)
+    from wam_tpu.wam2d import WaveletAttribution2D
+
+    mesh = make_mesh({"data": 8})
+    model = _pool_model_2d()
+    kw = dict(wavelet="haar", J=2, mode="reflect", n_samples=4,
+              method="integratedgrad")
+    x = jax.random.normal(jax.random.PRNGKey(9), (2, 3, 64, 32))
+    y = jnp.array([0, 2])
+
+    sharded = WaveletAttribution2D(model, mesh=mesh, **kw)
+    got = sharded(_put_seq(x, mesh, 2), y)
+    single = WaveletAttribution2D(model, sample_batch_size=None, **kw)
+    want = single(x, y)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-5)
+
+
+def test_wam2d_class_mesh_ig_single_step_parity():
+    """n_samples=1 IG: the lone path point is both trapezoid endpoints
+    (weight 1.0, not 0.5) — regression for the round-5 review finding."""
+    _need_devices(8)
+    from wam_tpu.wam2d import WaveletAttribution2D
+
+    mesh = make_mesh({"data": 8})
+    model = _pool_model_2d()
+    kw = dict(wavelet="haar", J=2, mode="reflect", n_samples=1,
+              method="integratedgrad")
+    x = jax.random.normal(jax.random.PRNGKey(9), (2, 3, 64, 32))
+    y = jnp.array([0, 2])
+
+    got = WaveletAttribution2D(model, mesh=mesh, **kw)(_put_seq(x, mesh, 2), y)
+    want = WaveletAttribution2D(model, sample_batch_size=None, **kw)(x, y)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-5)
+
+
+@pytest.mark.parametrize("label", [True, False])
+def test_wam3d_class_mesh_smooth_parity(label):
+    """Depth-sharded 3D SmoothGrad, labelled and representation (y=None)
+    modes, vs the single-device class."""
+    _need_devices(8)
+    from wam_tpu.wam3d import WaveletAttribution3D
+
+    mesh = make_mesh({"data": 8})
+    model = _pool_model_3d()
+    kw = dict(wavelet="haar", J=1, mode="symmetric", n_samples=3,
+              stdev_spread=0.05, random_seed=13)
+    x = jax.random.normal(jax.random.PRNGKey(4), (2, 1, 32, 8, 8))
+    y = jnp.array([1, 3]) if label else None
+
+    sharded = WaveletAttribution3D(model, mesh=mesh, **kw)
+    got = sharded.smooth(_put_seq(x, mesh, 3), y)
+    single = WaveletAttribution3D(model, stream_noise=True,
+                                  sample_batch_size=None, **kw)
+    want = single.smooth(x, y)
+    assert got.shape == want.shape
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-5)
+
+
+def test_wam2d_class_mesh_rejects_unsupported():
+    from wam_tpu.wam2d import WaveletAttribution2D
+
+    mesh = make_mesh({"data": len(jax.devices())})
+    with pytest.raises(ValueError, match="model_layout"):
+        WaveletAttribution2D(_pool_model_2d(), mesh=mesh, model_layout="nhwc")
+    with pytest.raises(ValueError, match="dwt_bf16"):
+        WaveletAttribution2D(_pool_model_2d(), mesh=mesh, dwt_bf16=True)
+
+
+# ---------------------------------------------------------------------------
+# HLO audits: gather-free gradient step, shard-local noise
+# ---------------------------------------------------------------------------
+
+
+def test_seq_sharded_grads_hlo_no_signal_sized_gather():
+    """The estimator's per-sample gradient step (reconstruct → model → VJP)
+    moves only O(L)-sized buffers: ring halos ride collective-permute, and
+    no all-gather approaches signal size (mirror of
+    test_sharded_coeff_grads_mode_hlo_no_signal_sized_gather, but through
+    the estimator class)."""
+    _need_devices(8)
+    from wam_tpu.models.audio import toy_wave_model
+    from wam_tpu.parallel.seq_estimators import SeqShardedWam
+
+    mesh = make_mesh({"data": 8})
+    sw = SeqShardedWam(mesh, toy_wave_model(jax.random.PRNGKey(0)), ndim=1,
+                       wavelet="db4", level=3, mode="symmetric")
+    x = _put_seq(jnp.zeros((2, 1 << 14)), mesh, 1)
+    y = jnp.array([0, 1])
+    coeffs = sw.dec(x)
+    hlo = sw._grads.lower(coeffs, y, spatial=(1 << 14,)).compile().as_text()
+    assert " collective-permute(" in hlo
+    offenders = _scan_gathers(hlo, gather_cap=512)
+    assert not offenders, f"signal-sized all-gather(s) in seq grads: {offenders}"
+
+
+def test_seq_sharded_noise_is_shard_local():
+    """The SmoothGrad draw must generate each shard's noise locally:
+    partitionable threefry + the output sharding constraint mean the
+    compiled noise graph contains NO all-gather at any size (the σ min/max
+    reduction is an all-reduce, which is allowed)."""
+    _need_devices(8)
+    from wam_tpu.models.audio import toy_wave_model
+    from wam_tpu.parallel.seq_estimators import SeqShardedWam
+
+    assert jax.config.jax_threefry_partitionable, (
+        "shard-local noise relies on partitionable threefry"
+    )
+    mesh = make_mesh({"data": 8})
+    sw = SeqShardedWam(mesh, toy_wave_model(jax.random.PRNGKey(0)), ndim=1,
+                       wavelet="db4", level=3, mode="symmetric")
+    x = _put_seq(jnp.zeros((2, 1 << 14)), mesh, 1)
+    hlo = sw._noisy.lower(
+        x, jax.random.PRNGKey(0), jnp.int32(0), jnp.float32(0.1)
+    ).compile().as_text()
+    assert "all-gather" not in hlo, "noise draw must be shard-local"
+    # the noisy output keeps the sequence sharding
+    noisy = sw._noisy(x, jax.random.PRNGKey(0), jnp.int32(0), jnp.float32(0.1))
+    assert len(noisy.sharding.device_set) == 8

@@ -108,10 +108,14 @@ def mel_filterbank(n_freqs: int, n_mels: int, sample_rate: int, f_min: float = 0
     return fb.astype(np.float32)
 
 
-def stft_power(x: jax.Array, n_fft: int = 1024, hop: int | None = None, center: bool = True) -> jax.Array:
+def stft_power(x: jax.Array, n_fft: int = 1024, hop: int | None = None, center: bool = True, impl: str | None = None) -> jax.Array:
     """Power spectrogram |STFT|² with a Hann window.
 
     x: (..., L) → (..., n_frames, n_fft//2 + 1). Differentiable.
+    ``impl`` overrides the global `set_stft_impl` selection for this call
+    ("matmul" | "fft"); the sequence-sharded estimators force "matmul" — the
+    DFT-as-matmul form is GSPMD-partitionable, while the fft path is not
+    (and trips an XLA CPU fft-thunk layout check on sharded operands).
     """
     hop = n_fft // 2 if hop is None else hop
     if center:
@@ -134,7 +138,13 @@ def stft_power(x: jax.Array, n_fft: int = 1024, hop: int | None = None, center: 
     else:
         idx = np.arange(n_frames)[:, None] * hop + np.arange(n_fft)[None, :]
         frames = x[..., idx]  # (..., n_frames, n_fft)
-    if _use_matmul_stft(n_fft):
+    if impl is not None and impl not in _STFT_IMPLS:
+        raise ValueError(f"impl {impl!r} not one of {_STFT_IMPLS}")
+    if impl is None or impl == "auto":
+        use_matmul = _use_matmul_stft(n_fft)
+    else:
+        use_matmul = impl == "matmul"
+    if use_matmul:
         # windowed real-DFT as two MXU matmuls; Precision.HIGH (bf16_3x
         # passes) holds the mel-dB error at the f32 summation floor while
         # measuring ~10% faster than HIGHEST end to end (BASELINE.md r4)
@@ -159,13 +169,15 @@ def melspectrogram(
     n_mels: int = 128,
     hop: int | None = None,
     to_db: bool = True,
+    impl: str | None = None,
 ) -> jax.Array:
     """Batch melspectrogram: (..., L) → (..., n_frames, n_mels).
 
     Matches the reference's per-waveform layout after its transpose
-    (`lib/wam_1D.py:216`: time-major, mel channels last).
+    (`lib/wam_1D.py:216`: time-major, mel channels last). ``impl`` is the
+    per-call STFT backend override (see `stft_power`).
     """
-    p = stft_power(x, n_fft=n_fft, hop=hop)
+    p = stft_power(x, n_fft=n_fft, hop=hop, impl=impl)
     fb = jnp.asarray(mel_filterbank(n_fft // 2 + 1, n_mels, sample_rate), dtype=x.dtype)
     mel = p @ fb  # (..., n_frames, n_mels)
     return amplitude_to_db(mel) if to_db else mel

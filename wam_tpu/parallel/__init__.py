@@ -21,6 +21,7 @@ from wam_tpu.parallel.halo_modes import (
     sharded_waverec_mode,
 )
 from wam_tpu.parallel.mesh import P, data_sample_mesh, make_mesh
+from wam_tpu.parallel.seq_estimators import SeqShardedWam, seq_sharded_wam
 from wam_tpu.parallel.multihost import hybrid_mesh, init_distributed, process_local_batch
 from wam_tpu.parallel.sharded import sharded_integrated_path, sharded_smoothgrad, sharded_smoothgrad_spmd
 
@@ -52,4 +53,6 @@ __all__ = [
     "sharded_waverec2_mode",
     "sharded_waverec3_mode",
     "sharded_coeff_grads_mode",
+    "SeqShardedWam",
+    "seq_sharded_wam",
 ]

@@ -151,7 +151,21 @@ class BaseWAM1D:
 
 
 class WaveletAttribution1D(BaseWAM1D):
-    """SmoothGrad / IG WAM-1D (`lib/wam_1D.py:249-435`), one jit graph."""
+    """SmoothGrad / IG WAM-1D (`lib/wam_1D.py:249-435`), one jit graph.
+
+    Long-context mode: pass ``mesh=`` (and optionally ``seq_axis=``) to run
+    the WHOLE estimator sequence-sharded — wavedec, waverec, model, grads,
+    and the SmoothGrad/IG loops all operate on waveforms whose sample axis
+    is sharded over the mesh, so no device ever holds the full signal
+    (reference ceiling removed: `lib/wam_1D.py:88-150` back-props through a
+    whole in-memory waveform). The model (and the built-in melspec front)
+    must be XLA-partitionable over time for the sharding to survive into the
+    model; the DWT/IDWT stages are gather-free by construction
+    (`parallel.seq_estimators`, audited like tests/test_halo_modes.py).
+    SmoothGrad noise is drawn shard-local with the same fold_in key stream
+    as ``stream_noise=True`` — per-sample results are bit-identical to the
+    single-device estimator; sample means differ only by summation order.
+    """
 
     def __init__(
         self,
@@ -169,6 +183,8 @@ class WaveletAttribution1D(BaseWAM1D):
         random_seed: int = 42,
         sample_batch_size: int | None | str = "auto",
         stream_noise: bool = False,
+        mesh=None,
+        seq_axis: str = "data",
     ):
         super().__init__(
             model_fn,
@@ -203,6 +219,32 @@ class WaveletAttribution1D(BaseWAM1D):
         # surface, SURVEY.md §5.6).
         self._jit_smooth = jax.jit(self._smooth_impl)
         self._jit_ig = jax.jit(self._ig_impl)
+        self.mesh = mesh
+        self.seq_axis = seq_axis
+        if mesh is not None:
+            from wam_tpu.parallel.seq_estimators import SeqShardedWam
+
+            # the mesh path pins the matmul STFT: the DFT-as-matmul form is
+            # GSPMD-partitionable over time (it is also the TPU default),
+            # while the fft path is not shardable and trips an XLA CPU
+            # fft-thunk layout check on sharded operands
+            def seq_front(wave):
+                mel = melspectrogram(wave, sample_rate=sample_rate,
+                                     n_fft=n_fft, n_mels=n_mels, impl="matmul")
+                return mel[:, None, :, :]
+
+            self._seq_front = seq_front
+            self._seq = SeqShardedWam(
+                mesh,
+                self.engine.model_fn,
+                ndim=1,
+                wavelet=wavelet,
+                level=J,
+                mode=mode,
+                seq_axis=seq_axis,
+                front_fn=seq_front,
+                front_grads=True,
+            )
 
     def _resolve_chunk(self, batch: int) -> int | None:
         return resolve_sample_chunk(self.sample_batch_size, batch, self.n_samples)
@@ -241,7 +283,14 @@ class WaveletAttribution1D(BaseWAM1D):
         x = normalize_waveforms(x)
         y = jnp.asarray(y)
         key = jax.random.PRNGKey(self.random_seed)
-        mel_avg, grad_avg = self._jit_smooth(x, y, key)
+        if self.mesh is not None:
+            grad_avg, mel_tap = self._seq.smoothgrad(
+                x, y, key, n_samples=self.n_samples,
+                stdev_spread=self.stdev_spread,
+            )
+            mel_avg = mel_tap[:, 0, :, :]
+        else:
+            mel_avg, grad_avg = self._jit_smooth(x, y, key)
         self.melspecs = mel_avg
         self.grad_coeffs = grad_avg
         return mel_avg, grad_avg
@@ -266,7 +315,15 @@ class WaveletAttribution1D(BaseWAM1D):
         ∫ mel-grads, coeffs × ∫ coeff-grads (`lib/wam_1D.py:353-421`)."""
         x = normalize_waveforms(x)
         y = jnp.asarray(y)
-        mel_attr, coeff_attr = self._jit_ig(x, y)
+        if self.mesh is not None:
+            coeffs, (coeff_integ, mel_integ) = self._seq.integrated(
+                x, y, n_steps=self.n_samples
+            )
+            baseline_mel = self._seq_front(x)[:, 0]
+            mel_attr = baseline_mel * mel_integ[:, 0, :, :]
+            coeff_attr = [c * g for c, g in zip(coeffs, coeff_integ)]
+        else:
+            mel_attr, coeff_attr = self._jit_ig(x, y)
         self.melspecs = mel_attr
         self.grad_coeffs = coeff_attr
         return mel_attr, coeff_attr

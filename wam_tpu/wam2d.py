@@ -148,6 +148,8 @@ class WaveletAttribution2D(BaseWAM2D):
         dwt_bf16: bool = False,
         stream_noise: bool | str = "auto",
         model_layout: str = "nchw",
+        mesh=None,
+        seq_axis: str = "data",
     ):
         super().__init__(
             model_fn,
@@ -158,6 +160,30 @@ class WaveletAttribution2D(BaseWAM2D):
             normalize_coeffs=normalize_coeffs,
             model_layout=model_layout,
         )
+        # Long-context mode: mesh= shards the image ROW axis over seq_axis
+        # end to end (decompose → model → grads → per-sample mosaic); see
+        # parallel.seq_estimators. NCHW-layout, f32-DWT only — the sharded
+        # analysis always accumulates f32 and the layout seam sits outside
+        # the sharded core.
+        if mesh is not None:
+            if model_layout != "nchw":
+                raise ValueError("mesh= requires model_layout='nchw'")
+            if dwt_bf16:
+                raise ValueError("mesh= does not support dwt_bf16")
+            from wam_tpu.parallel.seq_estimators import SeqShardedWam
+
+            self._seq = SeqShardedWam(
+                mesh,
+                model_fn,
+                ndim=2,
+                wavelet=wavelet,
+                level=J,
+                mode=mode,
+                seq_axis=seq_axis,
+                post_fn=lambda g: mosaic2d(g, normalize_coeffs, 1),
+            )
+        self.mesh = mesh
+        self.seq_axis = seq_axis
         if method not in ("smooth", "integratedgrad"):
             raise ValueError(f"Unknown method {method!r}")
         validate_sample_batch_size(sample_batch_size)
@@ -222,7 +248,13 @@ class WaveletAttribution2D(BaseWAM2D):
 
     def smooth_wam(self, x, y):
         key = jax.random.PRNGKey(self.random_seed)
-        avg = self._jit_smooth(jnp.asarray(x), jnp.asarray(y), key)
+        if self.mesh is not None:
+            avg = self._seq.smoothgrad(
+                jnp.asarray(x), jnp.asarray(y), key,
+                n_samples=self.n_samples, stdev_spread=self.stdev_spread,
+            )
+        else:
+            avg = self._jit_smooth(jnp.asarray(x), jnp.asarray(y), key)
         self.scales = reproject_mosaic(avg, self.J, self.approx_coeffs)
         return avg
 
@@ -249,7 +281,14 @@ class WaveletAttribution2D(BaseWAM2D):
         return baseline * integral
 
     def integrated_wam(self, x, y):
-        attr = self._jit_ig(jnp.asarray(x), jnp.asarray(y))
+        if self.mesh is not None:
+            coeffs, integral = self._seq.integrated(
+                jnp.asarray(x), jnp.asarray(y), n_steps=self.n_samples
+            )
+            baseline = mosaic2d(coeffs, normalize=True, channel_axis=1)
+            attr = baseline * integral
+        else:
+            attr = self._jit_ig(jnp.asarray(x), jnp.asarray(y))
         self.scales = reproject_mosaic(attr, self.J, self.approx_coeffs)
         return attr
 

@@ -205,6 +205,8 @@ class WaveletAttribution3D(BaseWAM3D):
         random_seed: int = 42,
         sample_batch_size: int | None | str = "auto",
         stream_noise: bool = False,
+        mesh=None,
+        seq_axis: str = "data",
     ):
         super().__init__(
             model_fn,
@@ -216,6 +218,25 @@ class WaveletAttribution3D(BaseWAM3D):
             normalize=normalize,
             EPS=EPS,
         )
+        # Long-context mode: mesh= shards the volume DEPTH axis over
+        # seq_axis end to end (parallel.seq_estimators); voxels only.
+        if mesh is not None and instance != "voxels":
+            raise ValueError("mesh= supports instance='voxels' only")
+        if mesh is not None:
+            from wam_tpu.parallel.seq_estimators import SeqShardedWam
+
+            self._seq = SeqShardedWam(
+                mesh,
+                lambda rec: model_fn(rec[:, None]),
+                ndim=3,
+                wavelet=wavelet,
+                level=J,
+                mode=mode,
+                seq_axis=seq_axis,
+                post_fn=cube3d,
+            )
+        self.mesh = mesh
+        self.seq_axis = seq_axis
         if method not in ("smooth", "integratedgrad"):
             raise ValueError(f"Unknown method {method!r}")
         validate_sample_batch_size(sample_batch_size)
@@ -274,7 +295,13 @@ class WaveletAttribution3D(BaseWAM3D):
         self.input_size = x.shape[-1]
         vol = x[:, 0]
         key = jax.random.PRNGKey(self.random_seed)
-        if y is None:
+        if self.mesh is not None:
+            y_arr = None if y is None else jnp.asarray(y)
+            self.grads = self._seq.smoothgrad(
+                vol, y_arr, key, n_samples=self.n_samples,
+                stdev_spread=self.stdev_spread,
+            )
+        elif y is None:
             self.grads = self._jit_smooth(False)(vol, key)
         else:
             self.grads = self._jit_smooth(True)(vol, jnp.asarray(y), key)
@@ -308,7 +335,13 @@ class WaveletAttribution3D(BaseWAM3D):
         x = jnp.asarray(x)
         self.input_size = x.shape[-1]
         vol = x[:, 0]
-        if y is None:
+        if self.mesh is not None:
+            y_arr = None if y is None else jnp.asarray(y)
+            coeffs, integral = self._seq.integrated(
+                vol, y_arr, n_steps=self.n_samples
+            )
+            self.grads = cube3d(coeffs) * integral
+        elif y is None:
             self.grads = self._jit_ig(False)(vol)
         else:
             self.grads = self._jit_ig(True)(vol, jnp.asarray(y))
