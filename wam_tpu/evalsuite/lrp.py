@@ -269,7 +269,12 @@ def _lrp_resnet_body(model, variables, x, y, *, eps, composite, nchw):
             # shapes), so their relevance steps run as ONE lax.scan — the
             # block subgraph compiles once per stage instead of once per
             # block, which is what made the first LRP call ~3x the compile
-            # cost of a plain fwd+bwd (BASELINE.md round-4 LRP section)
+            # cost of a plain fwd+bwd (BASELINE.md round-4 LRP section).
+            # Tradeoff: jnp.stack copies every block's captured activations
+            # and folded params while the originals stay live, roughly
+            # doubling peak trace-time memory per stage — acceptable for the
+            # compile-time win; on ResNet-101-scale stages with large inputs
+            # consider deleting blocks_out entries after stacking.
             idxs = list(range(size - 1, 0, -1))  # reversed relevance order
 
             def stacked(fn):

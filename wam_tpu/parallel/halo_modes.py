@@ -41,6 +41,7 @@ single hop and shard 0's local extension only consults its own samples.
 
 from __future__ import annotations
 
+import functools
 from functools import partial
 from typing import NamedTuple
 
@@ -481,6 +482,28 @@ def _level_inv_1d(coreA, tailA, coreD, tailD, synth_run, wav, repl_sh=None):
     return core_out, tail_out
 
 
+def _check_tails(coeffs, wav: Wavelet, axis: int, producer: str):
+    """Eager mirror of the `_level_inv_1d` trace-time invariant, shared by
+    the waverec run() wrappers (round-4 advisor): the last shard's synthesis
+    halo comes from the tail, so every leaf's tail must hold at least
+    (L-1)//2 coefficients along ``axis`` (``producer``'s tails always do)."""
+    h_min = (wav.filt_len - 1) // 2
+    for c in coeffs:
+        if isinstance(c, TailedLeaf):
+            pieces = [c]
+        elif isinstance(c, dict):
+            pieces = list(c.values())
+        else:
+            pieces = list(c)
+        for piece in pieces:
+            if piece.tail.shape[axis] < h_min:
+                raise ValueError(
+                    f"coefficient tail length {piece.tail.shape[axis]} < "
+                    f"{h_min}: the last shard's synthesis halo must come "
+                    f"from the tail; feed leaves produced by {producer}"
+                )
+
+
 def _build_synth_run(mesh: Mesh, wav: Wavelet, seq_axis: str):
     return shard_map(
         partial(_synth_core_local, wav=wav, seq_axis=seq_axis),
@@ -536,6 +559,7 @@ def sharded_waverec_mode(mesh: Mesh, wavelet, seq_axis: str = "data"):
                     f"shards={k}: these leaves were not produced by "
                     f"sharded_wavedec_mode on this mesh"
                 )
+        _check_tails(coeffs, wav, -1, "sharded_wavedec_mode")
         return apply(coeffs)
 
     run._apply = apply  # jitted body, exposed for HLO audits (tests)
@@ -641,6 +665,12 @@ def sharded_waverec2_mode(mesh: Mesh, wavelet, seq_axis: str = "data"):
     repl = NamedSharding(mesh, P(None, None, None))
     repl2 = NamedSharding(mesh, P(None, None))
     k = mesh.shape[seq_axis]
+    # local-synthesis wrappers memoized by their static per-level target
+    # shape — built once per (shape) instead of on every trace of every
+    # level (round-4 advisor), mirroring how synth_run is built once
+    get_w_run = functools.lru_cache(maxsize=None)(
+        lambda target: _build_local_synthesis(mesh, wav, seq_axis, 1, target)
+    )
 
     @jax.jit
     def apply(coeffs):
@@ -668,8 +698,7 @@ def sharded_waverec2_mode(mesh: Mesh, wavelet, seq_axis: str = "data"):
             aa_t, ad_t = tt[:b], tt[b:]
             # W axis second (local): stack the two W-subbands and synthesize
             w_target = 2 * wt - L + 2
-            w_run = _build_local_synthesis(mesh, wav, seq_axis, 1, (w_target,))
-            core = w_run(jnp.stack([aa_c, ad_c], axis=-2))
+            core = get_w_run((w_target,))(jnp.stack([aa_c, ad_c], axis=-2))
             t_in = lax.with_sharding_constraint(
                 jnp.stack([aa_t, ad_t], axis=-2),
                 NamedSharding(mesh, P(None, None, None, None)),
@@ -694,6 +723,7 @@ def sharded_waverec2_mode(mesh: Mesh, wavelet, seq_axis: str = "data"):
                         f"shards={k}: these leaves were not produced by "
                         f"sharded_wavedec2_mode on this mesh"
                     )
+        _check_tails(coeffs, wav, -2, "sharded_wavedec2_mode")
         return apply(coeffs)
 
     run._apply = apply  # jitted body, exposed for HLO audits (tests)
@@ -709,6 +739,10 @@ def sharded_waverec3_mode(mesh: Mesh, wavelet, seq_axis: str = "data"):
     repl = NamedSharding(mesh, P(None, None, None, None))
     repl2 = NamedSharding(mesh, P(None, None))
     k = mesh.shape[seq_axis]
+    # memoized like sharded_waverec2_mode's get_w_run (round-4 advisor)
+    get_hw_run = functools.lru_cache(maxsize=None)(
+        lambda target: _build_local_synthesis(mesh, wav, seq_axis, 2, target)
+    )
 
     @jax.jit
     def apply(coeffs):
@@ -736,8 +770,7 @@ def sharded_waverec3_mode(mesh: Mesh, wavelet, seq_axis: str = "data"):
                   for i, kk in enumerate(order)}
             # H and W axes second (local): fused 4-channel 2D synthesis
             target = (2 * ht - L + 2, 2 * wt - L + 2)
-            hw_run = _build_local_synthesis(mesh, wav, seq_axis, 2, target)
-            core = hw_run(jnp.stack([hw[kk][0] for kk in order], axis=-3))
+            core = get_hw_run(target)(jnp.stack([hw[kk][0] for kk in order], axis=-3))
             t_in = lax.with_sharding_constraint(
                 jnp.stack([hw[kk][1] for kk in order], axis=-3),
                 NamedSharding(mesh, P(None, None, None, None, None)),
@@ -763,6 +796,7 @@ def sharded_waverec3_mode(mesh: Mesh, wavelet, seq_axis: str = "data"):
                         f"shards={k}: these leaves were not produced by "
                         "sharded_wavedec3_mode on this mesh"
                     )
+        _check_tails(coeffs, wav, -3, "sharded_wavedec3_mode")
         return apply(coeffs)
 
     run._apply = apply  # jitted body, exposed for HLO audits (tests)
