@@ -116,8 +116,16 @@ def main():
 
         writer = JsonlWriter(args.out)
 
-    def record(name, n_items, sampled, unit="items/s"):
-        from wam_tpu.profiling import median_iqr
+    # Sub-100 ms steps on the tunneled TPU are wall-bimodal ACROSS processes
+    # even with tight within-run IQRs (round-4 wam2d_base ledger:
+    # 22.5/91.5/96.5/26.4 items/s on identical code, one −72.6% false
+    # "significant" flag). For those rows a device-time (xplane) median is
+    # recorded alongside wall, and the regression verdict compares DEVICE
+    # quartiles — the chip, not the tunnel.
+    _DEVICE_TIME_BELOW_S = 0.120
+
+    def record(name, n_items, sampled, unit="items/s", run=None):
+        from wam_tpu.profiling import device_time_samples, median_iqr
 
         samples, used_laps = sampled
         med, q1, q3, iqr = median_iqr(samples)
@@ -136,17 +144,52 @@ def main():
             "platform": platform,
             "dtype": "float32" if args.f32 else "bfloat16",
         }
+        if run is not None and on_accel and med < _DEVICE_TIME_BELOW_S:
+            # laps need not match the wall protocol: device busy time has no
+            # RTT share, so a few laps suffice and keep the capture small
+            dev = device_time_samples(run, k=min(k, 5),
+                                      laps=min(used_laps, 8))
+            if dev:
+                dmed, dq1, dq3, diqr = median_iqr(dev)
+                rec["device_seconds"] = round(dmed, 5)
+                rec["device_value"] = round(n_items / dmed, 3)
+                rec["device_value_q1"] = round(n_items / dq3, 3)
+                rec["device_value_q3"] = round(n_items / dq1, 3)
+                rec["device_iqr_pct"] = round(100.0 * diqr / dmed, 2)
         old = prev.get((name, rec["platform"], rec["dtype"]))
         if old and "value" in old:
             rec["prev_value"] = old["value"]
             rec["delta_pct"] = round(100.0 * (rec["value"] - old["value"])
                                      / old["value"], 2)
+            if "device_value" in old and "device_value" in rec:
+                rec["device_delta_pct"] = round(
+                    100.0 * (rec["device_value"] - old["device_value"])
+                    / old["device_value"], 2)
+                # tunnel-immune verdict: device-quartile non-overlap AND a
+                # material delta — device IQRs are ~0.01%, so pure interval
+                # non-overlap would flag 0.03% run-to-run drift (observed
+                # on identical code in the round-5 shakedown)
+                rec["significant"] = bool(
+                    (rec["device_value_q1"] > old["device_value_q3"]
+                     or rec["device_value_q3"] < old["device_value_q1"])
+                    and abs(rec["device_delta_pct"]) >= 1.0
+                )
+                print(json.dumps(rec), flush=True)
+                if writer is not None:
+                    writer.write(rec)
+                return
             old_laps = old.get("laps")
             comparable_laps = (
                 old_laps is not None
                 and max(used_laps, old_laps) <= 2 * min(used_laps, old_laps)
             )
-            if "value_q1" in old and "value_q3" in old and comparable_laps:
+            if ("device_value" in rec) != ("device_value" in old):
+                # device timing on only ONE side (first device-timed run
+                # against a wall-only ledger row, or a transiently failed
+                # capture against a device-timed row): the wall comparison
+                # is exactly the bimodal trap — leave the verdict open
+                rec["significant"] = None
+            elif "value_q1" in old and "value_q3" in old and comparable_laps:
                 # significant = the [q1, q3] throughput intervals don't overlap
                 rec["significant"] = bool(
                     rec["value_q1"] > old["value_q3"]
@@ -184,8 +227,9 @@ def main():
     base = BaseWAM2D(fn50, wavelet="haar", J=3, mode="reflect")
     x1 = jax.random.normal(jax.random.PRNGKey(1), (1, 3, image, image), jnp.float32)
     y1 = jnp.zeros((1,), jnp.int32)
+    base_run = lambda: base(x1, y1)
     record("wam2d_base_resnet50_single_haar_J3", 1,
-           _sampled(lambda: base(x1, y1), k=k, laps=laps))
+           _sampled(base_run, k=k, laps=laps), run=base_run)
 
     # 2. flagship SmoothGrad ---------------------------------------------------
     batch, n = (4, 3) if q else (32, 25)
@@ -203,8 +247,9 @@ def main():
     )
     x2 = jax.random.normal(jax.random.PRNGKey(2), (batch, 3, image, image), jnp.float32)
     y2 = jnp.arange(batch, dtype=jnp.int32) % 1000
+    run2 = lambda: ex2(x2, y2)
     record(f"wam2d_smoothgrad_resnet50_b{batch}_db4_n{n}", batch,
-           _sampled(lambda: ex2(x2, y2), k=k, laps=laps), "images/s")
+           _sampled(run2, k=k, laps=laps), "images/s", run=run2)
 
     # 2b. flagship via the channel-last engine (round-4): same workload,
     # model bound NHWC (bind_inference(nchw=False)) + model_layout="nhwc" —
@@ -218,8 +263,9 @@ def main():
         dwt_bf16=on_accel and not args.f32, model_layout="nhwc",
         **({} if on_accel else {"sample_batch_size": 1, "stream_noise": False}),
     )
+    run2b = lambda: ex2b(x2, y2)
     record(f"wam2d_smoothgrad_nhwc_resnet50_b{batch}_db4_n{n}", batch,
-           _sampled(lambda: ex2b(x2, y2), k=k, laps=laps), "images/s")
+           _sampled(run2b, k=k, laps=laps), "images/s", run=run2b)
 
     # Workloads 3-5 are built by bench_workloads.py — the SAME builders the
     # chunk-sweep tuner uses, so tuning always measures this exact config.
@@ -241,16 +287,18 @@ def main():
     # artifact (77.2 wf/s at chunk 16 vs 62-67 full-vmap)
     ex3, x3, y3 = audio_workload("auto" if on_accel else 1, b=ab, n=an,
                                  wave_len=wave_len, compute_dtype=dtype)
+    run3 = lambda: ex3(x3, y3)
     record(f"wam1d_smoothgrad_audiocnn_b{ab}_db6_J5_n{an}", ab,
-           _sampled(lambda: ex3(x3, y3), k=k, laps=laps), "waveforms/s")
+           _sampled(run3, k=k, laps=laps), "waveforms/s", run=run3)
 
     # 4. 3D SmoothGrad ("auto" chunking since round 4: the 128-row law
     # measured 109.8 vol/s at chunk 16 vs 90.3 full vmap) ----------------------
     size = 16 if q else 32
     vb, vn = (2, 3) if q else (8, 25)
     ex4, x4, y4 = vol_workload("auto" if on_accel else 1, b=vb, n=vn, size=size)
+    run4 = lambda: ex4(x4, y4)
     record(f"wam3d_smoothgrad_resnet3d18_b{vb}_{size}cube_haar_J2_n{vn}", vb,
-           _sampled(lambda: ex4(x4, y4), k=k, laps=laps), "volumes/s")
+           _sampled(run4, k=k, laps=laps), "volumes/s", run=run4)
 
     # 5. ViT IG path (chunk 16 marginally fastest, round-3 sweep) --------------
     steps = 4 if q else 64
@@ -258,8 +306,9 @@ def main():
         (16 if on_accel else 1) if not q else steps,
         steps=steps, image=image, compute_dtype=dtype,
     )
+    run5 = lambda: ex5(x5, y5)
     record(f"wam2d_ig_vitb16_path{steps}", 1,
-           _sampled(lambda: ex5(x5, y5), k=k, laps=laps))
+           _sampled(run5, k=k, laps=laps), run=run5)
 
 
 if __name__ == "__main__":

@@ -25,6 +25,9 @@ def main():
     parser.add_argument("--out", default="wam_mosaic.png")
     parser.add_argument("--samples", type=int, default=25)
     parser.add_argument("--size", type=int, default=224)
+    parser.add_argument("--layout", default="nhwc", choices=["nhwc", "nchw"],
+                        help="nhwc = the benched zero-layout-copy TPU path "
+                             "(default); nchw = the reference's layout")
     args = parser.parse_args()
 
     from wam_tpu.config import ensure_usable_backend, select_backend
@@ -54,12 +57,19 @@ def main():
         synth = np.stack([np.sin(12 * xx) * np.cos(9 * yy)] * 3) + 0.1 * rng.standard_normal((3, S, S))
         x = synth[None].astype(np.float32)
 
-    _, _, model_fn = build_vision_model(args.model, checkpoint_path=args.checkpoint, image_size=x.shape[-1])
-    y = int(np.asarray(model_fn(jnp.asarray(x))).argmax())
+    # layout="nhwc" binds the model channel-last and runs the whole engine
+    # pipeline channel-last — the configuration every recorded flagship
+    # number uses (BASELINE.md; __call__ still takes NCHW input either way)
+    nhwc = args.layout == "nhwc"
+    _, _, model_fn = build_vision_model(args.model, checkpoint_path=args.checkpoint,
+                                        image_size=x.shape[-1], nchw=not nhwc)
+    xin = jnp.asarray(x)
+    y = int(np.asarray(model_fn(jnp.transpose(xin, (0, 2, 3, 1)) if nhwc else xin)).argmax())
     print(f"explaining class {y}")
 
     explainer = WaveletAttribution2D(
-        model_fn, wavelet=args.wavelet, J=args.levels, method="smooth", n_samples=args.samples
+        model_fn, wavelet=args.wavelet, J=args.levels, method="smooth",
+        n_samples=args.samples, model_layout=args.layout,
     )
     mosaic = explainer(jnp.asarray(x), jnp.array([y]))
 

@@ -1,0 +1,75 @@
+"""A/B the benched audio step (b8 n50, 220500 samples, db6 J=5) with and
+without candidate rewrites (round-5 verdict #4: harvest the ~35% CNN conv
+share). Prints one JSON line per variant with wall and device medians.
+
+Usage: python scripts/audio_ab.py [--quick] [--variants base,fold_bn]
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true")
+    ap.add_argument("--k", type=int, default=5)
+    ap.add_argument("--variants", default="base,fold_bn")
+    args = ap.parse_args()
+
+    from wam_tpu.config import enable_compilation_cache, ensure_usable_backend
+
+    ensure_usable_backend(timeout_s=180.0)
+    enable_compilation_cache()
+
+    import jax
+    import jax.numpy as jnp
+
+    from wam_tpu.models.audio import AudioCNN, bind_audio_inference
+    from wam_tpu.profiling import bench_samples, device_time_samples, median_iqr
+    from wam_tpu.wam1d import WaveletAttribution1D, normalize_waveforms
+
+    q = args.quick
+    b, n = (2, 4) if q else (8, 50)
+    wave_len = 65536 if q else 220500
+    mel_t = wave_len // 512 + 1
+
+    amodel = AudioCNN(num_classes=50)
+    avars = amodel.init(jax.random.PRNGKey(0), jnp.zeros((1, 1, mel_t, 128)))
+    x = jax.random.normal(jax.random.PRNGKey(3), (b, wave_len), jnp.float32)
+    xn = normalize_waveforms(x)
+    y = jnp.arange(b, dtype=jnp.int32) % 50
+    key = jax.random.PRNGKey(42)
+
+    def build(fold_bn):
+        fn = bind_audio_inference(amodel, avars, compute_dtype=jnp.bfloat16,
+                                  fold_bn=fold_bn)
+        ex = WaveletAttribution1D(fn, wavelet="db6", J=5, method="smooth",
+                                  n_samples=n, stdev_spread=0.001,
+                                  sample_batch_size="auto")
+        return lambda: ex._jit_smooth(xn, y, key)
+
+    variants = {
+        "base": lambda: build(False),
+        "fold_bn": lambda: build(True),
+    }
+    for name in args.variants.split(","):
+        run = variants[name]()
+        wall = bench_samples(run, k=args.k, laps=6)
+        dev = device_time_samples(run, k=min(args.k, 3), laps=4)
+        wm = sorted(wall)[len(wall) // 2]
+        rec = {"variant": name, "wall_s": round(wm, 4),
+               "wall_wf_s": round(b / wm, 2)}
+        if dev:
+            dm, dq1, dq3, diqr = median_iqr(dev)
+            rec.update({"device_s": round(dm, 5),
+                        "device_wf_s": round(b / dm, 2),
+                        "device_iqr_pct": round(100 * diqr / dm, 2)})
+        print(json.dumps(rec), flush=True)
+
+
+if __name__ == "__main__":
+    main()

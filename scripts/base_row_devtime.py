@@ -1,0 +1,68 @@
+"""Reproducibility probe for the wam2d_base bench row's device-time metric.
+
+Runs ONLY matrix row 1 (ResNet-50 single-image haar J=3 base pass) and
+prints one JSON line with wall and device medians — run it from several
+fresh processes to check that device time is stable where wall time is
+bimodal (round-5 verdict #5).
+
+Usage: python scripts/base_row_devtime.py [--image 224] [--k 5]
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--image", type=int, default=224)
+    ap.add_argument("--k", type=int, default=5)
+    args = ap.parse_args()
+
+    from wam_tpu.config import enable_compilation_cache, ensure_usable_backend
+
+    ensure_usable_backend(timeout_s=180.0)
+    enable_compilation_cache()
+
+    import jax
+    import jax.numpy as jnp
+
+    from wam_tpu.models import bind_inference, resnet50
+    from wam_tpu.profiling import bench_samples, device_time_samples, median_iqr
+    from wam_tpu.wam2d import BaseWAM2D
+
+    model = resnet50(num_classes=1000)
+    variables = model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, args.image, args.image, 3)))
+    fn = bind_inference(model, variables, nchw=True,
+                        compute_dtype=jnp.bfloat16, fold_bn=True)
+    base = BaseWAM2D(fn, wavelet="haar", J=3, mode="reflect")
+    x = jax.random.normal(jax.random.PRNGKey(1), (1, 3, args.image, args.image))
+    y = jnp.zeros((1,), jnp.int32)
+    run = lambda: base(x, y)
+
+    wall = bench_samples(run, k=args.k, laps=8)
+    dev = device_time_samples(run, k=args.k, laps=8)
+    wm, wq1, wq3, wiqr = median_iqr(wall)
+    rec = {
+        "pid": os.getpid(),
+        "platform": jax.default_backend(),
+        "wall_s": round(wm, 5),
+        "wall_items_per_s": round(1.0 / wm, 2),
+        "wall_iqr_pct": round(100 * wiqr / wm, 2),
+    }
+    if dev:
+        dm, dq1, dq3, diqr = median_iqr(dev)
+        rec.update({
+            "device_s": round(dm, 5),
+            "device_items_per_s": round(1.0 / dm, 2),
+            "device_iqr_pct": round(100 * diqr / dm, 2),
+        })
+    print(json.dumps(rec), flush=True)
+
+
+if __name__ == "__main__":
+    main()

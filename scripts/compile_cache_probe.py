@@ -1,0 +1,76 @@
+"""Does the persistent compilation cache amortize first-call compiles
+across processes? (round-5 verdict #7)
+
+Times the FIRST call of the heavy registry methods (LRP's EpsilonPlusFlat
+walker — the worst offender at ~107 s cold — plus guided-bp and gradcam)
+in THIS process, with `enable_compilation_cache()` active. Run it twice in
+fresh processes: the second run's first-call times measure what the disk
+cache actually buys a cold process.
+
+Usage: python scripts/compile_cache_probe.py [--methods lrp,guided,gradcam]
+       [--cache-dir DIR] [--clear]
+"""
+
+import argparse
+import json
+import os
+import shutil
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--methods", default="lrp,guided,gradcam")
+    ap.add_argument("--cache-dir", default=None)
+    ap.add_argument("--clear", action="store_true",
+                    help="wipe the cache dir first (gives the cold number)")
+    ap.add_argument("--image", type=int, default=224)
+    ap.add_argument("--batch", type=int, default=8)
+    args = ap.parse_args()
+
+    from wam_tpu.config import enable_compilation_cache, ensure_usable_backend
+
+    ensure_usable_backend(timeout_s=180.0)
+    cache_dir = enable_compilation_cache(args.cache_dir)
+    if args.clear and os.path.isdir(cache_dir):
+        shutil.rmtree(cache_dir)
+        os.makedirs(cache_dir, exist_ok=True)
+
+    import jax
+    import jax.numpy as jnp
+
+    from wam_tpu.evalsuite import baselines as B
+    from wam_tpu.models import resnet50
+
+    model = resnet50(num_classes=1000)
+    variables = model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, args.image, args.image, 3)))
+    x = jax.random.normal(jax.random.PRNGKey(1),
+                          (args.batch, 3, args.image, args.image))
+    y = jnp.arange(args.batch, dtype=jnp.int32) % 1000
+
+    fns = {
+        "lrp": lambda: B.lrp(model, variables, x, y),
+        "guided": lambda: B.guided_backprop(model, variables, x, y),
+        "gradcam": lambda: B.gradcam(model, variables, x, y),
+    }
+    for name in args.methods.split(","):
+        t0 = time.perf_counter()
+        out = fns[name]()
+        jax.block_until_ready(out)
+        first = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        out = fns[name]()
+        jax.block_until_ready(out)
+        steady = time.perf_counter() - t0
+        print(json.dumps({"method": name, "first_call_s": round(first, 2),
+                          "steady_s": round(steady, 3),
+                          "cache_dir": cache_dir, "pid": os.getpid()}),
+              flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -191,6 +191,31 @@ def test_fold_bn_preserves_function_and_gradient():
     np.testing.assert_allclose(np.asarray(g0), np.asarray(g1), atol=2e-5, rtol=2e-5)
 
 
+def test_fold_bn_biased_convs_audio():
+    """fold_bn on a BIASED conv stack (AudioCNN's b{N}_bn ↔ b{N}_conv
+    naming): the conv bias must ride the BN scale too — round 5 found the
+    fold dropping the a·c term (invisible on the bias-free vision ResNets)."""
+    from wam_tpu.models.audio import AudioCNN, bind_audio_inference
+
+    model = AudioCNN(num_classes=7)
+    variables = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 1, 128, 128)))
+    # non-trivial stats AND biases so the a·c term is exercised
+    variables = jax.tree_util.tree_map(
+        lambda a: a + 0.05 * jax.random.normal(jax.random.PRNGKey(1), a.shape)
+        if a.ndim else a,
+        variables,
+    )
+    x = jax.random.normal(jax.random.PRNGKey(2), (2, 1, 128, 128))
+    f0 = bind_audio_inference(model, variables)
+    f1 = bind_audio_inference(model, variables, fold_bn=True)
+    np.testing.assert_allclose(np.asarray(f0(x)), np.asarray(f1(x)),
+                               atol=2e-5, rtol=2e-5)
+    g0 = jax.grad(lambda t: f0(t).sum())(x)
+    g1 = jax.grad(lambda t: f1(t).sum())(x)
+    np.testing.assert_allclose(np.asarray(g0), np.asarray(g1),
+                               atol=2e-5, rtol=2e-5)
+
+
 def test_stem_s2d_preserves_function_and_gradient():
     """Space-to-depth stem (models/resnet.py:_StemConv) computes the same
     function from the same (7,7,C,64) parameters."""

@@ -23,13 +23,18 @@ def _init(model, example):
 
 def build_vision_model(model_key: str = "resnet18", num_classes: int = 1000,
                        checkpoint_path: str | None = None, image_size: int = 224,
-                       compute_dtype: Any | None = None):
+                       compute_dtype: Any | None = None, nchw: bool = True,
+                       fold_bn: bool = False):
     """Build a vision model by key; optionally load a torchvision-style
     checkpoint. Returns (model, variables, model_fn) with model_fn taking
-    NCHW input like the reference tensors.
+    NCHW input like the reference tensors (``nchw=False`` binds the NHWC
+    fast path — pair it with ``WaveletAttribution2D(model_layout="nhwc")``
+    for the benched zero-layout-copy TPU configuration).
 
     compute_dtype=jnp.bfloat16 runs the forward/VJP at the MXU's native
-    precision (see wam_tpu.models.bind_inference)."""
+    precision; fold_bn folds inference-mode BN into conv kernels (both are
+    part of the recorded flagship config — see wam_tpu.models.bind_inference
+    and BASELINE.md)."""
     from wam_tpu.models import bind_inference, resnet18, resnet34, resnet50, resnet101
     from wam_tpu.models.ingest import torch_resnet_to_flax
 
@@ -81,7 +86,8 @@ def build_vision_model(model_key: str = "resnet18", num_classes: int = 1000,
         else:
             variables = load_variables(checkpoint_path, variables)
     return model, variables, bind_inference(
-        model, variables, nchw=True, compute_dtype=compute_dtype
+        model, variables, nchw=nchw, compute_dtype=compute_dtype,
+        fold_bn=fold_bn,
     )
 
 

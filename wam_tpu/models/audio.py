@@ -70,7 +70,8 @@ class AudioCNN(nn.Module):
 
 
 def bind_audio_inference(model: nn.Module, variables,
-                         compute_dtype=None) -> Callable[[jax.Array], jax.Array]:
+                         compute_dtype=None,
+                         fold_bn: bool = False) -> Callable[[jax.Array], jax.Array]:
     """Pure `(B, 1, T, M) -> (B, K)` function (the FtEx-wrapper role,
     `src/helpers.py:289-325`).
 
@@ -78,7 +79,16 @@ def bind_audio_inference(model: nn.Module, variables,
     precision (params cast once, melspec input cast at the boundary,
     logits back in f32) — the round-4 audio trace showed the conv stack
     running f32 activations at ~45% of the attribution step
-    (BASELINE.md round-4 audio breakdown)."""
+    (BASELINE.md round-4 audio breakdown).
+
+    fold_bn=True folds the inference-mode BatchNorms into the conv kernels
+    (value-preserving; `resnet._fold_bn_variables` matches the b{N}_bn ↔
+    b{N}_conv naming) — one fewer full-tensor multiply per BN site in the
+    VJP, same role as the vision flagship's fold_bn."""
+    if fold_bn:
+        from wam_tpu.models.resnet import _fold_bn_variables
+
+        variables = _fold_bn_variables(variables)
     if compute_dtype is not None:
         variables = jax.tree_util.tree_map(
             lambda a: a.astype(compute_dtype)

@@ -196,6 +196,8 @@ def _fold_bn_variables(variables, eps: float = 1e-5):
                 conv_name = (
                     "downsample_conv" if name == "downsample_bn"
                     else "conv" + name[2:] if name.startswith("bn")
+                    # AudioCNN-style naming: b1_bn ↔ b1_conv
+                    else name[:-3] + "_conv" if name.endswith("_bn")
                     else None
                 )
                 if conv_name is None or conv_name not in p_node:
@@ -204,7 +206,12 @@ def _fold_bn_variables(variables, eps: float = 1e-5):
                 gamma, beta = child["scale"], child["bias"]
                 mean, var = s_node[name]["mean"], s_node[name]["var"]
                 a = gamma / jnp.sqrt(var + eps)
-                p_node[conv_name] = dict(p_node[conv_name], kernel=kernel * a)
+                folded = dict(p_node[conv_name], kernel=kernel * a)
+                if "bias" in folded:
+                    # biased convs (e.g. AudioCNN): BN(z + c) = a·z + a·c + …
+                    # — the bias must ride the same per-channel scale
+                    folded["bias"] = folded["bias"] * a
+                p_node[conv_name] = folded
                 p_node[name] = dict(child, scale=jnp.ones_like(gamma),
                                     bias=beta - mean * a)
                 s_node[name] = dict(s_node[name], mean=jnp.zeros_like(mean),

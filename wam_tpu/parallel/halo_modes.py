@@ -482,11 +482,16 @@ def _level_inv_1d(coreA, tailA, coreD, tailD, synth_run, wav, repl_sh=None):
     return core_out, tail_out
 
 
-def _check_tails(coeffs, wav: Wavelet, axis: int, producer: str):
-    """Eager mirror of the `_level_inv_1d` trace-time invariant, shared by
-    the waverec run() wrappers (round-4 advisor): the last shard's synthesis
-    halo comes from the tail, so every leaf's tail must hold at least
-    (L-1)//2 coefficients along ``axis`` (``producer``'s tails always do)."""
+def _check_coeff_leaves(coeffs, wav: Wavelet, axis: int, k: int,
+                        producer: str, what: str):
+    """Shared eager validation for the waverec run() wrappers — ONE
+    container flattening (TailedLeaf | Detail2D | 3D dict) for both checks:
+
+    - core divisibility by the shard count along ``axis``;
+    - the `_level_inv_1d` trace-time invariant (round-4 advisor): the last
+      shard's synthesis halo comes from the tail, so every leaf's tail must
+      hold at least (L-1)//2 coefficients along ``axis`` (``producer``'s
+      tails always do)."""
     h_min = (wav.filt_len - 1) // 2
     for c in coeffs:
         if isinstance(c, TailedLeaf):
@@ -496,6 +501,13 @@ def _check_tails(coeffs, wav: Wavelet, axis: int, producer: str):
         else:
             pieces = list(c)
         for piece in pieces:
+            n = piece.core.shape[axis]
+            if n % k:
+                raise ValueError(
+                    f"coefficient core {what} {n} is not divisible by "
+                    f"shards={k}: these leaves were not produced by "
+                    f"{producer} on this mesh"
+                )
             if piece.tail.shape[axis] < h_min:
                 raise ValueError(
                     f"coefficient tail length {piece.tail.shape[axis]} < "
@@ -551,15 +563,8 @@ def sharded_waverec_mode(mesh: Mesh, wavelet, seq_axis: str = "data"):
     k = mesh.shape[seq_axis]
 
     def run(coeffs):
-        for c in coeffs:
-            C = c.core.shape[-1]
-            if C % k:
-                raise ValueError(
-                    f"coefficient core length {C} is not divisible by "
-                    f"shards={k}: these leaves were not produced by "
-                    f"sharded_wavedec_mode on this mesh"
-                )
-        _check_tails(coeffs, wav, -1, "sharded_wavedec_mode")
+        _check_coeff_leaves(coeffs, wav, -1, k, "sharded_wavedec_mode",
+                            "length")
         return apply(coeffs)
 
     run._apply = apply  # jitted body, exposed for HLO audits (tests)
@@ -713,17 +718,8 @@ def sharded_waverec2_mode(mesh: Mesh, wavelet, seq_axis: str = "data"):
         )
 
     def run(coeffs):
-        for c in coeffs:
-            pieces = [c] if isinstance(c, TailedLeaf) else list(c)
-            for piece in pieces:
-                C = piece.core.shape[-2]
-                if C % k:
-                    raise ValueError(
-                        f"coefficient core row count {C} is not divisible by "
-                        f"shards={k}: these leaves were not produced by "
-                        f"sharded_wavedec2_mode on this mesh"
-                    )
-        _check_tails(coeffs, wav, -2, "sharded_wavedec2_mode")
+        _check_coeff_leaves(coeffs, wav, -2, k, "sharded_wavedec2_mode",
+                            "row count")
         return apply(coeffs)
 
     run._apply = apply  # jitted body, exposed for HLO audits (tests)
@@ -786,17 +782,8 @@ def sharded_waverec3_mode(mesh: Mesh, wavelet, seq_axis: str = "data"):
         )
 
     def run(coeffs):
-        for c in coeffs:
-            pieces = [c] if isinstance(c, TailedLeaf) else list(c.values())
-            for piece in pieces:
-                C = piece.core.shape[-3]
-                if C % k:
-                    raise ValueError(
-                        f"coefficient core depth {C} is not divisible by "
-                        f"shards={k}: these leaves were not produced by "
-                        "sharded_wavedec3_mode on this mesh"
-                    )
-        _check_tails(coeffs, wav, -3, "sharded_wavedec3_mode")
+        _check_coeff_leaves(coeffs, wav, -3, k, "sharded_wavedec3_mode",
+                            "depth")
         return apply(coeffs)
 
     run._apply = apply  # jitted body, exposed for HLO audits (tests)
