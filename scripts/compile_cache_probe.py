@@ -1,11 +1,12 @@
 """Does the persistent compilation cache amortize first-call compiles
 across processes? (round-5 verdict #7)
 
-Times the FIRST call of the heavy registry methods (LRP's EpsilonPlusFlat
-walker — the worst offender at ~107 s cold — plus guided-bp and gradcam)
-in THIS process, with `enable_compilation_cache()` active. Run it twice in
-fresh processes: the second run's first-call times measure what the disk
-cache actually buys a cold process.
+Times the FIRST call of the heavy registry methods (guided-bp — the worst
+cold compile, ~157 s measured this round — plus LRP's EpsilonPlusFlat
+walker and gradcam) in THIS process, with `enable_compilation_cache()`
+active. Run it twice in fresh processes: the second run's first-call times
+measure what the disk cache actually buys a cold process
+(BASELINE.md round-5: 1.7-6 s).
 
 Usage: python scripts/compile_cache_probe.py [--methods lrp,guided,gradcam]
        [--cache-dir DIR] [--clear]

@@ -108,40 +108,29 @@ def main():
             "platform": platform,
         })
 
-    from wam_tpu.core.engine import WamEngine
-    from wam_tpu.core.estimators import smoothgrad
     from wam_tpu.models import bind_inference, resnet50
-    from wam_tpu.ops.packing2d import mosaic2d
+    from wam_tpu.wam2d import WaveletAttribution2D
 
     q = args.quick
     batch, n_samples, image = (4, 3, 64) if q else (32, 25, 224)
 
-    # flagship: EXACTLY bench.py's shipped configuration (NHWC, fold_bn,
-    # bf16 model, dwt-bf16, chunk 4, streamed noise)
+    # flagship: the class API at bench.py's shipped configuration (NHWC,
+    # fold_bn, bf16 model, dwt-bf16, "auto" schedule = chunk 4 + streamed
+    # noise at this geometry) — reusing the class's jitted step like the
+    # audio/3D rows, so schedule changes never diverge the roofline from
+    # the benched step
     model = resnet50(num_classes=1000)
     variables = model.init(jax.random.PRNGKey(0), jnp.zeros((1, image, image, 3)))
     model_fn = bind_inference(model, variables, nchw=False,
                               compute_dtype=jnp.bfloat16, fold_bn=True)
-    engine = WamEngine(model_fn, ndim=2, wavelet="db4", level=3,
-                       mode="reflect", channel_last=True)
+    ex2 = WaveletAttribution2D(model_fn, wavelet="db4", J=3, method="smooth",
+                               n_samples=n_samples, dwt_bf16=True,
+                               model_layout="nhwc")
     x2 = jax.random.normal(jax.random.PRNGKey(1), (batch, 3, image, image))
     y2 = jnp.arange(batch, dtype=jnp.int32) % 1000
 
-    @jax.jit
-    def flagship(x, key):
-        x = jnp.transpose(x, (0, 2, 3, 1))
-
-        def step(noisy):
-            noisy = noisy.astype(jnp.bfloat16)
-            _, grads = engine.attribute(noisy, y2)
-            return mosaic2d(grads, True, -1)
-
-        return smoothgrad(step, x, key, n_samples=n_samples, stdev_spread=0.25,
-                          batch_size=4 if not q else None,
-                          materialize_noise=False)
-
-    analyze("flagship_2d_b32_n25", flagship, (x2, jax.random.PRNGKey(42)),
-            batch * n_samples)
+    analyze("flagship_2d_b32_n25", ex2._jit_smooth,
+            (x2, y2, jax.random.PRNGKey(42)), batch * n_samples)
 
     # audio + 3D: the recorded bench_matrix configurations
     from bench_workloads import audio_workload, vol_workload
