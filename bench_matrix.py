@@ -162,6 +162,11 @@ def main():
             rec["prev_value"] = old["value"]
             rec["delta_pct"] = round(100.0 * (rec["value"] - old["value"])
                                      / old["value"], 2)
+            old_laps = old.get("laps")
+            comparable_laps = (
+                old_laps is not None
+                and max(used_laps, old_laps) <= 2 * min(used_laps, old_laps)
+            )
             if "device_value" in old and "device_value" in rec:
                 rec["device_delta_pct"] = round(
                     100.0 * (rec["device_value"] - old["device_value"])
@@ -175,20 +180,11 @@ def main():
                      or rec["device_value_q3"] < old["device_value_q1"])
                     and abs(rec["device_delta_pct"]) >= 1.0
                 )
-                print(json.dumps(rec), flush=True)
-                if writer is not None:
-                    writer.write(rec)
-                return
-            old_laps = old.get("laps")
-            comparable_laps = (
-                old_laps is not None
-                and max(used_laps, old_laps) <= 2 * min(used_laps, old_laps)
-            )
-            if ("device_value" in rec) != ("device_value" in old):
-                # device timing on only ONE side (first device-timed run
-                # against a wall-only ledger row, or a transiently failed
-                # capture against a device-timed row): the wall comparison
-                # is exactly the bimodal trap — leave the verdict open
+            elif on_accel:
+                # device timing missing on one or BOTH sides of a TPU
+                # comparison (wall-only ledger row, transiently failed
+                # capture): any wall diff is the bimodal cross-process trap
+                # — leave the verdict open rather than fall back
                 rec["significant"] = None
             elif "value_q1" in old and "value_q3" in old and comparable_laps:
                 # significant = the [q1, q3] throughput intervals don't overlap

@@ -442,7 +442,8 @@ def test_batched_auc_matches_per_image_loop():
         return x_s[None] * ins[:, None]
 
     runner = batched_auc_runner(inputs_fn, model_fn, images_per_chunk=2)
-    scores, curves = runner(x, expl, jnp.asarray(y))
+    out = runner(x, expl, jnp.asarray(y))  # one [score | curve] array per image
+    scores, curves = out[:, 0], out[:, 1:]
 
     for s in range(5):
         inputs = inputs_fn(x[s], expl[s])
@@ -581,10 +582,9 @@ def test_batched_auc_fan_chunked_matches_unchunked():
 
     plain = batched_auc_runner(inputs_fn, model_fn, images_per_chunk=1)
     chunked = batched_auc_runner(inputs_fn, model_fn, images_per_chunk=1, fan_chunk=4)
-    s0, c0 = plain(x, expl, y)
-    s1, c1 = chunked(x, expl, y)
-    np.testing.assert_allclose(np.asarray(c0), np.asarray(c1), atol=1e-6)
-    np.testing.assert_allclose(np.asarray(s0), np.asarray(s1), atol=1e-6)
+    out0 = plain(x, expl, y)     # one [score | curve] array per image
+    out1 = chunked(x, expl, y)
+    np.testing.assert_allclose(np.asarray(out0), np.asarray(out1), atol=1e-6)
 
 
 # -- round-3 batched-evaluator regressions (VERDICT.md round-2 weak #3) ----

@@ -244,7 +244,11 @@ def batched_auc_runner(
         out = jax.lax.map(one, (xb, explb, yb), batch_size=images_per_chunk)
         if return_logits:
             return out
-        return compute_auc(out), out
+        # ONE output array [score | curve] per image: two result tensors
+        # fetched separately cost one ~100 ms tunnel round trip EACH — the
+        # round-5 insertion trace measured 54 ms device inside a 267 ms
+        # wall, i.e. the two fetches were 80% of the call
+        return jnp.concatenate([compute_auc(out)[:, None], out], axis=1)
 
     if mesh is None:
         return jax.jit(body)
@@ -285,11 +289,10 @@ def run_cached_auc(
     out = runner(x, expl, jnp.asarray(y))
     if return_logits:
         return list(np.asarray(out))
-    scores, ps = out
-    # ONE device fetch per result tensor: per-element float(v)/np.asarray(p)
-    # cost a ~100 ms tunnel round trip EACH — 16 sequential RTTs made a
-    # 108 ms-device insertion call take 1.6 s wall (round-4 eval ceiling
-    # trace, BASELINE.md)
-    scores = np.asarray(scores)
-    ps = np.asarray(ps)
-    return [float(v) for v in scores], list(ps)
+    # ONE device fetch for the whole call: round 4 batched the per-element
+    # float(v)/np.asarray(p) fetches (16 sequential ~100 ms tunnel RTTs)
+    # into one per tensor; round 5 fuses the two result tensors into one
+    # [score | curve] array so the call pays a single RTT (insertion wall
+    # 267 → ~160 ms at 54 ms device, BASELINE.md round-5)
+    arr = np.asarray(out)
+    return [float(v) for v in arr[:, 0]], list(arr[:, 1:])
