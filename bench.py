@@ -36,7 +36,7 @@ F32 = "--f32" in sys.argv
 DWT_BF16 = "--no-dwt-bf16" not in sys.argv and not F32
 
 
-def tpu_throughput() -> tuple[float, str]:
+def tpu_throughput() -> tuple[float, float | None, str]:
     from wam_tpu.config import enable_compilation_cache, ensure_usable_backend
 
     ensure_usable_backend(timeout_s=180.0)
@@ -117,7 +117,7 @@ def tpu_throughput() -> tuple[float, str]:
             batch_size=chunk, materialize_noise=False,
         )
 
-    from wam_tpu.profiling import bench_time
+    from wam_tpu.profiling import bench_time, device_time_samples
 
     key = jax.random.PRNGKey(42)
     # laps>1 amortizes the tunneled-TPU host round trip (~100 ms measured)
@@ -125,7 +125,17 @@ def tpu_throughput() -> tuple[float, str]:
     # pipelined caller sees, not RTT-per-step (BASELINE.md round-2 note).
     t = bench_time(run, x, key, repeats=2 if QUICK else 3,
                    laps=2 if (QUICK or platform == "cpu") else 6)
-    return batch / t, platform
+    # device (xplane module-span) throughput alongside wall: the chip-only
+    # number the round-5 protocol records for every matrix row — wall on
+    # the tunneled platform carries a laps-amortized RTT share
+    dev_tput = None
+    if platform != "cpu":
+        dev = device_time_samples(run, x, key, k=3, laps=2)
+        if dev:
+            from wam_tpu.profiling import median_iqr
+
+            dev_tput = batch / median_iqr(dev)[0]
+    return batch / t, dev_tput, platform
 
 
 def cpu_baseline_throughput(full: bool = False) -> float:
@@ -254,7 +264,7 @@ def main():
             )
         )
         return
-    tpu, backend = tpu_throughput()
+    tpu, tpu_device, backend = tpu_throughput()
     try:
         cpu = cpu_baseline_throughput()
     except Exception as e:  # baseline must never block reporting
@@ -268,6 +278,8 @@ def main():
                 "value": round(tpu, 3),
                 "unit": "images/s",
                 "vs_baseline": round(vs, 2) if vs == vs else None,
+                "device_value": (round(tpu_device, 3)
+                                 if tpu_device is not None else None),
                 "dtype": "f32" if F32 else ("bf16+dwt-bf16" if DWT_BF16 else "bf16"),
                 "baseline_dtype": "f32-torch-cpu",
                 "platform": backend,

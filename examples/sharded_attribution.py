@@ -44,6 +44,11 @@ def main():
                         help="boundary mode for --long-context: periodization "
                              "(ring wrap, default) or an expansive pywt mode "
                              "(symmetric/reflect/zero) via the core+tail path")
+    parser.add_argument("--class-api", action="store_true",
+                        help="with --long-context: run the CLASS-level "
+                             "sequence-sharded SmoothGrad "
+                             "(WaveletAttribution1D(mesh=...)) instead of "
+                             "the raw gradient core")
     args = parser.parse_args()
 
     if args.virtual:
@@ -98,17 +103,34 @@ def main():
             out_shardings=NamedSharding(seq_mesh, P(None, "data")),
         )(jax.random.PRNGKey(3))
         model = toy_wave_model(jax.random.PRNGKey(2))
-        if args.boundary == "periodization":
-            step = sharded_coeff_grads_per(seq_mesh, args.wavelet, args.levels, model)
+        y = jnp.arange(args.batch, dtype=jnp.int32) % 4
+        if args.class_api:
+            # round-5: one class-level call runs a sequence-sharded
+            # SmoothGrad end to end (shard-local noise, sharded wavedec/
+            # waverec/model/grads) — here via the raw SeqShardedWam core
+            # (no melspec front, matching the toy waveform model; the 1D
+            # class composes the same core with its mel front)
+            from wam_tpu.parallel import SeqShardedWam
+
+            sw = SeqShardedWam(seq_mesh, model, ndim=1, wavelet=args.wavelet,
+                               level=args.levels, mode=args.boundary)
+            grads = sw.smoothgrad(wf, y, jax.random.PRNGKey(5),
+                                  n_samples=4, stdev_spread=0.1)
         else:
-            step = sharded_coeff_grads_mode(seq_mesh, args.wavelet, args.levels,
-                                            model, args.boundary)
-        grads = step(wf, jnp.arange(args.batch, dtype=jnp.int32) % 4)
+            if args.boundary == "periodization":
+                step = sharded_coeff_grads_per(seq_mesh, args.wavelet,
+                                               args.levels, model)
+            else:
+                step = sharded_coeff_grads_mode(seq_mesh, args.wavelet,
+                                                args.levels, model,
+                                                args.boundary)
+            grads = step(wf, y)
         jax.block_until_ready(grads)
         leaves = jax.tree_util.tree_leaves(grads)
         shown = [tuple(g.shape) for g in leaves[:4]]
         more = "..." if len(leaves) > 4 else ""
-        print(f"long-context coefficient gradients ({args.boundary}): "
+        what = "class-level SmoothGrad" if args.class_api else "coefficient gradients"
+        print(f"long-context {what} ({args.boundary}): "
               f"{shown}{more}, every leaf sharded over "
               f"{len(leaves[0].sharding.device_set)} devices")
         return
