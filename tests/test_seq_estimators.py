@@ -269,6 +269,37 @@ def test_wam2d_class_mesh_rejects_unsupported():
 # ---------------------------------------------------------------------------
 
 
+@pytest.mark.parametrize("chunk", [2, 3, 8])
+def test_seq_sharded_smoothgrad_sample_chunk_parity(chunk):
+    """sample_chunk flattens g samples into the batch axis (one dispatch,
+    g·B model rows): identical draws and per-sample gradients as the
+    sequential path — including a non-dividing chunk (remainder group)."""
+    _need_devices(8)
+    from wam_tpu.models.audio import toy_wave_model
+    from wam_tpu.parallel.seq_estimators import SeqShardedWam
+
+    mesh = make_mesh({"data": 8})
+    sw = SeqShardedWam(mesh, toy_wave_model(jax.random.PRNGKey(0)), ndim=1,
+                       wavelet="db3", level=2, mode="symmetric")
+    x = _put_seq(jax.random.normal(jax.random.PRNGKey(1), (2, 2048)), mesh, 1)
+    y = jnp.array([1, 3])
+    key = jax.random.PRNGKey(9)
+    # n=5: chunk=2 → three balanced chunks of g=2 with ONE pad slot (the
+    # weight-0 masking branch), chunk=3 → g=3 with one pad, chunk=8 → one
+    # full-vmap group — sequential/chunked/pad paths all covered
+    seq = sw.smoothgrad(x, y, key, n_samples=5, stdev_spread=0.1)
+    chunked = sw.smoothgrad(x, y, key, n_samples=5, stdev_spread=0.1,
+                            sample_chunk=chunk)
+    for a, b in zip(seq, chunked):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+    # representation mode through the chunked path too
+    rep_seq = sw.smoothgrad(x, None, key, n_samples=2, stdev_spread=0.1)
+    rep_ch = sw.smoothgrad(x, None, key, n_samples=2, stdev_spread=0.1,
+                           sample_chunk=2)
+    for a, b in zip(rep_seq, rep_ch):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+
+
 def test_seq_sharded_grads_hlo_no_signal_sized_gather():
     """The estimator's per-sample gradient step (reconstruct → model → VJP)
     moves only O(L)-sized buffers: ring halos ride collective-permute, and

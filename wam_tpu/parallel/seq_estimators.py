@@ -124,6 +124,10 @@ class SeqShardedWam:
             static_argnames=("spatial",),
         )
         self._noisy = jax.jit(self._noisy_impl)
+        self._noisy_chunk = jax.jit(self._noisy_chunk_impl,
+                                    static_argnames=("g",))
+        self._grads_chunk = jax.jit(self._grads_chunk_impl,
+                                    static_argnames=("spatial", "g"))
         # smooth accumulates plain sums (like `estimators.smoothgrad`); the
         # IG accumulator applies the per-element nan_to_num of
         # `estimators.trapezoid`
@@ -156,6 +160,18 @@ class SeqShardedWam:
             h = h + tap
         return target_loss(self.model_fn(h), y)
 
+    def _tap_grads(self, cs, y, spatial):
+        """Two-tap gradient (coefficients + front output) via the zero-tap
+        trick — the one definition both the sequential and chunked steps
+        wrap."""
+        tap_shape = jax.eval_shape(
+            lambda c: self.front_fn(self._reconstruct(c, spatial)), cs
+        )
+        tap0 = jnp.zeros(tap_shape.shape, tap_shape.dtype)
+        return jax.grad(
+            lambda c, t: self._loss(c, t, y, spatial), argnums=(0, 1)
+        )(cs, tap0)
+
     def _grads_impl(self, cs, y, spatial):
         """Per-sample gradient step. Without ``post_fn`` the output is the
         RAW coefficient-gradient tree (TailedLeaf for the expansive modes) —
@@ -167,14 +183,7 @@ class SeqShardedWam:
         the gather+pack runs in-graph; the packed canvas is output-sized and
         its assembly sharding is left to propagation."""
         if self.front_grads:
-            tap_shape = jax.eval_shape(
-                lambda c: self.front_fn(self._reconstruct(c, spatial)), cs
-            )
-            tap0 = jnp.zeros(tap_shape.shape, tap_shape.dtype)
-            g_cs, g_tap = jax.grad(
-                lambda c, t: self._loss(c, t, y, spatial), argnums=(0, 1)
-            )(cs, tap0)
-            return (g_cs, g_tap)
+            return self._tap_grads(cs, y, spatial)
         g_cs = jax.grad(lambda c: self._loss(c, None, y, spatial))(cs)
         return self.post_fn(self._gather(g_cs)) if self.post_fn is not None else g_cs
 
@@ -202,6 +211,57 @@ class SeqShardedWam:
         n = lax.with_sharding_constraint(n, NamedSharding(self.mesh, P(*spec)))
         return x + n
 
+    def _noisy_chunk_impl(self, x, key, i0, stdev_spread, g):
+        """``g`` consecutive draws of the SAME fold_in stream as
+        `_noisy_impl`, flattened into the batch axis: (g·B, ...). The
+        sample axis rides the conv batch, so one dispatch carries g·B
+        model rows (the 128-row schedule law) instead of B."""
+        sigma = noise_sigma(x, stdev_spread)
+        sigma = sigma.reshape(sigma.shape + (1,) * (x.ndim - 1))
+
+        def draw(i):
+            k = jax.random.fold_in(key, i)
+            return jax.random.normal(k, x.shape, x.dtype) * sigma
+
+        noise = jax.vmap(draw)(i0 + jnp.arange(g, dtype=jnp.int32))
+        spec = [None] * (x.ndim + 1)
+        spec[1 + x.ndim - self.ndim] = self.seq_axis
+        noise = lax.with_sharding_constraint(
+            noise, NamedSharding(self.mesh, P(*spec))
+        )
+        noisy = x[None] + noise
+        return noisy.reshape((-1,) + x.shape[1:])
+
+    def _grads_chunk_impl(self, cs, y_flat, w, spatial, g):
+        """Gradient step over a g-sample flattened chunk, returning the
+        ``w``-WEIGHTED SUM of the g per-sample gradient trees (leading axis
+        back to B). ``w`` (g,) is 1 for real samples, 0 for the pad samples
+        of a remainder chunk — padding keeps every chunk the same static
+        shape, so a non-dividing sample_chunk never re-compiles (the pad
+        rows' gradients are batch-diagonal and masked here).
+
+        The loss means over g·B rows, so gradients come back 1/g of the
+        per-sample mean-over-B convention — rescaled by g here. ``post_fn``
+        is vmapped over the g groups so its per-sample-call semantics
+        (e.g. the mosaic's normalize-over-the-batch) are preserved
+        exactly."""
+        by_sample = lambda a: a.reshape((g, a.shape[0] // g) + a.shape[1:])
+        wsum = lambda a: (a * w.reshape((g,) + (1,) * (a.ndim - 1))).sum(axis=0)
+        wsum_g = lambda tree: jax.tree_util.tree_map(
+            lambda a: wsum(by_sample(a)), tree
+        )
+        scale = lambda tree: jax.tree_util.tree_map(lambda a: g * a, tree)
+        if self.front_grads:
+            return wsum_g(scale(self._tap_grads(cs, y_flat, spatial)))
+        g_cs = scale(jax.grad(lambda c: self._loss(c, None, y_flat, spatial))(cs))
+        if self.post_fn is not None:
+            gathered = self._gather(g_cs)
+            per = jax.vmap(self.post_fn)(
+                jax.tree_util.tree_map(by_sample, gathered)
+            )
+            return jax.tree_util.tree_map(wsum, per)
+        return wsum_g(g_cs)
+
     # -- gradient core (single pass) ---------------------------------------
 
     def attribute(self, x, y=None):
@@ -214,20 +274,53 @@ class SeqShardedWam:
 
     # -- estimators --------------------------------------------------------
 
-    def smoothgrad(self, x, y, key, *, n_samples: int, stdev_spread: float):
+    def smoothgrad(self, x, y, key, *, n_samples: int, stdev_spread: float,
+                   sample_chunk: int | None = 1):
         """Mean over ``n_samples`` shard-local noisy passes. Same draws and
         per-sample gradients as `core.estimators.smoothgrad(step, x, key,
         .., materialize_noise=False)` wrapping the same single-device step
         (fold_in key stream; partitionable threefry is sharding-invariant);
-        the sample mean differs only by float summation order."""
+        the sample mean differs only by float summation order.
+
+        ``sample_chunk`` > 1 processes that many samples PER DISPATCH by
+        flattening them into the batch axis (g·B model rows — the v5e
+        128-row schedule law; memory grows by the same factor). ``None``
+        means ALL samples in one dispatch (the resolvers' full-vmap
+        convention). Identical draws and per-sample gradients; only the
+        summation order differs."""
+        if sample_chunk is None:
+            sample_chunk = n_samples
         spatial = tuple(x.shape[-self.ndim:])
+        spread = jnp.asarray(stdev_spread, x.dtype)
         acc = None
-        for i in range(n_samples):
-            noisy = self._noisy(x, key, jnp.asarray(i, jnp.int32),
-                                jnp.asarray(stdev_spread, x.dtype))
-            coeffs = self.dec(noisy)
-            g = self._grads(coeffs, y, spatial=spatial)
-            acc = g if acc is None else self._accum(acc, g, 1.0)
+        if sample_chunk <= 1:
+            for i in range(n_samples):
+                noisy = self._noisy(x, key, jnp.asarray(i, jnp.int32), spread)
+                coeffs = self.dec(noisy)
+                g = self._grads(coeffs, y, spatial=spatial)
+                acc = g if acc is None else self._accum(acc, g, 1.0)
+        else:
+            # every chunk runs at the SAME static size g (a remainder chunk
+            # is padded with weight-0 samples), so one compiled shape covers
+            # the whole loop even when sample_chunk doesn't divide
+            # n_samples; g is BALANCED across the chunk count so padding is
+            # minimal (n=25 chunk=16 → two chunks of 13, one pad slot —
+            # not 16+16 with seven)
+            n_chunks = -(-n_samples // min(sample_chunk, n_samples))
+            g = -(-n_samples // n_chunks)
+            y_flat = None if y is None else jnp.tile(jnp.asarray(y), g)
+            i = 0
+            while i < n_samples:
+                n_real = min(g, n_samples - i)
+                w = jnp.asarray([1.0] * n_real + [0.0] * (g - n_real),
+                                x.dtype)
+                noisy = self._noisy_chunk(x, key, jnp.asarray(i, jnp.int32),
+                                          spread, g=g)
+                coeffs = self.dec(noisy)
+                part = self._grads_chunk(coeffs, y_flat, w, spatial=spatial,
+                                         g=g)
+                acc = part if acc is None else self._accum(acc, part, 1.0)
+                i += n_real
         return self._finalize(self._scale(acc, 1.0 / n_samples))
 
     def integrated(self, x, y, *, n_steps: int, dx: float = 1.0):

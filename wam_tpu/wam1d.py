@@ -284,9 +284,13 @@ class WaveletAttribution1D(BaseWAM1D):
         y = jnp.asarray(y)
         key = jax.random.PRNGKey(self.random_seed)
         if self.mesh is not None:
+            # sample_batch_size governs the mesh path too: chunk samples
+            # into the batch axis ("auto" = the 128-row law; None = all
+            # samples in one dispatch)
             grad_avg, mel_tap = self._seq.smoothgrad(
                 x, y, key, n_samples=self.n_samples,
                 stdev_spread=self.stdev_spread,
+                sample_chunk=self._resolve_chunk(x.shape[0]),
             )
             mel_avg = mel_tap[:, 0, :, :]
         else:

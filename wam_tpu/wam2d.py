@@ -249,9 +249,11 @@ class WaveletAttribution2D(BaseWAM2D):
     def smooth_wam(self, x, y):
         key = jax.random.PRNGKey(self.random_seed)
         if self.mesh is not None:
+            x = jnp.asarray(x)
             avg = self._seq.smoothgrad(
-                jnp.asarray(x), jnp.asarray(y), key,
+                x, jnp.asarray(y), key,
                 n_samples=self.n_samples, stdev_spread=self.stdev_spread,
+                sample_chunk=self._resolve_chunk(x.shape),
             )
         else:
             avg = self._jit_smooth(jnp.asarray(x), jnp.asarray(y), key)

@@ -300,6 +300,7 @@ class WaveletAttribution3D(BaseWAM3D):
             self.grads = self._seq.smoothgrad(
                 vol, y_arr, key, n_samples=self.n_samples,
                 stdev_spread=self.stdev_spread,
+                sample_chunk=self._resolve_chunk(vol.shape[0]),
             )
         elif y is None:
             self.grads = self._jit_smooth(False)(vol, key)
