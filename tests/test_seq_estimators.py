@@ -300,6 +300,26 @@ def test_seq_sharded_smoothgrad_sample_chunk_parity(chunk):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
 
 
+@pytest.mark.parametrize("chunk", [2, 8])
+def test_seq_sharded_ig_sample_chunk_parity(chunk):
+    """IG α-chunking (broadcast coeffs × per-group α, trapezoid weights
+    with 0 pads): identical to the sequential path — n=5 with chunk=2
+    exercises the pad slot."""
+    _need_devices(8)
+    from wam_tpu.models.audio import toy_wave_model
+    from wam_tpu.parallel.seq_estimators import SeqShardedWam
+
+    mesh = make_mesh({"data": 8})
+    sw = SeqShardedWam(mesh, toy_wave_model(jax.random.PRNGKey(0)), ndim=1,
+                       wavelet="db3", level=2, mode="symmetric")
+    x = _put_seq(jax.random.normal(jax.random.PRNGKey(1), (2, 2048)), mesh, 1)
+    y = jnp.array([1, 3])
+    _, seq = sw.integrated(x, y, n_steps=5)
+    _, chunked = sw.integrated(x, y, n_steps=5, sample_chunk=chunk)
+    for a, b in zip(seq, chunked):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+
+
 def test_seq_sharded_grads_hlo_no_signal_sized_gather():
     """The estimator's per-sample gradient step (reconstruct → model → VJP)
     moves only O(L)-sized buffers: ring halos ride collective-permute, and

@@ -128,6 +128,8 @@ class SeqShardedWam:
                                     static_argnames=("g",))
         self._grads_chunk = jax.jit(self._grads_chunk_impl,
                                     static_argnames=("spatial", "g"))
+        self._grads_ig_chunk = jax.jit(self._grads_ig_chunk_impl,
+                                       static_argnames=("spatial", "g"))
         # smooth accumulates plain sums (like `estimators.smoothgrad`); the
         # IG accumulator applies the per-element nan_to_num of
         # `estimators.trapezoid`
@@ -232,13 +234,15 @@ class SeqShardedWam:
         noisy = x[None] + noise
         return noisy.reshape((-1,) + x.shape[1:])
 
-    def _grads_chunk_impl(self, cs, y_flat, w, spatial, g):
-        """Gradient step over a g-sample flattened chunk, returning the
-        ``w``-WEIGHTED SUM of the g per-sample gradient trees (leading axis
-        back to B). ``w`` (g,) is 1 for real samples, 0 for the pad samples
-        of a remainder chunk — padding keeps every chunk the same static
-        shape, so a non-dividing sample_chunk never re-compiles (the pad
-        rows' gradients are batch-diagonal and masked here).
+    def _chunk_grads_core(self, cs_flat, y_flat, w, spatial, g, nan: bool):
+        """Shared chunked gradient core: grads over a (g·B)-row flattened
+        coefficient tree, returned as the ``w``-WEIGHTED SUM of the g
+        per-sample gradient trees (leading axis back to B). ``w`` (g,) is
+        the per-sample weight (0 for the pad samples of a remainder chunk —
+        padding keeps every chunk the same static shape, so a non-dividing
+        chunk never re-compiles; pad rows are batch-diagonal and masked
+        here). ``nan`` applies `trapezoid`'s per-element nan_to_num (the IG
+        path).
 
         The loss means over g·B rows, so gradients come back 1/g of the
         per-sample mean-over-B convention — rescaled by g here. ``post_fn``
@@ -246,14 +250,20 @@ class SeqShardedWam:
         (e.g. the mosaic's normalize-over-the-batch) are preserved
         exactly."""
         by_sample = lambda a: a.reshape((g, a.shape[0] // g) + a.shape[1:])
-        wsum = lambda a: (a * w.reshape((g,) + (1,) * (a.ndim - 1))).sum(axis=0)
+
+        def wsum(a):
+            if nan:
+                a = jnp.nan_to_num(a)
+            return (a * w.reshape((g,) + (1,) * (a.ndim - 1))).sum(axis=0)
+
         wsum_g = lambda tree: jax.tree_util.tree_map(
             lambda a: wsum(by_sample(a)), tree
         )
         scale = lambda tree: jax.tree_util.tree_map(lambda a: g * a, tree)
         if self.front_grads:
-            return wsum_g(scale(self._tap_grads(cs, y_flat, spatial)))
-        g_cs = scale(jax.grad(lambda c: self._loss(c, None, y_flat, spatial))(cs))
+            return wsum_g(scale(self._tap_grads(cs_flat, y_flat, spatial)))
+        g_cs = scale(jax.grad(
+            lambda c: self._loss(c, None, y_flat, spatial))(cs_flat))
         if self.post_fn is not None:
             gathered = self._gather(g_cs)
             per = jax.vmap(self.post_fn)(
@@ -261,6 +271,25 @@ class SeqShardedWam:
             )
             return jax.tree_util.tree_map(wsum, per)
         return wsum_g(g_cs)
+
+    def _grads_chunk_impl(self, cs, y_flat, w, spatial, g):
+        """SmoothGrad chunk step (see `_chunk_grads_core`); ``cs`` is the
+        decomposition of the (g·B)-row noisy chunk."""
+        return self._chunk_grads_core(cs, y_flat, w, spatial, g, nan=False)
+
+    def _grads_ig_chunk_impl(self, cs, alphas, y_flat, w, spatial, g):
+        """IG chunk step: coefficients broadcast g× along the batch axis,
+        each group scaled by its α, then the shared core with trapezoid
+        weights (× dx, 0 for pad slots) and nan_to_num (see
+        `_chunk_grads_core`)."""
+
+        def scaled(c):
+            rep = jnp.broadcast_to(c[None], (g,) + c.shape)
+            a = alphas.reshape((g,) + (1,) * c.ndim).astype(c.dtype)
+            return (rep * a).reshape((g * c.shape[0],) + c.shape[1:])
+
+        cs_flat = jax.tree_util.tree_map(scaled, cs)
+        return self._chunk_grads_core(cs_flat, y_flat, w, spatial, g, nan=True)
 
     # -- gradient core (single pass) ---------------------------------------
 
@@ -323,23 +352,53 @@ class SeqShardedWam:
                 i += n_real
         return self._finalize(self._scale(acc, 1.0 / n_samples))
 
-    def integrated(self, x, y, *, n_steps: int, dx: float = 1.0):
+    def integrated(self, x, y, *, n_steps: int, dx: float = 1.0,
+                   sample_chunk: int | None = 1):
         """Trapezoidal path integral of the gradient over α·coeffs — the
         per-element `nan_to_num` and endpoint halving reproduce
         `core.estimators.trapezoid` up to float summation order. Returns
         (gathered coeffs, integral pytree); the caller multiplies by its
-        baseline."""
+        baseline. ``sample_chunk`` batches that many α-steps per dispatch
+        (None = all), same mechanics as `smoothgrad`'s."""
         spatial = tuple(x.shape[-self.ndim:])
         coeffs = self.dec(x)
         alphas = jnp.linspace(0.0, 1.0, n_steps, dtype=jnp.float32)
+
+        def trap_w(i):
+            # a length-1 path is its own both endpoints → weight 1.0
+            if n_steps == 1:
+                return 1.0
+            return 0.5 if i in (0, n_steps - 1) else 1.0
+
+        if sample_chunk is None:
+            sample_chunk = n_steps
         acc = None
-        for i in range(n_steps):
-            # trapezoid endpoint halving; a length-1 path is its own both
-            # endpoints (path[0]/2 + path[-1]/2 = path[0]), weight 1.0
-            w = 1.0 if n_steps == 1 else (0.5 if i in (0, n_steps - 1) else 1.0)
-            g = self._grads_ig(coeffs, alphas[i], y, spatial=spatial)
-            acc = (self._first_nan(g, w * dx) if acc is None
-                   else self._accum_nan(acc, g, w * dx))
+        if sample_chunk <= 1:
+            for i in range(n_steps):
+                g = self._grads_ig(coeffs, alphas[i], y, spatial=spatial)
+                acc = (self._first_nan(g, trap_w(i) * dx) if acc is None
+                       else self._accum_nan(acc, g, trap_w(i) * dx))
+        else:
+            n_chunks = -(-n_steps // min(sample_chunk, n_steps))
+            g_sz = -(-n_steps // n_chunks)
+            y_flat = None if y is None else jnp.tile(jnp.asarray(y), g_sz)
+            alphas_np = alphas.tolist()  # one transfer, not n_steps
+            i = 0
+            while i < n_steps:
+                n_real = min(g_sz, n_steps - i)
+                a_chunk = jnp.asarray(
+                    alphas_np[i:i + n_real] + [0.0] * (g_sz - n_real),
+                    jnp.float32,
+                )
+                w = jnp.asarray(
+                    [trap_w(i + k) * dx for k in range(n_real)]
+                    + [0.0] * (g_sz - n_real),
+                    jnp.float32,
+                )
+                part = self._grads_ig_chunk(coeffs, a_chunk, y_flat, w,
+                                            spatial=spatial, g=g_sz)
+                acc = part if acc is None else self._accum(acc, part, 1.0)
+                i += n_real
         return self._gather(coeffs), self._finalize(acc)
 
 

@@ -321,7 +321,8 @@ class WaveletAttribution1D(BaseWAM1D):
         y = jnp.asarray(y)
         if self.mesh is not None:
             coeffs, (coeff_integ, mel_integ) = self._seq.integrated(
-                x, y, n_steps=self.n_samples
+                x, y, n_steps=self.n_samples,
+                sample_chunk=self._resolve_chunk(x.shape[0]),
             )
             baseline_mel = self._seq_front(x)[:, 0]
             mel_attr = baseline_mel * mel_integ[:, 0, :, :]

@@ -284,8 +284,10 @@ class WaveletAttribution2D(BaseWAM2D):
 
     def integrated_wam(self, x, y):
         if self.mesh is not None:
+            x = jnp.asarray(x)
             coeffs, integral = self._seq.integrated(
-                jnp.asarray(x), jnp.asarray(y), n_steps=self.n_samples
+                x, jnp.asarray(y), n_steps=self.n_samples,
+                sample_chunk=self._resolve_chunk(x.shape),
             )
             baseline = mosaic2d(coeffs, normalize=True, channel_axis=1)
             attr = baseline * integral

@@ -339,7 +339,8 @@ class WaveletAttribution3D(BaseWAM3D):
         if self.mesh is not None:
             y_arr = None if y is None else jnp.asarray(y)
             coeffs, integral = self._seq.integrated(
-                vol, y_arr, n_steps=self.n_samples
+                vol, y_arr, n_steps=self.n_samples,
+                sample_chunk=self._resolve_chunk(vol.shape[0]),
             )
             self.grads = cube3d(coeffs) * integral
         elif y is None:
