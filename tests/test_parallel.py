@@ -376,6 +376,46 @@ def test_sharded_smoothgrad_spmd_pad_and_mask_parity(batch):
                                atol=1e-5)
 
 
+def test_sharded_smoothgrad_spmd_pallas_dwt():
+    """The Pallas DWT must run INSIDE shard_map: jax 0.9's check_vma
+    rejects pallas_call outputs without vma annotations, which crashed the
+    spmd estimator on real TPU (its default dwt2 impl) while the CPU suite
+    silently exercised the conv impl — round-5 review finding. Interpret
+    mode hits the same check, so this is the portable regression."""
+    _need_devices(8)
+    from wam_tpu.parallel import sharded_smoothgrad_spmd
+    from wam_tpu.wavelets import get_dwt2_impl, set_dwt2_impl
+
+    prev = get_dwt2_impl()
+    set_dwt2_impl("pallas")
+    try:
+        rng = np.random.default_rng(3)
+        W = jnp.asarray(rng.standard_normal((16 * 16, 5)), dtype=jnp.float32)
+        eng = WamEngine(_linear_model(W), ndim=2, wavelet="haar", level=2,
+                        mode="reflect")
+        x = jnp.asarray(rng.standard_normal((4, 1, 16, 16)), dtype=jnp.float32)
+        y = jnp.arange(4, dtype=jnp.int32) % 5
+
+        def step_local(noisy, y_l, grad_scale):
+            _, grads = eng.attribute(noisy, y_l)
+            grads = jax.tree_util.tree_map(lambda g: g * grad_scale, grads)
+            return mosaic2d(grads, normalize=False)
+
+        mesh = make_mesh({"sample": 2, "data": 4})
+        runner = sharded_smoothgrad_spmd(step_local, mesh, n_samples=4,
+                                         stdev_spread=0.15)
+        out = runner(x, y, jax.random.PRNGKey(11))
+        # same values as the conv impl through the same runner
+        set_dwt2_impl("conv")
+        want = sharded_smoothgrad_spmd(step_local, mesh, n_samples=4,
+                                       stdev_spread=0.15)(x, y,
+                                                          jax.random.PRNGKey(11))
+        np.testing.assert_allclose(np.asarray(out), np.asarray(want),
+                                   atol=1e-5)
+    finally:
+        set_dwt2_impl(prev)
+
+
 @pytest.mark.slow
 def test_sharded_smoothgrad_spmd_hlo_has_no_model_gather():
     """The spmd variant's compiled HLO must contain NO all-gather at all:

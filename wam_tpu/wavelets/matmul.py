@@ -194,6 +194,13 @@ def _pallas_forward(x3: jax.Array, A: jax.Array, Bt: jax.Array) -> jax.Array:
     h2, w2 = A.shape[0], Bt.shape[1]
     h_out, w_out = h2 // 2, w2 // 2
     interpret = jax.default_backend() != "tpu"
+    # Inside shard_map (check_vma=True, the jax 0.9 default) every output
+    # aval must carry its varying-manual-axes set; the kernel is elementwise
+    # in the grid dim, so outputs vary over exactly the axes the operands
+    # do. Outside shard_map all vmas are empty frozensets — a no-op.
+    out_vma = frozenset().union(
+        *(getattr(jax.typeof(a), "vma", frozenset()) for a in (x3, A, Bt))
+    )
     return pl.pallas_call(
         _fused_kernel,
         grid=(n,),
@@ -205,7 +212,8 @@ def _pallas_forward(x3: jax.Array, A: jax.Array, Bt: jax.Array) -> jax.Array:
         out_specs=pl.BlockSpec(
             (1, 4, h_out, w_out), lambda i: (i, 0, 0, 0), memory_space=pltpu.VMEM
         ),
-        out_shape=jax.ShapeDtypeStruct((n, 4, h_out, w_out), jnp.float32),
+        out_shape=jax.ShapeDtypeStruct((n, 4, h_out, w_out), jnp.float32,
+                                       vma=out_vma),
         interpret=interpret,
     )(A, Bt, x3)
 
