@@ -110,19 +110,26 @@ def sharded_dwt_per(mesh: Mesh, wavelet: str, seq_axis: str = "data"):
     return run
 
 
-def sharded_wavedec_per(mesh: Mesh, wavelet: str, level: int, seq_axis: str = "data"):
+def sharded_wavedec_per(mesh: Mesh, wavelet: str, level: int, seq_axis: str = "data",
+                        batch_axis: str | None = None):
     """Multi-level sharded decomposition: [cA_J, cD_J, ..., cD_1], each leaf
     sharded over ``seq_axis``. Requires the local shard length to stay even
-    at every level (N divisible by shards·2^level)."""
+    at every level (N divisible by shards·2^level).
+
+    ``batch_axis`` additionally shards the LEADING (batch) axis over that
+    mesh axis — without it, devices on non-``seq_axis`` mesh axes replicate
+    the whole computation (round-5: the sample/batch-parallel seq
+    estimator). With ``batch_axis`` the leading axis must divide that mesh
+    axis (checked eagerly)."""
 
     @jax.jit
     @partial(
         shard_map,
         mesh=mesh,
-        in_specs=P(None, seq_axis),
-        out_specs=P(None, seq_axis),
+        in_specs=P(batch_axis, seq_axis),
+        out_specs=P(batch_axis, seq_axis),
     )
-    def run(x_local):
+    def apply(x_local):
         coeffs = []
         a = x_local
         for _ in range(level):
@@ -131,14 +138,35 @@ def sharded_wavedec_per(mesh: Mesh, wavelet: str, level: int, seq_axis: str = "d
         coeffs.append(a)
         return coeffs[::-1]
 
+    def run(x):
+        _check_batch_divisible(x.shape[0], mesh, batch_axis)
+        return apply(x)
+
+    run._apply = apply  # jitted body, exposed for HLO/sharding audits
     return run
 
 
-def _sharded_wavedec_nd(mesh: Mesh, level: int, seq_axis: str, ndim: int, level_fn):
+def _check_batch_divisible(n: int, mesh: Mesh, batch_axis: str | None):
+    """Eager guard for the batch_axis contract: the (flattened) leading
+    axis must divide the batch mesh axis — otherwise shard_map fails at
+    trace time with an opaque divisibility error (round-5 review)."""
+    if batch_axis is not None and n % mesh.shape[batch_axis]:
+        raise ValueError(
+            f"flattened leading axis {n} is not divisible by "
+            f"{batch_axis}={mesh.shape[batch_axis]}: batch_axis sharding "
+            "needs the (product of) leading dims divisible by that mesh "
+            "axis; reshape, pad, or drop batch_axis"
+        )
+
+
+def _sharded_wavedec_nd(mesh: Mesh, level: int, seq_axis: str, ndim: int, level_fn,
+                        batch_axis: str | None = None):
     """Shared multi-level builder for the 2D/3D sharded decompositions:
     shard_map over the sharded spatial axis (first of the trailing ``ndim``),
-    loop ``level_fn`` per level, flatten/restore arbitrary leading dims."""
-    spec = P(*((None, seq_axis) + (None,) * (ndim - 1)))
+    loop ``level_fn`` per level, flatten/restore arbitrary leading dims
+    (``batch_axis`` shards the flattened leading axis — see
+    `sharded_wavedec_per`)."""
+    spec = P(*((batch_axis, seq_axis) + (None,) * (ndim - 1)))
 
     @partial(shard_map, mesh=mesh, in_specs=spec, out_specs=spec)
     def run(x_local):
@@ -156,7 +184,15 @@ def _sharded_wavedec_nd(mesh: Mesh, level: int, seq_axis: str, ndim: int, level_
         out = run(x.reshape((-1,) + x.shape[-ndim:]))
         return jax.tree_util.tree_map(lambda a: a.reshape(lead + a.shape[1:]), out)
 
-    return apply
+    def checked(x):
+        import numpy as _np
+
+        _check_batch_divisible(int(_np.prod(x.shape[:-ndim])) if x.ndim > ndim
+                               else 1, mesh, batch_axis)
+        return apply(x)
+
+    checked._apply = apply  # jitted body, exposed for HLO/sharding audits
+    return checked
 
 
 def _level_fn_2d(wavelet: str, seq_axis: str):
@@ -187,22 +223,26 @@ def _level_fn_3d(wavelet: str, seq_axis: str):
     return level_fn
 
 
-def sharded_wavedec2_per(mesh: Mesh, wavelet: str, level: int, seq_axis: str = "data"):
+def sharded_wavedec2_per(mesh: Mesh, wavelet: str, level: int, seq_axis: str = "data",
+                         batch_axis: str | None = None):
     """Multi-level 2D sharded decomposition for images/feature maps whose
     row axis exceeds one core's memory: x (..., H, W) — any leading dims —
     with H sharded over ``seq_axis``; every output leaf keeps that sharding.
     Bit-compatible with `wam_tpu.wavelets.periodized.wavedec2_per`. Requires
     H divisible by shards·2^level and W divisible by 2^level."""
-    return _sharded_wavedec_nd(mesh, level, seq_axis, 2, _level_fn_2d(wavelet, seq_axis))
+    return _sharded_wavedec_nd(mesh, level, seq_axis, 2,
+                               _level_fn_2d(wavelet, seq_axis), batch_axis)
 
 
-def sharded_wavedec3_per(mesh: Mesh, wavelet: str, level: int, seq_axis: str = "data"):
+def sharded_wavedec3_per(mesh: Mesh, wavelet: str, level: int, seq_axis: str = "data",
+                         batch_axis: str | None = None):
     """Multi-level 3D sharded decomposition for volumes whose depth axis
     exceeds one core's memory: x (..., D, H, W) — any leading dims — with D
     sharded over ``seq_axis``. Bit-compatible with
     `wam_tpu.wavelets.periodized.wavedec3_per`. Requires D divisible by
     shards·2^level and H, W divisible by 2^level."""
-    return _sharded_wavedec_nd(mesh, level, seq_axis, 3, _level_fn_3d(wavelet, seq_axis))
+    return _sharded_wavedec_nd(mesh, level, seq_axis, 3,
+                               _level_fn_3d(wavelet, seq_axis), batch_axis)
 
 
 # ---------------------------------------------------------------------------
@@ -211,7 +251,8 @@ def sharded_wavedec3_per(mesh: Mesh, wavelet: str, level: int, seq_axis: str = "
 # ---------------------------------------------------------------------------
 
 
-def _sharded_waverec_nd(mesh: Mesh, seq_axis: str, ndim: int, level_fn):
+def _sharded_waverec_nd(mesh: Mesh, seq_axis: str, ndim: int, level_fn,
+                        batch_axis: str | None = None):
     """Shared multi-level builder for the sharded reconstructions.
 
     The single-device `idwt*_per` invert via `jax.linear_transpose` of the
@@ -227,7 +268,7 @@ def _sharded_waverec_nd(mesh: Mesh, seq_axis: str, ndim: int, level_fn):
     `linear_transpose` expectation — traced from a plain ShapeDtypeStruct —
     cannot express; the variance check is disabled and correctness is
     pinned by the round-trip/parity tests instead."""
-    spec = P(*((None, seq_axis) + (None,) * (ndim - 1)))
+    spec = P(*((batch_axis, seq_axis) + (None,) * (ndim - 1)))
 
     @partial(shard_map, mesh=mesh, in_specs=spec, out_specs=spec, check_vma=False)
     def run(coeffs):
@@ -249,29 +290,44 @@ def _sharded_waverec_nd(mesh: Mesh, seq_axis: str, ndim: int, level_fn):
         out = run(flat)
         return out.reshape(lead + out.shape[1:])
 
-    return apply
+    def checked(coeffs):
+        import numpy as _np
+
+        lead = jax.tree_util.tree_leaves(coeffs)[0].shape[:-ndim]
+        _check_batch_divisible(int(_np.prod(lead)) if lead else 1,
+                               mesh, batch_axis)
+        return apply(coeffs)
+
+    checked._apply = apply  # jitted body, exposed for HLO/sharding audits
+    return checked
 
 
-def sharded_waverec_per(mesh: Mesh, wavelet: str, seq_axis: str = "data"):
+def sharded_waverec_per(mesh: Mesh, wavelet: str, seq_axis: str = "data",
+                        batch_axis: str | None = None):
     """Inverse of `sharded_wavedec_per`: [cA_J, cD_J, ..., cD_1] — every
     leaf (..., n) sharded over ``seq_axis`` on its last axis — back to the
     (..., N) signal with the same sharding. Exact adjoint inverse,
     bit-compatible with `wam_tpu.wavelets.periodized.waverec_per`."""
     return _sharded_waverec_nd(
-        mesh, seq_axis, 1, lambda t: _local_dwt_with_halo(t, wavelet, seq_axis)
+        mesh, seq_axis, 1, lambda t: _local_dwt_with_halo(t, wavelet, seq_axis),
+        batch_axis,
     )
 
 
-def sharded_waverec2_per(mesh: Mesh, wavelet: str, seq_axis: str = "data"):
+def sharded_waverec2_per(mesh: Mesh, wavelet: str, seq_axis: str = "data",
+                         batch_axis: str | None = None):
     """Inverse of `sharded_wavedec2_per` (rows sharded). Bit-compatible
     with `waverec2_per`."""
-    return _sharded_waverec_nd(mesh, seq_axis, 2, _level_fn_2d(wavelet, seq_axis))
+    return _sharded_waverec_nd(mesh, seq_axis, 2, _level_fn_2d(wavelet, seq_axis),
+                               batch_axis)
 
 
-def sharded_waverec3_per(mesh: Mesh, wavelet: str, seq_axis: str = "data"):
+def sharded_waverec3_per(mesh: Mesh, wavelet: str, seq_axis: str = "data",
+                         batch_axis: str | None = None):
     """Inverse of `sharded_wavedec3_per` (depth sharded). Bit-compatible
     with `waverec3_per`."""
-    return _sharded_waverec_nd(mesh, seq_axis, 3, _level_fn_3d(wavelet, seq_axis))
+    return _sharded_waverec_nd(mesh, seq_axis, 3, _level_fn_3d(wavelet, seq_axis),
+                               batch_axis)
 
 
 def sharded_coeff_grads_per(

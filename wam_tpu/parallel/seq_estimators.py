@@ -89,6 +89,7 @@ class SeqShardedWam:
         front_fn: Callable[[jax.Array], jax.Array] | None = None,
         front_grads: bool = False,
         post_fn: Callable[[Any], Any] | None = None,
+        batch_axis: str | None = None,
     ):
         if ndim not in (1, 2, 3):
             raise ValueError(f"ndim must be 1, 2 or 3, got {ndim}")
@@ -96,17 +97,37 @@ class SeqShardedWam:
             raise ValueError("front_grads=True requires front_fn")
         if front_grads and post_fn is not None:
             raise ValueError("front_grads and post_fn are mutually exclusive")
+        if batch_axis is not None and mode != "periodization":
+            # the expansive-mode (core+tail) builders pin their shard_map
+            # specs to a replicated leading axis; only the periodized path
+            # threads batch_axis so far
+            raise ValueError(
+                "batch_axis= is currently supported with "
+                "mode='periodization' only"
+            )
+        if batch_axis is not None:
+            if batch_axis not in mesh.axis_names:
+                raise ValueError(
+                    f"batch_axis {batch_axis!r} is not a mesh axis "
+                    f"{tuple(mesh.axis_names)}"
+                )
+            if batch_axis == seq_axis:
+                raise ValueError("batch_axis must differ from seq_axis")
         self.mesh = mesh
         self.ndim = ndim
         self.seq_axis = seq_axis
+        self.batch_axis = batch_axis
         self.front_fn = front_fn
         self.front_grads = front_grads
         self.post_fn = post_fn
         self.model_fn = model_fn
         self.periodized = mode == "periodization"
         if self.periodized:
-            self.dec = _DEC_PER[ndim](mesh, wavelet, level, seq_axis)
-            rec = _REC_PER[ndim](mesh, wavelet, seq_axis)
+            # batch_axis shards the LEADING axis over the remaining mesh —
+            # without it, devices off the seq axis replicate all compute
+            self.dec = _DEC_PER[ndim](mesh, wavelet, level, seq_axis,
+                                      batch_axis)
+            rec = _REC_PER[ndim](mesh, wavelet, seq_axis, batch_axis)
             self._rec_signal = rec
             self._gather = lambda tree: tree  # leaves already plain arrays
         else:
@@ -209,6 +230,7 @@ class SeqShardedWam:
         k = jax.random.fold_in(key, i)
         n = jax.random.normal(k, x.shape, x.dtype) * sigma
         spec = [None] * x.ndim
+        spec[0] = self.batch_axis
         spec[x.ndim - self.ndim] = self.seq_axis
         n = lax.with_sharding_constraint(n, NamedSharding(self.mesh, P(*spec)))
         return x + n
@@ -226,13 +248,21 @@ class SeqShardedWam:
             return jax.random.normal(k, x.shape, x.dtype) * sigma
 
         noise = jax.vmap(draw)(i0 + jnp.arange(g, dtype=jnp.int32))
+        # seq-only constraint pre-flatten (g alone may not divide the batch
+        # axis); the flattened g·B form below carries the batch sharding
         spec = [None] * (x.ndim + 1)
         spec[1 + x.ndim - self.ndim] = self.seq_axis
         noise = lax.with_sharding_constraint(
             noise, NamedSharding(self.mesh, P(*spec))
         )
         noisy = x[None] + noise
-        return noisy.reshape((-1,) + x.shape[1:])
+        flat_spec = [None] * x.ndim
+        flat_spec[0] = self.batch_axis
+        flat_spec[x.ndim - self.ndim] = self.seq_axis
+        return lax.with_sharding_constraint(
+            noisy.reshape((-1,) + x.shape[1:]),
+            NamedSharding(self.mesh, P(*flat_spec)),
+        )
 
     def _chunk_grads_core(self, cs_flat, y_flat, w, spatial, g, nan: bool):
         """Shared chunked gradient core: grads over a (g·B)-row flattened

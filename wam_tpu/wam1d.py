@@ -185,6 +185,7 @@ class WaveletAttribution1D(BaseWAM1D):
         stream_noise: bool = False,
         mesh=None,
         seq_axis: str = "data",
+        batch_axis: str | None = None,
     ):
         super().__init__(
             model_fn,
@@ -219,8 +220,11 @@ class WaveletAttribution1D(BaseWAM1D):
         # surface, SURVEY.md §5.6).
         self._jit_smooth = jax.jit(self._smooth_impl)
         self._jit_ig = jax.jit(self._ig_impl)
+        if mesh is None and batch_axis is not None:
+            raise ValueError("batch_axis= requires mesh=")
         self.mesh = mesh
         self.seq_axis = seq_axis
+        self.batch_axis = batch_axis
         if mesh is not None:
             from wam_tpu.parallel.seq_estimators import SeqShardedWam
 
@@ -244,6 +248,7 @@ class WaveletAttribution1D(BaseWAM1D):
                 seq_axis=seq_axis,
                 front_fn=seq_front,
                 front_grads=True,
+                batch_axis=batch_axis,
             )
 
     def _resolve_chunk(self, batch: int) -> int | None:

@@ -150,6 +150,7 @@ class WaveletAttribution2D(BaseWAM2D):
         model_layout: str = "nchw",
         mesh=None,
         seq_axis: str = "data",
+        batch_axis: str | None = None,
     ):
         super().__init__(
             model_fn,
@@ -181,9 +182,13 @@ class WaveletAttribution2D(BaseWAM2D):
                 mode=mode,
                 seq_axis=seq_axis,
                 post_fn=lambda g: mosaic2d(g, normalize_coeffs, 1),
+                batch_axis=batch_axis,
             )
+        if mesh is None and batch_axis is not None:
+            raise ValueError("batch_axis= requires mesh=")
         self.mesh = mesh
         self.seq_axis = seq_axis
+        self.batch_axis = batch_axis
         if method not in ("smooth", "integratedgrad"):
             raise ValueError(f"Unknown method {method!r}")
         validate_sample_batch_size(sample_batch_size)

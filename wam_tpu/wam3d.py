@@ -207,6 +207,7 @@ class WaveletAttribution3D(BaseWAM3D):
         stream_noise: bool = False,
         mesh=None,
         seq_axis: str = "data",
+        batch_axis: str | None = None,
     ):
         super().__init__(
             model_fn,
@@ -234,9 +235,13 @@ class WaveletAttribution3D(BaseWAM3D):
                 mode=mode,
                 seq_axis=seq_axis,
                 post_fn=cube3d,
+                batch_axis=batch_axis,
             )
+        if mesh is None and batch_axis is not None:
+            raise ValueError("batch_axis= requires mesh=")
         self.mesh = mesh
         self.seq_axis = seq_axis
+        self.batch_axis = batch_axis
         if method not in ("smooth", "integratedgrad"):
             raise ValueError(f"Unknown method {method!r}")
         validate_sample_batch_size(sample_batch_size)
