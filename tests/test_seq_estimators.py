@@ -361,9 +361,48 @@ def test_seq_sharded_batch_axis_parity_and_split():
     spec = in_shardings[0].spec
     assert tuple(spec) == ("batch", "data"), spec
 
+    # 2D/3D expansive modes stay gated (batch-concat in their inverses)
     with pytest.raises(ValueError, match="periodization"):
-        SeqShardedWam(mesh2, model, ndim=1, wavelet="db2", level=2,
+        SeqShardedWam(mesh2, model, ndim=2, wavelet="db2", level=2,
                       mode="symmetric", batch_axis="batch")
+
+
+@pytest.mark.parametrize("wavelet,mode", [("db2", "symmetric"),
+                                          ("db6", "reflect")])
+def test_seq_sharded_batch_axis_expansive_1d(wavelet, mode):
+    """batch_axis through the 1D EXPANSIVE (core+tail) path: parity vs the
+    seq-only mesh, cores and tails both carrying the batch sharding."""
+    _need_devices(8)
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from wam_tpu.models.audio import toy_wave_model
+    from wam_tpu.parallel.seq_estimators import SeqShardedWam
+
+    model = toy_wave_model(jax.random.PRNGKey(0))
+    x_host = jax.random.normal(jax.random.PRNGKey(1), (8, 4096))
+    y = jnp.arange(8, dtype=jnp.int32) % 4
+    key = jax.random.PRNGKey(9)
+
+    mesh1 = make_mesh({"data": 8})
+    sw1 = SeqShardedWam(mesh1, model, ndim=1, wavelet=wavelet, level=2,
+                        mode=mode)
+    want = sw1.smoothgrad(_put_seq(x_host, mesh1, 1), y, key,
+                          n_samples=4, stdev_spread=0.1, sample_chunk=2)
+
+    mesh2 = make_mesh({"batch": 2, "data": 4})
+    sw2 = SeqShardedWam(mesh2, model, ndim=1, wavelet=wavelet, level=2,
+                        mode=mode, batch_axis="batch")
+    x2 = jax.device_put(x_host, NamedSharding(mesh2, P("batch", "data")))
+    got = sw2.smoothgrad(x2, y, key, n_samples=4, stdev_spread=0.1,
+                         sample_chunk=2)
+    for a, b in zip(got, want):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+
+    # the split must be REAL: the dec stage's compiled input carries the
+    # batch axis (a regression to P(None, seq) is numerically invisible)
+    noisy = sw2._noisy_chunk(x2, key, jnp.int32(0),
+                             jnp.asarray(0.1, x2.dtype), g=2)
+    spec = sw2.dec._apply.lower(noisy).compile().input_shardings[0][0].spec
+    assert tuple(spec)[:2] == ("batch", "data"), spec
 
 
 def test_seq_sharded_grads_hlo_no_signal_sized_gather():

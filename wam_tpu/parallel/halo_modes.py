@@ -212,12 +212,13 @@ def _tail_coeffs(core: jax.Array, tail: jax.Array, wav: Wavelet, mode: str, repl
     return out
 
 
-def _build_core_run(mesh: Mesh, wav: Wavelet, mode: str, seq_axis: str):
+def _build_core_run(mesh: Mesh, wav: Wavelet, mode: str, seq_axis: str,
+                    batch_axis: str | None = None):
     return shard_map(
         partial(_core_local, wav=wav, mode=mode, seq_axis=seq_axis),
         mesh=mesh,
-        in_specs=P(None, seq_axis),
-        out_specs=P(None, None, seq_axis),
+        in_specs=P(batch_axis, seq_axis),
+        out_specs=P(batch_axis, None, seq_axis),
     )
 
 
@@ -248,18 +249,22 @@ def _level_1d(core, tail, core_run, wav, mode, repl_sh=None):
 
 
 def sharded_wavedec_mode(
-    mesh: Mesh, wavelet, level: int, mode: str = "symmetric", seq_axis: str = "data"
+    mesh: Mesh, wavelet, level: int, mode: str = "symmetric", seq_axis: str = "data",
+    batch_axis: str | None = None
 ):
     """Multi-level 1D decomposition with pywt boundary modes, sequence-
     sharded over ``seq_axis`` on the LAST axis. Returns a function
     `x -> [cA_J, cD_J, ..., cD_1]` of `TailedLeaf` pairs; `gather_coeffs`
-    reproduces `transform.wavedec(x, wavelet, level, mode)` exactly."""
+    reproduces `transform.wavedec(x, wavelet, level, mode)` exactly.
+    ``batch_axis`` additionally shards the flattened LEADING axis over that
+    mesh axis (cores AND the O(L) tails — the tails stay replicated along
+    the sequence axis only); the flattened leading dims must divide it."""
     wav = _resolve(wavelet)
     _check_mode(mode)
     k = mesh.shape[seq_axis]
-    core_run = _build_core_run(mesh, wav, mode, seq_axis)
-    sh = NamedSharding(mesh, P(None, seq_axis))
-    repl = NamedSharding(mesh, P(None, None))
+    core_run = _build_core_run(mesh, wav, mode, seq_axis, batch_axis)
+    sh = NamedSharding(mesh, P(batch_axis, seq_axis))
+    repl = NamedSharding(mesh, P(batch_axis, None))
 
     @jax.jit
     def apply(x):
@@ -282,7 +287,11 @@ def sharded_wavedec_mode(
         ]
 
     def run(x):
+        from wam_tpu.parallel.halo import _check_batch_divisible
+
         _check_divisibility(x.shape[-1], k, wav.filt_len, level, "sequence axis")
+        _check_batch_divisible(int(np.prod(x.shape[:-1])) if x.ndim > 1 else 1,
+                               mesh, batch_axis)
         return apply(x)
 
     run._apply = apply  # jitted body, exposed for HLO audits (tests)
@@ -469,8 +478,9 @@ def _level_inv_1d(coreA, tailA, coreD, tailD, synth_run, wav, repl_sh=None):
         # partitioner derives a conv's sharding from its operands, so an
         # output-side constraint alone lands after the internal squeeze and
         # the conv still gets spatially partitioned into zero-size pieces
+        # (the batch entry of repl_sh rides along — batch_axis support)
         tail_subs = lax.with_sharding_constraint(
-            tail_subs, NamedSharding(repl_sh.mesh, P(None, None, None))
+            tail_subs, NamedSharding(repl_sh.mesh, P(repl_sh.spec[0], None, None))
         )
     core_out = synth_run(subs, tail_subs[..., :h])
     t_len = max(2 * T - L + 2, 0)
@@ -516,27 +526,31 @@ def _check_coeff_leaves(coeffs, wav: Wavelet, axis: int, k: int,
                 )
 
 
-def _build_synth_run(mesh: Mesh, wav: Wavelet, seq_axis: str):
+def _build_synth_run(mesh: Mesh, wav: Wavelet, seq_axis: str,
+                     batch_axis: str | None = None):
     return shard_map(
         partial(_synth_core_local, wav=wav, seq_axis=seq_axis),
         mesh=mesh,
-        in_specs=(P(None, None, seq_axis), P(None, None, None)),
-        out_specs=P(None, seq_axis),
+        in_specs=(P(batch_axis, None, seq_axis), P(batch_axis, None, None)),
+        out_specs=P(batch_axis, seq_axis),
     )
 
 
-def sharded_waverec_mode(mesh: Mesh, wavelet, seq_axis: str = "data"):
+def sharded_waverec_mode(mesh: Mesh, wavelet, seq_axis: str = "data",
+                         batch_axis: str | None = None):
     """Inverse of `sharded_wavedec_mode`: the TailedLeaf coefficient list
     back to the (..., N) signal as a `TailedLeaf` (core (..., 2C_top)
     sharded, tail replicated; `gather_leaf` yields the full signal).
     Matches `transform.waverec` exactly — including its trim-to-detail
-    convention, which in core+tail form touches only the replicated tail."""
+    convention, which in core+tail form touches only the replicated tail.
+    ``batch_axis``: see `sharded_wavedec_mode`."""
     wav = _resolve(wavelet)
-    synth_run = _build_synth_run(mesh, wav, seq_axis)
-    # pin every tail op replicated: left to propagation, the partitioner may
-    # try to shard a length-~L tail conv over the mesh, producing zero-size
-    # partitions and an invalid reshape ("failed after spmd-partitioning")
-    repl = NamedSharding(mesh, P(None, None))
+    synth_run = _build_synth_run(mesh, wav, seq_axis, batch_axis)
+    # pin every tail op replicated ALONG THE SEQ AXIS (batch may shard):
+    # left to propagation, the partitioner may try to shard a length-~L
+    # tail conv over the mesh, producing zero-size partitions and an
+    # invalid reshape ("failed after spmd-partitioning")
+    repl = NamedSharding(mesh, P(batch_axis, None))
 
     @jax.jit
     def apply(coeffs):
@@ -563,8 +577,13 @@ def sharded_waverec_mode(mesh: Mesh, wavelet, seq_axis: str = "data"):
     k = mesh.shape[seq_axis]
 
     def run(coeffs):
+        from wam_tpu.parallel.halo import _check_batch_divisible
+
         _check_coeff_leaves(coeffs, wav, -1, k, "sharded_wavedec_mode",
                             "length")
+        lead = coeffs[0].core.shape[:-1]
+        _check_batch_divisible(int(np.prod(lead)) if lead else 1,
+                               mesh, batch_axis)
         return apply(coeffs)
 
     run._apply = apply  # jitted body, exposed for HLO audits (tests)

@@ -97,13 +97,15 @@ class SeqShardedWam:
             raise ValueError("front_grads=True requires front_fn")
         if front_grads and post_fn is not None:
             raise ValueError("front_grads and post_fn are mutually exclusive")
-        if batch_axis is not None and mode != "periodization":
-            # the expansive-mode (core+tail) builders pin their shard_map
-            # specs to a replicated leading axis; only the periodized path
-            # threads batch_axis so far
+        if batch_axis is not None and mode != "periodization" and ndim != 1:
+            # the 2D/3D expansive-mode inverses batch several subband
+            # letters through one shard_map call by CONCATENATING along the
+            # leading axis — sharded-batch concat there is unresolved, so
+            # batch_axis covers periodization (all ndim) and the 1D
+            # expansive path
             raise ValueError(
-                "batch_axis= is currently supported with "
-                "mode='periodization' only"
+                "batch_axis= supports mode='periodization' (any ndim) "
+                "or ndim=1 expansive modes"
             )
         if batch_axis is not None:
             if batch_axis not in mesh.axis_names:
@@ -130,6 +132,12 @@ class SeqShardedWam:
             rec = _REC_PER[ndim](mesh, wavelet, seq_axis, batch_axis)
             self._rec_signal = rec
             self._gather = lambda tree: tree  # leaves already plain arrays
+        elif ndim == 1 and batch_axis is not None:
+            self.dec = _DEC_MODE[1](mesh, wavelet, level, mode, seq_axis,
+                                    batch_axis)
+            rec = _REC_MODE[1](mesh, wavelet, seq_axis, batch_axis)
+            self._rec_signal = lambda cs: gather_leaf(rec(cs), axis=-1)
+            self._gather = lambda tree: gather_coeffs(tree, ndim=1)
         else:
             self.dec = _DEC_MODE[ndim](mesh, wavelet, level, mode, seq_axis)
             rec = _REC_MODE[ndim](mesh, wavelet, seq_axis)
