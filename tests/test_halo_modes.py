@@ -10,7 +10,7 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
-from conftest import need_devices, scan_gathers
+from conftest import need_devices, need_modern_shard_map, scan_gathers
 from wam_tpu.parallel import make_mesh
 from wam_tpu.parallel.halo_modes import (
     gather_coeffs,
@@ -22,6 +22,7 @@ from wam_tpu.wavelets.transform import wavedec, wavedec2, wavedec3
 
 
 _need_devices = need_devices
+_need_modern_shard_map = need_modern_shard_map
 
 
 @pytest.mark.parametrize("wavelet", ["haar", "db4", "sym3"])
@@ -145,6 +146,7 @@ def _audit_hlo(run, x, mesh, spec, gather_cap):
 
 def test_sharded_wavedec_mode_hlo_no_signal_sized_gather():
     _need_devices(8)
+    _need_modern_shard_map("old GSPMD inserts a signal-sized all-gather here")
     from jax.sharding import PartitionSpec as P
 
     mesh = make_mesh({"data": 8})
@@ -160,6 +162,7 @@ def test_sharded_wavedec2_mode_hlo_no_signal_sized_gather():
     which GSPMD cannot represent — it replicates the whole signal. The
     local W analysis must therefore run inside shard_map."""
     _need_devices(8)
+    _need_modern_shard_map("old GSPMD inserts a signal-sized all-gather here")
     from jax.sharding import PartitionSpec as P
 
     mesh = make_mesh({"data": 8})
@@ -171,6 +174,7 @@ def test_sharded_wavedec2_mode_hlo_no_signal_sized_gather():
 
 def test_sharded_wavedec3_mode_hlo_no_signal_sized_gather():
     _need_devices(8)
+    _need_modern_shard_map("old GSPMD inserts a signal-sized all-gather here")
     from jax.sharding import PartitionSpec as P
 
     mesh = make_mesh({"data": 8})
@@ -251,6 +255,7 @@ def test_sharded_coeff_grads_mode_hlo_no_signal_sized_gather():
     model's own collectives; the reconstruction feeding the model is evenly
     sharded because the top-level tail is empty."""
     _need_devices(8)
+    _need_modern_shard_map("old GSPMD inserts a signal-sized all-gather here")
     from jax.sharding import NamedSharding, PartitionSpec as P
     from wam_tpu.models.audio import toy_wave_model
     from wam_tpu.parallel.halo_modes import sharded_coeff_grads_mode

@@ -1,0 +1,332 @@
+"""Serving runtime (`wam_tpu/serve/`): bucket routing and padding
+correctness, the one-compile-per-bucket guarantee, backpressure, deadline
+timeouts, CPU-fallback degradation, and the metrics ledger schema.
+
+The operational tests (backpressure/deadline/fallback) drive the worker
+loop with GATED fake entries — a threading.Event handshake instead of
+sleeps, so the queue states they assert are deterministic and the tests
+stay inside the tier-1 time budget."""
+
+import json
+import threading
+
+import jax
+import numpy as np
+import pytest
+
+from wam_tpu.serve import (
+    AttributionServer,
+    Bucket,
+    BucketTable,
+    DeadlineExceededError,
+    NoBucketError,
+    QueueFullError,
+    ServeMetrics,
+    ServerClosedError,
+    pad_item,
+)
+
+
+# -- shape bucketing ----------------------------------------------------------
+
+
+def test_bucket_table_selects_smallest_fit():
+    table = BucketTable([(1, 64, 64), (1, 32, 32), (1, 48, 48)])
+    assert table.select((1, 32, 32)).shape == (1, 32, 32)
+    assert table.select((1, 20, 20)).shape == (1, 32, 32)  # least pad waste
+    assert table.select((1, 33, 32)).shape == (1, 48, 48)  # every dim must fit
+    assert table.select((1, 64, 64)).shape == (1, 64, 64)
+    with pytest.raises(NoBucketError):
+        table.select((1, 65, 64))  # too big for every bucket
+    with pytest.raises(NoBucketError):
+        table.select((32, 32))  # rank mismatch never fits
+    with pytest.raises(ValueError):
+        BucketTable([(1, 32, 32), (1, 32, 32)])  # duplicates
+    with pytest.raises(ValueError):
+        BucketTable([])
+
+
+def test_pad_item_and_waste():
+    b = Bucket.of((1, 8, 8))
+    x = np.arange(2 * 3, dtype=np.float32).reshape(1, 2, 3)
+    padded = pad_item(x, b)
+    assert padded.shape == (1, 8, 8)
+    np.testing.assert_array_equal(padded[:, :2, :3], x)
+    assert padded.sum() == x.sum()  # zero fill
+    assert b.pad_waste(x.shape) == pytest.approx(1.0 - 6 / 64)
+    assert b.pad_waste((1, 8, 8)) == 0.0
+    assert pad_item(padded, b) is padded  # exact fit: no copy
+
+
+def test_serve_config_bucket_parsing():
+    from wam_tpu.config import ServeConfig
+
+    cfg = ServeConfig(buckets="3x224x224, 3x256x256,32768")
+    assert cfg.bucket_shapes() == [(3, 224, 224), (3, 256, 256), (32768,)]
+    assert ServeConfig().bucket_shapes() == []
+
+
+# -- padding correctness through a real engine --------------------------------
+
+
+def _toy_wam2d():
+    from wam_tpu.models.toy import toy_conv_model
+    from wam_tpu.wam2d import BaseWAM2D
+
+    toy = toy_conv_model(jax.random.PRNGKey(0), ndim=2)
+    return BaseWAM2D(lambda x: toy(x.mean(axis=1)), J=2)
+
+
+def test_batch_pad_matches_unbatched_reference():
+    """A lone request in a replicate-padded max_batch=4 batch must come back
+    identical to the unbatched engine call: duplicate rows cannot move the
+    mosaic's per-block max-normalizer (serve.buckets docstring)."""
+    wam = _toy_wam2d()
+    x = np.asarray(jax.random.normal(jax.random.PRNGKey(1), (1, 16, 16)))
+    ref = np.asarray(wam(x[None], np.asarray([2])))[0]
+
+    server = AttributionServer(
+        wam.serve_entry(), [(1, 16, 16)], max_batch=4, warmup=False
+    )
+    try:
+        got = server.attribute(x, 2)
+    finally:
+        server.close()
+    np.testing.assert_allclose(got, ref, atol=1e-6)
+
+
+def test_spatial_pad_matches_padded_reference():
+    """A spatially padded request equals the engine run on the zero-padded
+    input — the serve result IS the padded input's attribution (the
+    documented trade; it is not the unpadded input's)."""
+    wam = _toy_wam2d()
+    bucket = Bucket.of((1, 16, 16))
+    x_small = np.asarray(jax.random.normal(jax.random.PRNGKey(2), (1, 12, 12)))
+    ref = np.asarray(wam(pad_item(x_small, bucket)[None], np.asarray([1])))[0]
+
+    server = AttributionServer(
+        wam.serve_entry(), [bucket.shape], max_batch=4, warmup=False
+    )
+    try:
+        got = server.attribute(x_small, 1)
+    finally:
+        server.close()
+    np.testing.assert_allclose(got, ref, atol=1e-6)
+
+
+def test_mixed_stream_compiles_once_per_bucket():
+    """A >= 3-shape request stream (exact and undersized fits) compiles
+    exactly once per bucket — at warmup — asserted via the jit cache-miss
+    counter wired through serve_entry(on_trace=...)."""
+    wam = _toy_wam2d()
+    metrics = ServeMetrics()
+    shapes = [(1, 8, 8), (1, 16, 16), (1, 24, 24)]
+    server = AttributionServer(
+        wam.serve_entry(on_trace=metrics.note_compile),
+        shapes,
+        max_batch=2,
+        metrics=metrics,
+    )
+    assert metrics.compile_count == len(shapes)  # warmup compiled each bucket
+    stream = [(1, 8, 8), (1, 16, 16), (1, 24, 24), (1, 6, 6), (1, 12, 12),
+              (1, 20, 20), (1, 8, 8), (1, 24, 24)]
+    try:
+        for i, shape in enumerate(stream):
+            x = np.asarray(jax.random.normal(jax.random.PRNGKey(i), shape))
+            out = server.attribute(x, i % 4)
+            assert out.shape[-1] == out.shape[-2]  # a mosaic came back
+    finally:
+        server.close()
+    assert metrics.compile_count == len(shapes)  # zero hot-path compiles
+    assert metrics.completed == len(stream)
+
+
+# -- operational semantics (gated fake entries) -------------------------------
+
+
+class _GateEntry:
+    """Fake entry that parks the worker thread inside the dispatch until
+    released — deterministic queue buildup without sleeps."""
+
+    def __init__(self):
+        self.entered = threading.Event()
+        self.release = threading.Event()
+        self.calls = 0
+
+    def __call__(self, xs, ys):
+        self.calls += 1
+        self.entered.set()
+        assert self.release.wait(timeout=10), "test gate never released"
+        return np.asarray(xs) * 2.0
+
+
+def test_backpressure_rejects_with_retry_after():
+    entry = _GateEntry()
+    server = AttributionServer(
+        entry, [(4,)], max_batch=1, max_wait_ms=0.0, queue_depth=2,
+        warmup=False,
+    )
+    x = np.zeros((4,), np.float32)
+    try:
+        first = server.submit(x, 0)
+        assert entry.entered.wait(timeout=10)  # worker is parked in dispatch
+        server.submit(x, 0)
+        server.submit(x, 0)  # queue now holds queue_depth items
+        with pytest.raises(QueueFullError) as ei:
+            server.submit(x, 0)
+        assert ei.value.retry_after_s > 0
+        assert server.metrics.rejected == 1
+        entry.release.set()
+        np.testing.assert_array_equal(first.result(timeout=10), x * 2.0)
+    finally:
+        entry.release.set()
+        server.close()
+    assert server.metrics.completed == 3  # the admitted requests all served
+
+
+def test_deadline_lapses_while_queued():
+    entry = _GateEntry()
+    server = AttributionServer(
+        entry, [(4,)], max_batch=1, max_wait_ms=0.0, queue_depth=8,
+        warmup=False,
+    )
+    x = np.zeros((4,), np.float32)
+    try:
+        first = server.submit(x, 0)
+        assert entry.entered.wait(timeout=10)
+        doomed = server.submit(x, 0, deadline_ms=30.0)
+        threading.Event().wait(0.1)  # let the deadline lapse while queued
+        entry.release.set()
+        first.result(timeout=10)
+        with pytest.raises(DeadlineExceededError):
+            doomed.result(timeout=10)
+    finally:
+        entry.release.set()
+        server.close()
+    assert server.metrics.expired == 1
+
+
+def test_submit_validation_and_close():
+    server = AttributionServer(
+        lambda xs, ys: np.asarray(xs), [(4,)], max_batch=1, warmup=False
+    )
+    x = np.zeros((4,), np.float32)
+    with pytest.raises(ValueError, match="label"):
+        server.submit(x)  # labeled server needs y
+    with pytest.raises(NoBucketError):
+        server.submit(np.zeros((5,), np.float32), 0)
+    server.close()
+    with pytest.raises(ServerClosedError):
+        server.submit(x, 0)
+
+
+def test_unlabeled_server():
+    server = AttributionServer(
+        lambda xs, ys: np.asarray(xs) + (0.0 if ys is None else 1.0),
+        [(4,)], max_batch=2, labeled=False, warmup=False,
+    )
+    x = np.arange(4, dtype=np.float32)
+    try:
+        with pytest.raises(ValueError, match="unlabeled"):
+            server.submit(x, 3)
+        np.testing.assert_array_equal(server.attribute(x), x)  # ys stayed None
+    finally:
+        server.close()
+
+
+def test_cpu_fallback_on_device_loss(monkeypatch):
+    """Entry raises mid-run + forced re-probe says the accelerator is gone
+    -> the server swaps in the fallback entry once, replays the batch on
+    it, and keeps serving degraded."""
+    from wam_tpu import config as wconfig
+
+    calls = {"probe": 0}
+
+    def fake_probe(timeout_s: float = 180.0, force: bool = False):
+        calls["probe"] += 1
+        assert force  # the runtime must force a re-probe, not read the cache
+        return False  # accelerator is gone
+
+    monkeypatch.setattr(wconfig, "probe_accelerator", fake_probe)
+
+    def dying_entry(xs, ys):
+        raise RuntimeError("device lost")
+
+    server = AttributionServer(
+        dying_entry, [(4,)], max_batch=1, warmup=False,
+        fallback_factory=lambda: (lambda xs, ys: np.asarray(xs) * 3.0),
+    )
+    x = np.ones((4,), np.float32)
+    try:
+        out = server.attribute(x, 0)
+        np.testing.assert_array_equal(out, x * 3.0)
+        assert server.degraded
+        assert calls["probe"] == 1
+        assert server.metrics.fallbacks >= 1
+        # later batches go straight to the fallback — no re-probe, no raise
+        np.testing.assert_array_equal(server.attribute(x, 1), x * 3.0)
+        assert calls["probe"] == 1
+    finally:
+        server.close()
+
+
+def test_healthy_accelerator_reraises(monkeypatch):
+    """An in-process bug with a HEALTHY accelerator must re-raise to the
+    caller, not silently degrade."""
+    from wam_tpu import config as wconfig
+
+    monkeypatch.setattr(
+        wconfig, "probe_accelerator", lambda timeout_s=180.0, force=False: True
+    )
+
+    def buggy_entry(xs, ys):
+        raise RuntimeError("actual bug")
+
+    server = AttributionServer(
+        buggy_entry, [(4,)], max_batch=1, warmup=False,
+        fallback_factory=lambda: (lambda xs, ys: np.asarray(xs)),
+    )
+    try:
+        with pytest.raises(RuntimeError, match="actual bug"):
+            server.attribute(np.ones((4,), np.float32), 0)
+        assert not server.degraded
+        assert server.metrics.failed == 1
+    finally:
+        server.close()
+
+
+# -- metrics ledger -----------------------------------------------------------
+
+
+def test_metrics_ledger_schema(tmp_path):
+    path = str(tmp_path / "serve.jsonl")
+    server = AttributionServer(
+        lambda xs, ys: np.asarray(xs), [(4,), (8,)], max_batch=2,
+        warmup=False, metrics_path=path,
+    )
+    for i in range(5):
+        server.attribute(np.zeros((4 if i % 2 else 8,), np.float32), 0)
+    server.close()  # drains + emits
+
+    rows = [json.loads(line) for line in open(path)]
+    batches = [r for r in rows if r["metric"] == "serve_batch"]
+    summaries = [r for r in rows if r["metric"] == "serve_summary"]
+    assert batches and len(summaries) == 1
+    for r in batches:
+        assert 0.0 < r["fill_ratio"] <= 1.0
+        assert 0.0 <= r["pad_waste"] < 1.0
+        assert r["service_s"] >= 0.0 and r["queue_depth"] >= 0
+    s = summaries[0]
+    assert s["completed"] == 5 and s["submitted"] == 5
+    assert s["latency_p50_ms"] > 0.0 and s["latency_p99_ms"] >= s["latency_p50_ms"]
+    assert s["attributions_per_s"] > 0.0
+    assert s["compile_count"] == 0  # plain-python entry never traces
+    assert "assemble" in s["stages"] and "dispatch" in s["stages"]
+    assert s["config"]["max_batch"] == 2  # describe() rode along
+
+
+def test_percentile_ms_empty_is_nan():
+    from wam_tpu.serve import percentile_ms
+
+    assert np.isnan(percentile_ms([], 50))
+    assert percentile_ms([0.1], 50) == pytest.approx(100.0)

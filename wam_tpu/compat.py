@@ -1,0 +1,40 @@
+"""JAX version-compatibility shims.
+
+The parallel stack is written against the stabilized `jax.shard_map`
+surface (top-level export, ``check_vma=`` knob). Older jax (< 0.6, e.g. the
+0.4.x line) ships the same functionality as
+`jax.experimental.shard_map.shard_map` with the knob spelled ``check_rep=``.
+This module resolves whichever is available so every call site imports
+`shard_map` from here and keeps writing the modern spelling.
+"""
+
+from __future__ import annotations
+
+__all__ = ["shard_map", "axis_size"]
+
+try:
+    from jax import shard_map  # jax >= 0.6: stable top-level export
+except ImportError:  # pragma: no cover - exercised on jax 0.4.x only
+    from jax.experimental.shard_map import shard_map as _experimental_shard_map
+
+    def shard_map(f=None, /, **kwargs):
+        """`jax.experimental.shard_map.shard_map` with the modern kwarg
+        spelling: ``check_vma=`` maps onto the experimental ``check_rep=``.
+        Supports both direct calls and the `partial(shard_map, ...)`
+        decorator idiom used across wam_tpu.parallel."""
+        if "check_vma" in kwargs:
+            kwargs["check_rep"] = kwargs.pop("check_vma")
+        if f is None:
+            return lambda g: _experimental_shard_map(g, **kwargs)
+        return _experimental_shard_map(f, **kwargs)
+
+
+try:
+    from jax.lax import axis_size  # jax >= 0.6
+except ImportError:  # pragma: no cover - exercised on jax 0.4.x only
+    from jax.core import axis_frame as _axis_frame
+
+    def axis_size(axis_name) -> int:
+        """Static size of a mapped mesh axis inside shard_map — on jax
+        0.4.x `jax.core.axis_frame(name)` already returns the plain int."""
+        return _axis_frame(axis_name)

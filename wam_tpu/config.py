@@ -20,6 +20,7 @@ __all__ = [
     "WAM1DConfig",
     "WAM3DConfig",
     "EvalConfig",
+    "ServeConfig",
     "select_backend",
     "enable_compilation_cache",
     "add_config_args",
@@ -30,20 +31,22 @@ __all__ = [
 _probe_result: bool | None = None
 
 
-def probe_accelerator(timeout_s: float = 180.0) -> bool:
+def probe_accelerator(timeout_s: float = 180.0, force: bool = False) -> bool:
     """Check in a SUBPROCESS whether the accelerator backend can initialize.
 
     The axon TPU plugin can block indefinitely inside client creation when
     its pool is unreachable, so a simple try/except in-process would hang;
     a throwaway subprocess with a hard timeout is the only safe probe.
-    The answer cannot change within a process, so it is cached after the
-    first call.
+    The answer rarely changes within a process, so it is cached after the
+    first call; ``force=True`` re-probes (and refreshes the cache) — the
+    serving runtime uses this to distinguish a mid-run device loss from an
+    in-process bug before degrading to its CPU fallback entry.
     """
     import subprocess
     import sys
 
     global _probe_result
-    if _probe_result is not None:
+    if _probe_result is not None and not force:
         return _probe_result
     try:
         proc = subprocess.run(
@@ -166,6 +169,34 @@ class WAM3DConfig:
     random_seed: int = 42
     sample_batch_size: int | None | str = "auto"
     device: str = "auto"
+
+
+@dataclass
+class ServeConfig:
+    """Knobs of `wam_tpu.serve.AttributionServer` (and the
+    scripts/bench_serve.py load generator). ``buckets`` is the admitted
+    item-shape set as a CLI-friendly string: comma-separated, dims joined
+    by 'x' — e.g. "3x224x224,3x256x256" for images, "32768,65536" for
+    waveforms; "" lets the caller pick programmatically."""
+
+    max_batch: int = 8
+    max_wait_ms: float = 5.0
+    queue_depth: int = 64
+    deadline_ms: float = 0.0  # 0 = no per-request deadline
+    buckets: str = ""
+    warmup: bool = True
+    compilation_cache: bool = True
+    metrics_path: str = ""
+    device: str = "auto"
+
+    def bucket_shapes(self) -> list[tuple[int, ...]]:
+        if not self.buckets:
+            return []
+        return [
+            tuple(int(d) for d in part.strip().split("x"))
+            for part in self.buckets.split(",")
+            if part.strip()
+        ]
 
 
 @dataclass

@@ -180,8 +180,9 @@ def make_sharded_runner(body, mesh, data_axis: str = "data"):
     the AUC and μ-fidelity runners (round-4 verdict #4)."""
     from functools import partial
 
-    from jax import shard_map
     from jax.sharding import PartitionSpec as P
+
+    from wam_tpu.compat import shard_map
 
     sharded = jax.jit(
         partial(shard_map, mesh=mesh, in_specs=P(data_axis),
@@ -221,7 +222,13 @@ def batched_auc_runner(
     ``fan_chunk`` bounds the model rows WITHIN one sample's fan (an inner
     lax.map) for when the fan alone exceeds the caller's batch-size memory
     cap. ``return_logits=True`` returns raw logits rows (the 1D
-    input-fidelity argmax path) instead of (scores, prob_curves).
+    input-fidelity argmax path).
+
+    RETURN-TYPE CHANGE (round 5): the default (non-logits) path now returns
+    ONE fused ``(B, 1 + n_iter+1)`` array — column 0 the AUC score, columns
+    1: the prob curve — where it previously returned a ``(scores, curves)``
+    tuple. Two separate result tensors cost one tunnel round trip each;
+    unpack with ``out[:, 0], out[:, 1:]``.
 
     With ``mesh``, the image batch is sharded over ``data_axis`` via
     `shard_map` — each device runs the identical per-image body on its

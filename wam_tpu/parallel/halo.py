@@ -28,8 +28,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax import shard_map
 
+from wam_tpu.compat import axis_size, shard_map
 from wam_tpu.wavelets.filters import build_wavelet
 from wam_tpu.wavelets.periodized import dwt_per, separable_dwt2, separable_dwt3
 
@@ -53,7 +53,7 @@ def _local_dwt_with_halo(x_local: jax.Array, wavelet: str, axis_name: str):
     hop count is static, derived from shapes."""
     wav = build_wavelet(wavelet)
     L = wav.filt_len
-    n_shards = lax.axis_size(axis_name)
+    n_shards = axis_size(axis_name)
     if L > 2:
         need = L - 2
         local_len = x_local.shape[-1]

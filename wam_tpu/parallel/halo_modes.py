@@ -49,8 +49,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
-from jax import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from wam_tpu.compat import axis_size, shard_map
 
 from wam_tpu.wavelets.filters import Wavelet
 from wam_tpu.wavelets.transform import (
@@ -173,7 +174,7 @@ def _core_local(x_local: jax.Array, wav: Wavelet, mode: str, seq_axis: str) -> j
     L = wav.filt_len
     if L > 2:
         need = L - 2
-        k = lax.axis_size(seq_axis)
+        k = axis_size(seq_axis)
         perm = [(i, (i + 1) % k) for i in range(k)]
         halo = lax.ppermute(x_local[:, -need:], seq_axis, perm=perm)
         head = x_local[:, : min(x_local.shape[-1], 2 * L)]
@@ -443,7 +444,7 @@ def _synth_core_local(subs_local: jax.Array, halo_src: jax.Array, wav: Wavelet, 
     m = subs_local.shape[-1]
     h = (L - 1) // 2
     if h > 0:
-        k = lax.axis_size(seq_axis)
+        k = axis_size(seq_axis)
         perm = [(i, (i - 1) % k) for i in range(k)]
         ring = lax.ppermute(subs_local[..., :h], seq_axis, perm=perm)
         last = lax.axis_index(seq_axis) == k - 1

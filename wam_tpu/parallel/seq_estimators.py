@@ -74,6 +74,13 @@ class SeqShardedWam:
     inserts the model-side halos). The DWT/IDWT stages are gather-free by
     construction — audited in tests/test_seq_estimators.py the same way as
     tests/test_halo_modes.py.
+
+    Inputs are BATCHED: `attribute` / `smoothgrad` / `integrated` take x of
+    rank ``ndim + leading batch dims`` (at least one — (B, L) for ndim=1,
+    (B, H, W) or (B, C, H, W) for ndim=2, (B, D, H, W) for ndim=3). An
+    unbatched signal slips past the sharding constraints (its leading axis
+    is read as batch) and mis-shards silently, so the entry points reject
+    ``x.ndim <= ndim`` loudly instead.
     """
 
     def __init__(
@@ -331,9 +338,19 @@ class SeqShardedWam:
 
     # -- gradient core (single pass) ---------------------------------------
 
+    def _check_batched(self, x):
+        """Entry-point guard for the batched-input contract (class
+        docstring): rank ndim inputs would alias the batch slot."""
+        if x.ndim <= self.ndim:
+            raise ValueError(
+                f"SeqShardedWam(ndim={self.ndim}) takes BATCHED inputs "
+                f"(rank > {self.ndim}); got rank {x.ndim} {x.shape} — add a "
+                f"leading batch axis (x[None]) for a single signal")
+
     def attribute(self, x, y=None):
         """One un-noised pass: (coeffs, grads) like `WamEngine.attribute`,
         coefficient leaves gathered to plain (sequence-sharded) arrays."""
+        self._check_batched(x)
         coeffs = self.dec(x)
         spatial = tuple(x.shape[-self.ndim:])
         grads = self._grads(coeffs, y, spatial=spatial)
@@ -355,6 +372,7 @@ class SeqShardedWam:
         means ALL samples in one dispatch (the resolvers' full-vmap
         convention). Identical draws and per-sample gradients; only the
         summation order differs."""
+        self._check_batched(x)
         if sample_chunk is None:
             sample_chunk = n_samples
         spatial = tuple(x.shape[-self.ndim:])
@@ -398,6 +416,7 @@ class SeqShardedWam:
         (gathered coeffs, integral pytree); the caller multiplies by its
         baseline. ``sample_chunk`` batches that many α-steps per dispatch
         (None = all), same mechanics as `smoothgrad`'s."""
+        self._check_batched(x)
         spatial = tuple(x.shape[-self.ndim:])
         coeffs = self.dec(x)
         alphas = jnp.linspace(0.0, 1.0, n_steps, dtype=jnp.float32)

@@ -18,8 +18,10 @@ from typing import Any, Callable
 
 import jax
 import jax.numpy as jnp
-from jax import lax, shard_map
+from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from wam_tpu.compat import shard_map
 
 from wam_tpu.core.estimators import noise_sigma, trapezoid
 

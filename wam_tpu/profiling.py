@@ -95,7 +95,13 @@ def _device_busy_seconds(logdir: str) -> float | None:
     "XLA Ops" line double-counts ~2× (events overlap/nest: measured 0.738 s
     op-sum vs 0.379 s module span on the flagship step), so the fallback
     when no module line exists is the op-interval UNION. None when no TPU
-    device plane exists (CPU backend)."""
+    device plane exists (CPU backend).
+
+    Multi-chip captures expose one TPU plane PER DEVICE, each carrying the
+    same SPMD program's span — summing across planes would report k× the
+    step time on k chips. The capture is therefore reduced per plane and the
+    BUSIEST plane wins (max), which is the wall-clock-limiting chip of an
+    SPMD step; per-chip skew stays invisible here, by design."""
     import glob
 
     try:
@@ -112,27 +118,25 @@ def _device_busy_seconds(logdir: str) -> float | None:
     space = xplane_pb2.XSpace()
     with open(sorted(paths)[-1], "rb") as f:
         space.ParseFromString(f.read())
-    total = 0.0
-    found = False
+    per_plane = []
     for plane in space.planes:
         if "TPU" not in plane.name:
             continue
         lines = {line.name: line for line in plane.lines}
         if "XLA Modules" in lines and lines["XLA Modules"].events:
-            found = True
-            total += sum(ev.duration_ps
-                         for ev in lines["XLA Modules"].events) / 1e12
+            per_plane.append(sum(ev.duration_ps
+                                 for ev in lines["XLA Modules"].events) / 1e12)
         elif "XLA Ops" in lines:
-            found = True
-            total += _union_seconds(lines["XLA Ops"].events)
-    return total if found else None
+            per_plane.append(_union_seconds(lines["XLA Ops"].events))
+    return max(per_plane) if per_plane else None
 
 
 def device_time_samples(fn, *args, k: int = 3, laps: int = 1, warmup: int = 1) -> list[float]:
     """``k`` device-time samples (seconds/call): each sample traces one
-    lap-amortized region with `jax.profiler` and sums the TPU device plane's
-    "XLA Modules" program spans / laps (op-interval union as fallback — see
-    `_device_busy_seconds` for why a plain op sum is wrong).
+    lap-amortized region with `jax.profiler` and reports the busiest TPU
+    device plane's "XLA Modules" program spans / laps (op-interval union as
+    fallback; max over planes, NOT a sum — a multi-chip SPMD capture carries
+    the same program on every plane. See `_device_busy_seconds`).
 
     This measures the CHIP, not the tunnel: wall samples of sub-100 ms
     steps on the tunneled TPU are dominated by host/tunnel state and turn

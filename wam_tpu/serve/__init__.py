@@ -1,0 +1,39 @@
+"""`wam_tpu.serve` — batched attribution serving runtime.
+
+The production layer the ROADMAP north star asks for: a stream of
+independent attribution requests (mixed shapes, mixed arrival times) in, a
+small fixed set of warm compiled graphs and a single device-owning worker
+loop out. See `serve.runtime` for the operational semantics, `serve.buckets`
+for the shape-admission policy, `serve.metrics` for the ledger schema, and
+`scripts/bench_serve.py` for the closed-loop load generator.
+
+Engines plug in via their ``serve_entry()`` methods (wam1d/wam2d/wam3d) —
+thread-safe batched callables jitted with donated input buffers on TPU
+(`serve.entry.jit_entry`).
+"""
+
+from wam_tpu.serve.buckets import Bucket, BucketTable, NoBucketError, pad_item
+from wam_tpu.serve.entry import jit_entry
+from wam_tpu.serve.metrics import ServeMetrics, percentile_ms
+from wam_tpu.serve.runtime import (
+    AttributionServer,
+    DeadlineExceededError,
+    QueueFullError,
+    ServeError,
+    ServerClosedError,
+)
+
+__all__ = [
+    "AttributionServer",
+    "Bucket",
+    "BucketTable",
+    "NoBucketError",
+    "ServeError",
+    "QueueFullError",
+    "DeadlineExceededError",
+    "ServerClosedError",
+    "ServeMetrics",
+    "percentile_ms",
+    "jit_entry",
+    "pad_item",
+]

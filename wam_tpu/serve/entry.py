@@ -1,0 +1,45 @@
+"""Jitted serving entries — the glue between an engine and the runtime.
+
+A serving entry is a pure callable ``entry(x, y) -> attribution pytree``
+with a leading batch axis on every input and output leaf, no instance-
+attribute stashing (the worker loop is a thread; the engines' ``__call__``
+convenience surface mutates ``self.scales`` etc. and is NOT thread-safe),
+and jit applied here so the runtime can:
+
+- **donate** the padded input batch (the dispatcher builds a fresh host
+  buffer per batch, so aliasing it into the graph saves one HBM copy per
+  dispatch on TPU; donation is off on backends that cannot use it), and
+- **count jit cache misses** via ``on_trace``: the wrapped Python callable
+  runs exactly once per compiled shape, so the hook is a direct cache-miss
+  counter — the serve ledger's ``compile_count`` and the one-compile-per-
+  bucket test assertion.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+
+__all__ = ["jit_entry"]
+
+
+def jit_entry(
+    impl: Callable,
+    *,
+    donate: bool | None = None,
+    on_trace: Callable[[], None] | None = None,
+):
+    """Wrap ``impl(x, y)`` as a serving entry (see module docstring).
+
+    ``donate=None`` resolves to "donate on TPU only" — XLA:CPU leaves
+    donated buffers unused and warns per call."""
+    if donate is None:
+        donate = jax.default_backend() == "tpu"
+
+    def wrapped(x, y):
+        if on_trace is not None:
+            on_trace()  # trace-time only: one call per jit cache miss
+        return impl(x, y)
+
+    return jax.jit(wrapped, donate_argnums=(0,) if donate else ())

@@ -1,0 +1,156 @@
+"""Per-request serving metrics (tentpole part 3).
+
+The serving runtime's observable state, accumulated thread-safely and
+emitted as `results.JsonlWriter` ledger rows comparable to the bench.py /
+bench_matrix.py ledgers: one ``serve_batch`` row per dispatched batch
+(queue depth, fill ratio, pad waste, service time) plus a ``serve_summary``
+row per drain window (p50/p99 latency, attributions/sec, reject/expiry
+counts, jit cache misses). Stage wall-clock inside the worker loop reuses
+`profiling.StageTimer` (assemble / dispatch / fetch), so serve ledgers
+decompose the same way bench ledgers do.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+
+from wam_tpu.profiling import StageTimer
+from wam_tpu.results import JsonlWriter
+
+__all__ = ["ServeMetrics", "percentile_ms"]
+
+
+def percentile_ms(latencies_s, q: float) -> float:
+    """Linear-interpolated percentile of a latency sample, in ms (NaN when
+    empty — a summary of zero requests has no latency)."""
+    if not latencies_s:
+        return float("nan")
+    return float(np.quantile(np.asarray(latencies_s, np.float64), q / 100.0) * 1e3)
+
+
+class ServeMetrics:
+    """Accumulator shared by the dispatcher (submit side) and the worker
+    loop (drain side); every mutator takes the lock, so client threads and
+    the device-owner thread can hit it concurrently."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.stages = StageTimer()
+        self.compile_count = 0  # jit cache misses (serve_entry on_trace hook)
+        self.submitted = 0
+        self.completed = 0
+        self.rejected = 0  # backpressure (queue full)
+        self.expired = 0  # deadline passed while queued
+        self.failed = 0  # engine raised; no fallback could serve it
+        self.fallbacks = 0  # batches served by the degraded CPU entry
+        self.latencies_s: list[float] = []  # submit -> result, per request
+        self.queue_waits_s: list[float] = []  # submit -> batch assembly
+        self.batch_rows: list[dict] = []  # one dict per dispatched batch
+        self._t0 = time.perf_counter()
+
+    # -- mutators (called from dispatcher / worker threads) -----------------
+
+    def note_compile(self) -> None:
+        """Hook for `serve_entry(on_trace=...)`: runs once per jit trace,
+        i.e. once per (bucket) cache miss."""
+        with self._lock:
+            self.compile_count += 1
+
+    def note_submit(self) -> None:
+        with self._lock:
+            self.submitted += 1
+
+    def note_reject(self) -> None:
+        with self._lock:
+            self.rejected += 1
+
+    def note_expired(self, n: int = 1) -> None:
+        with self._lock:
+            self.expired += n
+
+    def note_failed(self, n: int = 1) -> None:
+        with self._lock:
+            self.failed += n
+
+    def note_fallback(self) -> None:
+        with self._lock:
+            self.fallbacks += 1
+
+    def note_batch(
+        self,
+        *,
+        bucket_shape: tuple[int, ...],
+        n_real: int,
+        max_batch: int,
+        pad_waste: float,
+        queue_depth: int,
+        service_s: float,
+        queue_waits_s: list[float],
+        latencies_s: list[float],
+    ) -> None:
+        """One dispatched batch: aggregate row + per-request samples."""
+        with self._lock:
+            self.completed += len(latencies_s)
+            self.latencies_s.extend(latencies_s)
+            self.queue_waits_s.extend(queue_waits_s)
+            self.batch_rows.append(
+                {
+                    "metric": "serve_batch",
+                    "bucket": list(bucket_shape),
+                    "n_real": n_real,
+                    "fill_ratio": n_real / max_batch,
+                    "pad_waste": pad_waste,
+                    "queue_depth": queue_depth,
+                    "service_s": service_s,
+                    "timestamp": time.time(),
+                }
+            )
+
+    # -- reporting ----------------------------------------------------------
+
+    def summary(self) -> dict:
+        """Aggregate window stats; keys are the ledger schema documented in
+        DESIGN.md ("Serving runtime")."""
+        with self._lock:
+            window_s = time.perf_counter() - self._t0
+            fills = [r["fill_ratio"] for r in self.batch_rows]
+            wastes = [r["pad_waste"] for r in self.batch_rows]
+            depths = [r["queue_depth"] for r in self.batch_rows]
+            return {
+                "metric": "serve_summary",
+                "window_s": window_s,
+                "submitted": self.submitted,
+                "completed": self.completed,
+                "rejected": self.rejected,
+                "expired": self.expired,
+                "failed": self.failed,
+                "fallback_batches": self.fallbacks,
+                "batches": len(self.batch_rows),
+                "compile_count": self.compile_count,
+                "fill_ratio_mean": float(np.mean(fills)) if fills else float("nan"),
+                "pad_waste_mean": float(np.mean(wastes)) if wastes else float("nan"),
+                "queue_depth_mean": float(np.mean(depths)) if depths else float("nan"),
+                "queue_depth_max": int(max(depths)) if depths else 0,
+                "latency_p50_ms": percentile_ms(self.latencies_s, 50),
+                "latency_p99_ms": percentile_ms(self.latencies_s, 99),
+                "queue_wait_p50_ms": percentile_ms(self.queue_waits_s, 50),
+                "attributions_per_s": self.completed / window_s if window_s > 0 else 0.0,
+                "stages": self.stages.summary(),
+            }
+
+    def emit(self, writer: JsonlWriter, config: dict | None = None) -> dict:
+        """Flush batch rows + the summary row to a JSONL ledger; returns the
+        summary. ``config`` is attached to the summary row the way
+        `results.MetricRecord` carries its config."""
+        with self._lock:
+            rows = list(self.batch_rows)
+        for row in rows:
+            writer.write(row)
+        summary = self.summary()
+        if config is not None:
+            summary["config"] = config
+        writer.write(summary)
+        return summary

@@ -1,0 +1,374 @@
+"""Batched attribution serving runtime (the tentpole of `wam_tpu/serve/`).
+
+Turns a stream of independent single-item attribution requests into
+efficiently padded device batches. One `AttributionServer` owns one device
+stream: client threads `submit()` items and block on futures; a single
+worker thread coalesces same-bucket requests into fixed-shape batches
+(always the bucket's full ``max_batch`` rows — one compiled graph per
+bucket, ever), dispatches them through a jitted serving entry
+(`serve.entry.jit_entry`, usually an engine's ``serve_entry()``), and
+fans results back out per request.
+
+Operational semantics (DESIGN.md "Serving runtime"):
+- **Backpressure**: the queue is bounded by ``queue_depth`` items across
+  all buckets; `submit` on a full queue raises `QueueFullError` carrying a
+  ``retry_after_s`` estimate (EMA batch service time × queued batches) —
+  reject-with-retry-after, never unbounded buffering.
+- **Coalescing**: the worker serves the bucket whose head request is
+  oldest, dispatching when the bucket has ``max_batch`` items or its head
+  has waited ``max_wait_ms`` — latency-bounded batch fill.
+- **Deadlines**: a request carrying a deadline that lapses while queued is
+  completed with `DeadlineExceededError` at pop time instead of wasting a
+  batch slot.
+- **Degradation**: if the entry raises mid-run and `probe_accelerator`
+  (forced re-probe) says the accelerator is gone, the server swaps in the
+  ``fallback_factory`` entry (a CPU-backend rebuild) once, replays the
+  failed batch on it, and keeps serving degraded rather than failing hard.
+- **Shutdown**: `close()` stops intake immediately, drains queued work,
+  then joins the worker.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from concurrent.futures import Future
+from dataclasses import dataclass, field
+
+import jax
+import numpy as np
+
+from wam_tpu.serve.buckets import Bucket, BucketTable, pad_item
+from wam_tpu.serve.metrics import ServeMetrics
+
+__all__ = [
+    "AttributionServer",
+    "ServeError",
+    "QueueFullError",
+    "DeadlineExceededError",
+    "ServerClosedError",
+]
+
+
+class ServeError(RuntimeError):
+    """Base class for serving-runtime request failures."""
+
+
+class QueueFullError(ServeError):
+    """Backpressure: the bounded queue is full. ``retry_after_s`` is the
+    server's estimate of when capacity frees up — clients should back off
+    at least that long before resubmitting."""
+
+    def __init__(self, retry_after_s: float):
+        super().__init__(f"queue full; retry after {retry_after_s:.3f}s")
+        self.retry_after_s = retry_after_s
+
+
+class DeadlineExceededError(ServeError):
+    """The request's deadline lapsed while it was still queued."""
+
+
+class ServerClosedError(ServeError):
+    """`submit` after `close()` (or during drain)."""
+
+
+@dataclass
+class _Request:
+    x: np.ndarray
+    y: int | None
+    bucket: Bucket
+    t_submit: float
+    deadline: float | None  # perf_counter timestamp, None = no deadline
+    future: Future = field(default_factory=Future)
+
+
+class AttributionServer:
+    """See module docstring.
+
+    Parameters
+    ----------
+    entry : ``(x, y) -> attribution pytree`` with leading batch axis on
+        every leaf (an engine's ``serve_entry()`` or any jitted callable).
+    buckets : `BucketTable` or iterable of admitted item shapes.
+    max_batch : rows per dispatched batch (every batch is padded to exactly
+        this, so each bucket compiles once).
+    max_wait_ms : max time a head-of-bucket request waits for batch fill.
+    queue_depth : bound on queued items across all buckets (backpressure).
+    deadline_ms : default per-request deadline (0 = none; per-`submit`
+        override).
+    labeled : whether requests carry a class label. ``labeled=False``
+        servers dispatch ``entry(x, None)`` (representation mode); mixing
+        labeled and unlabeled requests in one server would need two graphs
+        per bucket, so it is rejected at `submit`.
+    warmup : compile every bucket at `start()` (after
+        `config.enable_compilation_cache()` when ``compilation_cache``), so
+        no request ever eats a cold compile on the hot path.
+    metrics : a shared `ServeMetrics`; constructed fresh when None. Pass
+        the same object given to ``serve_entry(on_trace=...)`` so compile
+        counts land in the same ledger.
+    metrics_path : when set, `close()` emits the batch rows + summary to
+        this JSONL ledger (`results.JsonlWriter`).
+    fallback_factory : zero-arg callable building a CPU-backend entry for
+        degraded serving (see module docstring).
+    dtype : host dtype items are staged as (one contiguous transfer per
+        batch).
+    """
+
+    def __init__(
+        self,
+        entry,
+        buckets,
+        *,
+        max_batch: int = 8,
+        max_wait_ms: float = 5.0,
+        queue_depth: int = 64,
+        deadline_ms: float = 0.0,
+        labeled: bool = True,
+        warmup: bool = True,
+        compilation_cache: bool = False,
+        metrics: ServeMetrics | None = None,
+        metrics_path: str | None = None,
+        fallback_factory=None,
+        dtype=np.float32,
+        auto_start: bool = True,
+    ):
+        if max_batch < 1:
+            raise ValueError("max_batch must be >= 1")
+        if queue_depth < 1:
+            raise ValueError("queue_depth must be >= 1")
+        self._entry = entry
+        self.table = buckets if isinstance(buckets, BucketTable) else BucketTable(buckets)
+        self.max_batch = max_batch
+        self.max_wait_s = max_wait_ms / 1e3
+        self.queue_depth = queue_depth
+        self.default_deadline_s = deadline_ms / 1e3 if deadline_ms else None
+        self.labeled = labeled
+        self.warmup = warmup
+        self.compilation_cache = compilation_cache
+        self.metrics = metrics if metrics is not None else ServeMetrics()
+        self.metrics_path = metrics_path
+        self._fallback_factory = fallback_factory
+        self.dtype = dtype
+        self.degraded = False
+
+        self._cond = threading.Condition()
+        self._queues: dict[Bucket, list[_Request]] = {b: [] for b in self.table}
+        self._pending = 0
+        self._closed = False
+        self._started = False
+        self._worker: threading.Thread | None = None
+        self._ema_batch_s = 0.05  # retry-after seed until the first batch lands
+        if auto_start:
+            self.start()
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self) -> "AttributionServer":
+        """Warm every bucket (one compile each — the only compiles this
+        server will ever do), then launch the worker. Idempotent."""
+        if self._started:
+            return self
+        if self.compilation_cache:
+            from wam_tpu.config import enable_compilation_cache
+
+            enable_compilation_cache()
+        if self.warmup:
+            for bucket in self.table:
+                self._dispatch(*self._zeros_batch(bucket))
+        self._worker = threading.Thread(
+            target=self._worker_loop, name="wam-serve-worker", daemon=True
+        )
+        self._started = True
+        self._worker.start()
+        return self
+
+    def close(self, emit_metrics: bool = True) -> None:
+        """Stop intake, drain queued requests, join the worker, and (when
+        ``metrics_path`` is set) flush the ledger."""
+        with self._cond:
+            if self._closed:
+                return
+            self._closed = True
+            self._cond.notify_all()
+        if self._worker is not None:
+            self._worker.join()
+        if emit_metrics and self.metrics_path:
+            from wam_tpu.results import JsonlWriter
+
+            self.metrics.emit(JsonlWriter(self.metrics_path), config=self.describe())
+        self._started = False
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def describe(self) -> dict:
+        return {
+            "buckets": [list(b.shape) for b in self.table],
+            "max_batch": self.max_batch,
+            "max_wait_ms": self.max_wait_s * 1e3,
+            "queue_depth": self.queue_depth,
+            "labeled": self.labeled,
+            "degraded": self.degraded,
+        }
+
+    # -- client side --------------------------------------------------------
+
+    def submit(self, x, y=None, deadline_ms: float | None = None) -> Future:
+        """Enqueue one item (NO leading batch axis — a client batch is a
+        sequence of submits, coalesced back together by the worker).
+        Returns a `concurrent.futures.Future` resolving to the item's
+        attribution (leading axis stripped), or raising `ServeError`."""
+        if self.labeled and y is None:
+            raise ValueError("labeled server: submit(x, y) needs a class label")
+        if not self.labeled and y is not None:
+            raise ValueError("unlabeled server: submit() must not carry a label")
+        x = np.asarray(x, self.dtype)
+        bucket = self.table.select(x.shape)  # NoBucketError before any queueing
+        self.metrics.note_submit()
+        now = time.perf_counter()
+        if deadline_ms is None:
+            deadline = (now + self.default_deadline_s) if self.default_deadline_s else None
+        else:
+            deadline = now + deadline_ms / 1e3
+        req = _Request(x, y, bucket, now, deadline)
+        with self._cond:
+            if self._closed or not self._started:
+                raise ServerClosedError("server is not accepting requests")
+            if self._pending >= self.queue_depth:
+                self.metrics.note_reject()
+                batches_ahead = -(-self._pending // self.max_batch)
+                raise QueueFullError(retry_after_s=self._ema_batch_s * batches_ahead)
+            self._queues[bucket].append(req)
+            self._pending += 1
+            self._cond.notify_all()
+        return req.future
+
+    def attribute(self, x, y=None, deadline_ms: float | None = None):
+        """Blocking convenience wrapper: submit + wait."""
+        return self.submit(x, y, deadline_ms=deadline_ms).result()
+
+    # -- worker side --------------------------------------------------------
+
+    def _zeros_batch(self, bucket: Bucket):
+        x = np.zeros((self.max_batch,) + bucket.shape, self.dtype)
+        y = np.zeros((self.max_batch,), np.int32) if self.labeled else None
+        return x, y
+
+    def _dispatch(self, xs, ys):
+        """Run one padded batch through the entry, degrading to the CPU
+        fallback when the accelerator has actually gone away (forced
+        re-probe distinguishes a device loss from a plain bug: an
+        in-process exception with a healthy accelerator re-raises)."""
+        try:
+            if self.degraded:
+                self.metrics.note_fallback()
+            return jax.device_get(self._entry(xs, ys))
+        except Exception:
+            if self.degraded or self._fallback_factory is None:
+                raise
+            from wam_tpu import config
+
+            if config.probe_accelerator(force=True):
+                raise  # accelerator healthy: the failure is not the device
+            self._entry = self._fallback_factory()
+            self.degraded = True
+            self.metrics.note_fallback()
+            return jax.device_get(self._entry(xs, ys))
+
+    def _take_batch(self):
+        """Block until a batch is ready (bucket full, head waited
+        max_wait_ms, or draining at close). Returns (bucket, requests,
+        queue_depth_at_pop) or None when closed and drained."""
+        with self._cond:
+            while True:
+                if self._pending == 0:
+                    if self._closed:
+                        return None
+                    self._cond.wait(0.05)
+                    continue
+                # serve the bucket whose head request is oldest
+                bucket = min(
+                    (b for b, q in self._queues.items() if q),
+                    key=lambda b: self._queues[b][0].t_submit,
+                )
+                q = self._queues[bucket]
+                head_wait = time.perf_counter() - q[0].t_submit
+                if (
+                    len(q) >= self.max_batch
+                    or head_wait >= self.max_wait_s
+                    or self._closed  # draining: don't sit out max_wait
+                ):
+                    take = q[: self.max_batch]
+                    del q[: self.max_batch]
+                    self._pending -= len(take)
+                    return bucket, take, self._pending + len(take)
+                self._cond.wait(self.max_wait_s - head_wait)
+
+    def _worker_loop(self):
+        while True:
+            got = self._take_batch()
+            if got is None:
+                return
+            bucket, reqs, depth = got
+            now = time.perf_counter()
+            live, expired = [], []
+            for r in reqs:
+                (expired if r.deadline is not None and now > r.deadline else live).append(r)
+            for r in expired:
+                r.future.set_exception(
+                    DeadlineExceededError("deadline lapsed while queued")
+                )
+            if expired:
+                self.metrics.note_expired(len(expired))
+            if not live:
+                continue
+            self._serve_batch(bucket, live, depth)
+
+    def _serve_batch(self, bucket: Bucket, live: list[_Request], depth: int):
+        n_real = len(live)
+        with self.metrics.stages.stage("assemble"):
+            xs = np.stack([pad_item(r.x, bucket) for r in live])
+            if n_real < self.max_batch:
+                # pad rows REPLICATE the first real item: duplicates cannot
+                # move the engines' per-block max-normalizer, so real rows
+                # come back identical to a full batch (serve.buckets)
+                reps = np.repeat(xs[:1], self.max_batch - n_real, axis=0)
+                xs = np.concatenate([xs, reps])
+            if self.labeled:
+                ys = np.asarray([r.y for r in live], np.int32)
+                if n_real < self.max_batch:
+                    ys = np.concatenate(
+                        [ys, np.repeat(ys[:1], self.max_batch - n_real)]
+                    )
+            else:
+                ys = None
+        t0 = time.perf_counter()
+        try:
+            with self.metrics.stages.stage("dispatch"):
+                out = self._dispatch(xs, ys)
+        except Exception as e:
+            for r in live:
+                r.future.set_exception(e)
+            self.metrics.note_failed(n_real)
+            return
+        service_s = time.perf_counter() - t0
+        # EMA over batch service time feeds the retry-after estimate
+        self._ema_batch_s = 0.8 * self._ema_batch_s + 0.2 * service_s
+        with self.metrics.stages.stage("distribute"):
+            done = time.perf_counter()
+            for i, r in enumerate(live):
+                row = jax.tree_util.tree_map(lambda a: np.asarray(a)[i], out)
+                r.future.set_result(row)
+        self.metrics.note_batch(
+            bucket_shape=bucket.shape,
+            n_real=n_real,
+            max_batch=self.max_batch,
+            pad_waste=float(np.mean([bucket.pad_waste(r.x.shape) for r in live])),
+            queue_depth=depth,
+            service_s=service_s,
+            queue_waits_s=[t0 - r.t_submit for r in live],
+            latencies_s=[done - r.t_submit for r in live],
+        )

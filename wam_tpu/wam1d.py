@@ -165,6 +165,9 @@ class WaveletAttribution1D(BaseWAM1D):
     SmoothGrad noise is drawn shard-local with the same fold_in key stream
     as ``stream_noise=True`` — per-sample results are bit-identical to the
     single-device estimator; sample means differ only by summation order.
+    NOTE: ``stream_noise`` itself is ignored under ``mesh=`` — with the
+    default ``stream_noise=False``, adding ``mesh=`` therefore changes the
+    (equally valid) noise realization.
     """
 
     def __init__(
@@ -360,6 +363,31 @@ class WaveletAttribution1D(BaseWAM1D):
         if self.method == "smooth":
             return self.smooth_wam(x, y)
         return self.integrated_wam(x, y)
+
+    def serve_entry(self, donate: bool | None = None, on_trace=None):
+        """Batched serving entry ``(x, y) -> (mel_attr, coeff_attr)`` for the
+        `wam_tpu.serve` worker: x is (B, W) float32 waveforms (already
+        peak-normalized — the list form of `normalize_waveforms` is a host
+        step), y is (B,) int labels. Returns the same pytree as ``__call__``
+        minus the instance-attribute stashing (``self.melspecs`` /
+        ``self.grad_coeffs``) that makes it thread-unsafe; the serve runtime
+        distributes rows of every leaf. SmoothGrad folds the instance seed in
+        at entry-build time. ``mesh=`` is rejected: the serving worker owns
+        exactly one device."""
+        if self.mesh is not None:
+            raise ValueError(
+                "serve_entry() does not support mesh=; the serve worker owns "
+                "a single device — drive the sharded estimator directly")
+        from wam_tpu.serve.entry import jit_entry
+
+        if self.method == "smooth":
+            key = jax.random.PRNGKey(self.random_seed)
+            impl = lambda x, y: self._smooth_impl(  # noqa: E731
+                jnp.asarray(x, jnp.float32), y, key)
+        else:
+            impl = lambda x, y: self._ig_impl(  # noqa: E731
+                jnp.asarray(x, jnp.float32), y)
+        return jit_entry(impl, donate=donate, on_trace=on_trace)
 
 
 def _minmax_normalize(a):

@@ -94,6 +94,21 @@ class BaseWAM2D:
                                          channel_axis=self._caxis)
         return mosaic
 
+    def serve_entry(self, donate: bool | None = None, on_trace=None):
+        """Batched serving entry: jitted ``(x, y) -> mosaic (B, S, S)`` with
+        no instance-attribute stashing (unlike ``__call__``), safe to call
+        from the `wam_tpu.serve` worker thread. ``donate``/``on_trace`` are
+        forwarded to `serve.entry.jit_entry` (input-buffer donation on TPU,
+        jit cache-miss counting)."""
+        from wam_tpu.serve.entry import jit_entry
+
+        def impl(x, y):
+            x = self._to_internal(x)
+            _, grads = self.engine.attribute(x, y)
+            return mosaic2d(grads, self.normalize_coeffs, self._caxis)
+
+        return jit_entry(impl, donate=donate, on_trace=on_trace)
+
     def disentangle_scales(self, grads, approx_coeffs: bool = False):
         return disentangle_scales(grads, approx_coeffs=approx_coeffs,
                                   channel_axis=self._caxis)
@@ -119,7 +134,12 @@ class WaveletAttribution2D(BaseWAM2D):
     ``stream_noise=True`` draws SmoothGrad noise inside the sample map
     instead of materializing the (n_samples, B, C, H, W) buffer — different
     (equally valid) draws, lower peak HBM, a few % faster at large batches
-    (`core.estimators.smoothgrad(materialize_noise=False)`).
+    (`core.estimators.smoothgrad(materialize_noise=False)`). NOTE: the
+    ``mesh=`` path always draws shard-local with the fold_in stream (the
+    ``stream_noise=True`` draws, bit-identical per sample); ``stream_noise``
+    itself is ignored there, so adding ``mesh=`` under the default
+    materialized-noise setting changes the (equally valid) noise
+    realization.
 
     Scheduling defaults are "auto" — the benched TPU schedule, so the class
     API delivers the recorded flagship number out of the box (round-3
@@ -305,3 +325,24 @@ class WaveletAttribution2D(BaseWAM2D):
         if self.method == "smooth":
             return self.smooth_wam(x, y)
         return self.integrated_wam(x, y)
+
+    def serve_entry(self, donate: bool | None = None, on_trace=None):
+        """Batched serving entry ``(x, y) -> mosaic (B, S, S)`` for the
+        `wam_tpu.serve` worker: the estimator body without the
+        instance-attribute stashing (``self.scales``) that makes ``__call__``
+        thread-unsafe. SmoothGrad folds the instance seed in at entry-build
+        time, so every batch reuses one noise stream — matching what repeat
+        ``__call__`` invocations do. ``mesh=`` is rejected: the serving
+        worker owns exactly one device."""
+        if self.mesh is not None:
+            raise ValueError(
+                "serve_entry() does not support mesh=; the serve worker owns "
+                "a single device — drive the sharded estimator directly")
+        from wam_tpu.serve.entry import jit_entry
+
+        if self.method == "smooth":
+            key = jax.random.PRNGKey(self.random_seed)
+            impl = lambda x, y: self._smooth_impl(x, y, key)  # noqa: E731
+        else:
+            impl = self._ig_impl
+        return jit_entry(impl, donate=donate, on_trace=on_trace)

@@ -187,7 +187,14 @@ class BaseWAM3D:
 
 
 class WaveletAttribution3D(BaseWAM3D):
-    """SmoothGrad / IG WAM-3D (`lib/wam_3D.py:501-719`)."""
+    """SmoothGrad / IG WAM-3D (`lib/wam_3D.py:501-719`).
+
+    NOTE: ``stream_noise`` is ignored under ``mesh=`` — the sequence-sharded
+    path always draws SmoothGrad noise shard-local with the fold_in key
+    stream (the ``stream_noise=True`` draws), so with the default
+    ``stream_noise=False``, adding ``mesh=`` changes the (equally valid)
+    noise realization.
+    """
 
     def __init__(
         self,
@@ -365,3 +372,24 @@ class WaveletAttribution3D(BaseWAM3D):
         """(B, J+2, S, S, S) per-level upsampled maps from the last gradient
         cube (`lib/wam_3D.py:662-719`, orientation-sum typo fixed)."""
         return visualize_cube(self.grads, self.J)
+
+    def serve_entry(self, donate: bool | None = None, on_trace=None):
+        """Batched serving entry ``(x, y) -> cube (B, S, S, S)`` for the
+        `wam_tpu.serve` worker: x is (B, 1, D, H, W) volumes as fed to
+        ``__call__``, y is (B,) int labels (the serve path is labeled-only).
+        Same estimator body as ``__call__`` without the ``self.grads`` /
+        ``self.input_size`` stashing that makes it thread-unsafe. SmoothGrad
+        folds the instance seed in at entry-build time. ``mesh=`` is
+        rejected: the serving worker owns exactly one device."""
+        if self.mesh is not None:
+            raise ValueError(
+                "serve_entry() does not support mesh=; the serve worker owns "
+                "a single device — drive the sharded estimator directly")
+        from wam_tpu.serve.entry import jit_entry
+
+        if self.method == "smooth":
+            key = jax.random.PRNGKey(self.random_seed)
+            impl = lambda x, y: self._smooth_impl(x[:, 0], y, key)  # noqa: E731
+        else:
+            impl = lambda x, y: self._ig_impl(x[:, 0], y)  # noqa: E731
+        return jit_entry(impl, donate=donate, on_trace=on_trace)
