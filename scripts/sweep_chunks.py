@@ -1,51 +1,19 @@
-"""Chunk-size sweep for the non-flagship canonical workloads (audio 1D,
-3D volumes, ViT IG) — extends the round-3 flagship scaling study to the rest
-of the BASELINE.json matrix. Uses the SAME workload builders as
-bench_matrix.py (bench_workloads.py), so a sweep measures exactly the
-benchmarked config. Prints one JSON line per (workload, chunk).
+"""DEPRECATED shim — the chunk sweep moved to `wam_tpu.tune.sweep` (the
+round-6 autotuner package). Same arguments, same per-line JSON output:
 
-    python scripts/sweep_chunks.py audio 4 8 25 50
-    python scripts/sweep_chunks.py vol 5 25
-    python scripts/sweep_chunks.py vit 4 8 16
+    python -m wam_tpu.tune.sweep audio 4 8 25 50
+
+This wrapper keeps the old invocation working.
 """
 
-import json
 import os
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-
-def main():
-    kind = sys.argv[1]
-    chunks = [int(c) for c in sys.argv[2:]] or [None]
-
-    from wam_tpu.config import enable_compilation_cache, ensure_usable_backend
-
-    platform = ensure_usable_backend(timeout_s=180.0)
-    enable_compilation_cache()
-
-    import jax.numpy as jnp
-
-    from bench_workloads import audio_workload, vit_workload, vol_workload
-    from wam_tpu.profiling import bench_time
-
-    for chunk in chunks:
-        if kind == "audio":
-            ex, x, y = audio_workload(chunk)
-        elif kind == "vol":
-            ex, x, y = vol_workload(chunk)
-        elif kind == "vit":
-            ex, x, y = vit_workload(chunk, compute_dtype=jnp.bfloat16)
-        else:
-            sys.exit(f"unknown workload {kind!r}")
-
-        t = bench_time(lambda: ex(x, y), repeats=3, laps=4)
-        print(json.dumps({
-            "platform": platform, "workload": kind, "chunk": chunk,
-            "step_s": round(t, 4), "items_per_s": round(x.shape[0] / t, 2),
-        }), flush=True)
-
-
 if __name__ == "__main__":
-    main()
+    print("# scripts/sweep_chunks.py is deprecated; use "
+          "`python -m wam_tpu.tune.sweep`", file=sys.stderr)
+    from wam_tpu.tune.sweep import main
+
+    sys.exit(main(sys.argv[1:]))

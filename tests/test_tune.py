@@ -1,0 +1,357 @@
+"""Schedule autotuner + fused backward kernels (`wam_tpu/tune/`).
+
+Covers the round-6 tentpole end to end on CPU:
+
+- schedule cache round-trip, stale-version invalidation, env kill switch;
+- chunk-override plumbing: a tuned entry steers
+  `core.estimators.resolve_sample_chunk("auto")`, the 2D class API, the
+  sharded sequence estimator, and the serve warmup path;
+- fused ReLU-VJP parity (values AND gradients) vs `jax.nn.relu` for the
+  portable "xla" impl and the Pallas kernels under interpret mode — the
+  kernel *code path* regression-tested without a TPU;
+- attribution parity of `bind_inference(fused_relu_vjp=True)` — the gate
+  that must hold before the flag may default on;
+- μ-fidelity fused single-upload draws match the pre-fusion per-tensor
+  construction bit for bit;
+- the autotuner's toy dry-run (measure + pick a winner, no persistence).
+"""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from wam_tpu.tune import (
+    SCHEDULE_CACHE_VERSION,
+    ScheduleCache,
+    invalidate_process_cache,
+    load_schedule_cache,
+    lookup_schedule,
+    record_schedule,
+    resolve_fan_cap,
+    schedule_key,
+)
+from wam_tpu.tune.fused_relu import (
+    fused_relu,
+    pack_mask,
+    set_fused_relu_impl,
+    unpack_mask,
+)
+
+
+@pytest.fixture
+def sched_cache(tmp_path, monkeypatch):
+    """Isolated user-layer schedule cache: env-pointed file + fresh process
+    singleton, restored after the test."""
+    path = tmp_path / "schedules.json"
+    monkeypatch.setenv("WAM_TPU_SCHEDULE_CACHE", str(path))
+    monkeypatch.delenv("WAM_TPU_NO_SCHEDULE_CACHE", raising=False)
+    invalidate_process_cache()
+    yield path
+    invalidate_process_cache()
+
+
+# -- cache round-trip and versioning ------------------------------------------
+
+
+def test_schedule_key_canonical_form():
+    key = schedule_key("wam2d", (3, 224, 224), 32, "bf16", "pallas", "tpu")
+    assert key == "wam2d|3x224x224|b32|bf16|pallas|tpu"
+    assert schedule_key("eval2d", (), 128, "f32", "conv", "cpu").startswith(
+        "eval2d|-|b128|"
+    )
+
+
+def test_cache_round_trip(sched_cache):
+    key = record_schedule(
+        "wam2d", (3, 64, 64), 8,
+        {"sample_chunk": 16, "stream_noise": True, "items_per_s": 101.5},
+        dtype="f32", dwt_impl="conv", backend="cpu",
+    )
+    assert sched_cache.exists()
+    # a FRESH process (singleton dropped) reads the same entry back
+    invalidate_process_cache()
+    ent = lookup_schedule("wam2d", (3, 64, 64), 8, "f32", "conv", "cpu")
+    assert ent == {"sample_chunk": 16, "stream_noise": True, "items_per_s": 101.5}
+    assert load_schedule_cache().get(key) == ent
+    # the file carries the schema version
+    data = json.loads(sched_cache.read_text())
+    assert data["version"] == SCHEDULE_CACHE_VERSION
+    assert key in data["schedules"]
+
+
+def test_stale_version_file_is_ignored_wholesale(sched_cache):
+    key = schedule_key("wam2d", (3, 64, 64), 8, "f32", "conv", "cpu")
+    sched_cache.write_text(json.dumps({
+        "version": SCHEDULE_CACHE_VERSION + 1,
+        "schedules": {key: {"sample_chunk": 999}},
+    }))
+    invalidate_process_cache()
+    cache = load_schedule_cache()
+    assert str(sched_cache) in cache.stale_files
+    assert lookup_schedule("wam2d", (3, 64, 64), 8, "f32", "conv", "cpu") is None
+    # the next save overwrites the stale file with the current schema
+    record_schedule("wam2d", (3, 64, 64), 8, {"sample_chunk": 4},
+                    dtype="f32", dwt_impl="conv", backend="cpu")
+    assert json.loads(sched_cache.read_text())["version"] == SCHEDULE_CACHE_VERSION
+
+
+def test_corrupt_file_is_ignored(sched_cache):
+    sched_cache.write_text("{not json")
+    invalidate_process_cache()
+    assert lookup_schedule("nope", (1,), 1) is None  # no raise
+
+
+def test_kill_switch_disables_lookup(sched_cache, monkeypatch):
+    record_schedule("wam2d", (3, 64, 64), 8, {"sample_chunk": 16},
+                    dtype="f32", dwt_impl="conv", backend="cpu")
+    monkeypatch.setenv("WAM_TPU_NO_SCHEDULE_CACHE", "1")
+    assert lookup_schedule("wam2d", (3, 64, 64), 8, "f32", "conv", "cpu") is None
+
+
+def test_pinned_defaults_overlaid_by_user_entry(sched_cache):
+    # the repo ships the benched flagship schedule
+    key = "wam2d|3x224x224|b32|bf16|pallas|tpu"
+    cache = load_schedule_cache()
+    pinned = cache.get(key)
+    assert pinned is not None and pinned["sample_chunk"] == 4
+    # a tuned user entry for the same key wins after reload
+    record_schedule("wam2d", (3, 224, 224), 32, {"sample_chunk": 8},
+                    dtype="bf16", dwt_impl="pallas", backend="tpu")
+    invalidate_process_cache()
+    assert load_schedule_cache().get(key)["sample_chunk"] == 8
+    # save() wrote ONLY the diff vs pinned
+    data = json.loads(sched_cache.read_text())
+    assert list(data["schedules"]) == [key]
+
+
+def test_resolve_fan_cap(sched_cache):
+    assert resolve_fan_cap(64, 129) == 64  # ints pass through
+    assert resolve_fan_cap("auto", 129) == 128  # no entry: default
+    record_schedule("eval2d", (129,), 129, {"fan_cap": 256})
+    assert resolve_fan_cap("auto", 129) == 256
+
+
+# -- chunk-override plumbing --------------------------------------------------
+
+
+def test_resolve_sample_chunk_prefers_tuned_entry(sched_cache):
+    from wam_tpu.core.estimators import resolve_sample_chunk
+
+    # no entry: CPU "auto" keeps the legacy full-vmap behavior
+    assert resolve_sample_chunk("auto", 8, 25, workload="wam2d",
+                                shape=(3, 64, 64)) is None
+    record_schedule("wam2d", (3, 64, 64), 8, {"sample_chunk": 16},
+                    dtype="f32", backend=jax.default_backend())
+    got = resolve_sample_chunk("auto", 8, 25, workload="wam2d",
+                               shape=(3, 64, 64))
+    assert got == 16
+    # explicit values still pass through untouched
+    assert resolve_sample_chunk(5, 8, 25, workload="wam2d",
+                                shape=(3, 64, 64)) == 5
+    # tuned chunk >= n_samples collapses to full vmap (the law's convention)
+    assert resolve_sample_chunk("auto", 8, 3, workload="wam2d",
+                                shape=(3, 64, 64)) is None
+
+
+def test_wam2d_resolves_tuned_chunk_and_stream(sched_cache):
+    from wam_tpu.models.toy import toy_conv_model
+    from wam_tpu.wam2d import WaveletAttribution2D
+
+    record_schedule("wam2d", (1, 8, 8), 2,
+                    {"sample_chunk": 2, "stream_noise": True},
+                    dtype="f32", backend=jax.default_backend())
+    toy = toy_conv_model(jax.random.PRNGKey(0), ndim=2)
+    wam = WaveletAttribution2D(lambda x: toy(x.mean(axis=1)),
+                               wavelet="haar", J=1, n_samples=4)
+    assert wam._resolve_chunk((2, 1, 8, 8)) == 2
+    assert wam._resolve_stream((2, 1, 8, 8)) is True
+    # attributions still come back under the tuned schedule
+    x = jax.random.normal(jax.random.PRNGKey(1), (2, 1, 8, 8))
+    out = wam(x, np.asarray([0, 1]))
+    assert out.shape == (2, 8, 8) and bool(jnp.isfinite(out).all())
+
+
+def test_seq_sharded_resolves_tuned_chunk(sched_cache):
+    from wam_tpu.parallel.seq_estimators import SeqShardedWam
+
+    record_schedule("wamseq2d", (8, 8), 4, {"sample_chunk": 3},
+                    dtype="f32", backend=jax.default_backend())
+    x = jnp.zeros((4, 8, 8))
+    sw = SeqShardedWam.__new__(SeqShardedWam)  # scheduling needs only ndim
+    sw.ndim = 2
+    assert sw._resolve_seq_chunk("auto", x, 8) == 3
+    sw.ndim = 1  # no entry for wamseq1d: sequential default
+    assert sw._resolve_seq_chunk("auto", x, 8) == 1
+    assert sw._resolve_seq_chunk(2, x, 8) == 2  # explicit passes through
+
+
+def test_serve_warmup_loads_schedule_cache(sched_cache):
+    """`AttributionServer.start()` must load the schedule cache BEFORE the
+    bucket warmup compiles, so tuned chunks are visible to the first trace
+    (serve/runtime.py round-6 wiring)."""
+    from wam_tpu.models.toy import toy_conv_model
+    from wam_tpu.serve import AttributionServer
+    from wam_tpu.tune import cache as tcache
+    from wam_tpu.wam2d import BaseWAM2D
+
+    invalidate_process_cache()
+    assert tcache._process_cache is None
+    toy = toy_conv_model(jax.random.PRNGKey(0), ndim=2)
+    wam = BaseWAM2D(lambda x: toy(x.mean(axis=1)), J=1)
+    server = AttributionServer(wam.serve_entry(), [(1, 8, 8)], max_batch=2,
+                               warmup=True)
+    try:
+        assert tcache._process_cache is not None
+    finally:
+        server.close()
+
+
+# -- fused ReLU-VJP -----------------------------------------------------------
+
+
+def test_pack_unpack_round_trip():
+    x = jax.random.normal(jax.random.PRNGKey(0), (16, 128))
+    gate = unpack_mask(pack_mask(x))
+    np.testing.assert_array_equal(np.asarray(gate), np.asarray(x) > 0)
+
+
+@pytest.fixture(params=["xla", "pallas_interpret"])
+def relu_impl(request):
+    set_fused_relu_impl(request.param)
+    yield request.param
+    set_fused_relu_impl("auto")
+
+
+def test_fused_relu_matches_jax_nn_relu(relu_impl):
+    # odd, non-tile-aligned shape exercises the pad/unpad seam; explicit
+    # zeros pin the subgradient-at-0 convention (gate x > 0, like
+    # jax.nn.relu — NOT jnp.maximum's 0.5/0.5 tie split)
+    x = jax.random.normal(jax.random.PRNGKey(3), (2, 3, 17, 19))
+    x = x.at[0, 0, 0, :5].set(0.0)
+
+    np.testing.assert_array_equal(np.asarray(fused_relu(x)),
+                                  np.asarray(jax.nn.relu(x)))
+
+    g = jax.random.normal(jax.random.PRNGKey(4), x.shape)
+    ref = jax.grad(lambda a: (jax.nn.relu(a) * g).sum())(x)
+    got = jax.grad(lambda a: (fused_relu(a) * g).sum())(x)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
+
+
+def test_fused_relu_bf16_grads(relu_impl):
+    x = jax.random.normal(jax.random.PRNGKey(5), (4, 37), jnp.bfloat16)
+    ref = jax.grad(lambda a: jax.nn.relu(a).astype(jnp.float32).sum())(x)
+    got = jax.grad(lambda a: fused_relu(a).astype(jnp.float32).sum())(x)
+    assert got.dtype == jnp.bfloat16
+    np.testing.assert_array_equal(np.asarray(got, np.float32),
+                                  np.asarray(ref, np.float32))
+
+
+def test_fused_relu_impl_validation():
+    with pytest.raises(ValueError):
+        set_fused_relu_impl("cuda")
+
+
+def test_bind_inference_fused_relu_attribution_parity(relu_impl):
+    """The gate for fused_relu_vjp=True: input-gradient attributions of the
+    bound model must match the stock binding exactly (same values, same
+    gate), on a real residual network."""
+    from wam_tpu.models import bind_inference, resnet18
+
+    model = resnet18(num_classes=4)
+    variables = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 32, 32, 3)))
+    x = jax.random.normal(jax.random.PRNGKey(1), (2, 3, 32, 32))
+    y = jnp.asarray([1, 3])
+
+    def saliency(fn):
+        def loss(a):
+            return jnp.take_along_axis(fn(a), y[:, None], axis=1).sum()
+        return jax.grad(loss)(x)
+
+    ref = saliency(bind_inference(model, variables, nchw=True))
+    got = saliency(bind_inference(model, variables, nchw=True,
+                                  fused_relu_vjp=True))
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=1e-6, atol=1e-6)
+    cos = float(
+        (got * ref).sum()
+        / (jnp.linalg.norm(got.ravel()) * jnp.linalg.norm(ref.ravel()))
+    )
+    assert cos > 0.9999
+
+
+def test_bind_inference_fused_relu_requires_act_attr():
+    from wam_tpu.models import bind_inference
+
+    class NoAct:
+        pass
+
+    with pytest.raises(ValueError, match="act"):
+        bind_inference(NoAct(), {}, fused_relu_vjp=True)
+
+
+# -- fused μ-fidelity draws ---------------------------------------------------
+
+
+def test_mu_fidelity_draws_fusion_matches_reference():
+    """The single-upload (B, 2, S, g²) fusion must reproduce the exact
+    per-tensor draws (same rng call order) the evaluators consumed before
+    round 6."""
+    from wam_tpu.evalsuite.metrics import mu_fidelity_draws
+
+    seed, B, g, S, subset = 7, 2, 4, 6, 5
+    rand, onehot = mu_fidelity_draws({}, seed, B, g, S, subset,
+                                     with_rand_masks=True)
+    assert rand.shape == (B, S, g, g)
+    assert onehot.shape == (B, S, g * g)
+
+    rng = np.random.default_rng(seed)
+    for b in range(B):
+        ref_rand = rng.uniform(size=(S, g, g)).astype(np.float32)
+        subsets = np.stack([rng.choice(g * g, size=subset, replace=False)
+                            for _ in range(S)])
+        ref_onehot = np.zeros((S, g * g), dtype=np.float32)
+        np.put_along_axis(ref_onehot, subsets, 1.0, axis=1)
+        np.testing.assert_array_equal(np.asarray(rand[b]), ref_rand)
+        np.testing.assert_array_equal(np.asarray(onehot[b]), ref_onehot)
+    assert np.all(np.asarray(onehot).sum(axis=-1) == subset)
+
+    # the cache returns the same device buffers without redrawing
+    cache = {}
+    first = mu_fidelity_draws(cache, seed, B, g, S, subset, with_rand_masks=True)
+    again = mu_fidelity_draws(cache, seed, B, g, S, subset, with_rand_masks=True)
+    assert first[0] is again[0] and first[1] is again[1]
+
+
+# -- autotuner ----------------------------------------------------------------
+
+
+def test_chunk_candidates_ladder():
+    from wam_tpu.tune.autotuner import chunk_candidates
+
+    cands = chunk_candidates(32, 25)
+    # 128/256/512-row targets at b32 → chunks 4, 8, 16, plus full vmap
+    assert cands == [4, 8, 16, None]
+    assert chunk_candidates(4, 3) == [None]  # every target >= n_samples
+
+
+def test_autotune_toy_dry_run(sched_cache):
+    """The CI smoke the verify skill runs: measure the toy candidate set on
+    CPU, crown a winner, persist nothing."""
+    from wam_tpu.tune.autotuner import autotune
+    from wam_tpu.tune.workloads import get_workload
+
+    out = autotune(get_workload("toy"), k=1, laps=1, persist=False)
+    assert out["persisted"] is False
+    assert not sched_cache.exists()
+    assert out["key"].startswith("wam2d_toy|32x32|b4|f32|")
+    ent = out["entry"]
+    assert ent["sample_chunk"] is None or ent["sample_chunk"] >= 1
+    assert ent["items_per_s"] > 0
+    assert ent["plane"] in ("device", "wall")
+    assert len(out["results"]) >= 2
+    # a dry run must leave the live schedule untouched
+    assert load_schedule_cache().get(out["key"]) is None

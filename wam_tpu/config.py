@@ -206,7 +206,9 @@ class EvalConfig:
     grid_size: int = 28
     sample_size: int = 128
     subset_size: int = 157
-    batch_size: int = 128
+    # "auto" = the tuned eval fan_cap when a schedule entry exists, else 128
+    # (wam_tpu.tune.resolve_fan_cap)
+    batch_size: int | str = 128
     device: str = "auto"
 
 

@@ -38,20 +38,44 @@ def validate_sample_batch_size(value) -> None:
 # The v5e scheduling law all three modalities obey (BASELINE.md round-3
 # scaling study + the round-4 median-of-k re-sweeps that overturned the
 # "audio/3D prefer full vmap" single-min artifact): ~128 model rows per
-# mapped sample step.
+# mapped sample step. Since round 6 this is the FALLBACK: a tuned schedule
+# in the `wam_tpu.tune` cache (keyed by workload/shape/batch/dtype/impl/
+# backend) wins over the law when the caller identifies its workload.
 _AUTO_TARGET_ROWS = 128
 
 
-def resolve_sample_chunk(sample_batch_size, batch: int, n_samples: int):
-    """Trace-time resolution of sample_batch_size="auto": chunk the sample
-    map so chunk·batch ≈ 128 model rows on TPU, full vmap elsewhere.
-    Explicit ints/None pass through."""
+def _clamp_chunk(chunk, n_samples: int):
+    if chunk is None or int(chunk) >= n_samples:
+        return None
+    return max(1, int(chunk))
+
+
+def resolve_sample_chunk(sample_batch_size, batch: int, n_samples: int,
+                         *, workload: str | None = None, shape=None,
+                         dtype: str = "f32", dwt_impl: str | None = None):
+    """Trace-time resolution of sample_batch_size="auto".
+
+    Explicit ints/None pass through. For "auto", a tuned entry from the
+    schedule cache (`wam_tpu.tune.lookup_schedule`, keyed by
+    ``workload``/``shape``/``batch``/``dtype``/dwt impl/backend) is
+    consulted first — on ANY backend, so a CPU- or future-backend tune is
+    honored too; its chunk is clamped to ``n_samples`` (chunk ≥ n → full
+    vmap, same convention as the law). Without a matching entry (or with
+    ``workload=None``, the legacy call shape): chunk·batch ≈ 128 model rows
+    on TPU, full vmap elsewhere — exactly the round-5 behavior.
+    """
     if sample_batch_size != "auto":
         return sample_batch_size
+    if workload is not None:
+        from wam_tpu.tune import lookup_schedule
+
+        ent = lookup_schedule(workload, shape, batch, dtype, dwt_impl)
+        if ent is not None and "sample_chunk" in ent:
+            return _clamp_chunk(ent["sample_chunk"], n_samples)
     if jax.default_backend() != "tpu":
         return None
     chunk = max(1, _AUTO_TARGET_ROWS // max(1, int(batch)))
-    return None if chunk >= n_samples else chunk
+    return _clamp_chunk(chunk, n_samples)
 
 
 def noise_sigma(x: jax.Array, stdev_spread: float) -> jax.Array:

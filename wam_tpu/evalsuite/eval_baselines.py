@@ -49,7 +49,8 @@ class _BaseEvalBaselines:
     evaluator to change them. ``mesh`` shards every metric's
     perturbation-inference batch over ``data_axis`` (§2.10)."""
 
-    def __init__(self, model, variables, method: str, batch_size: int, random_seed: int,
+    def __init__(self, model, variables, method: str, batch_size: int | str,
+                 random_seed: int,
                  n_samples: int, stdev_spread: float, cam_layer: str, nchw: bool,
                  methods: tuple[str, ...], mesh=None, data_axis: str = "data",
                  compute_dtype=None):
@@ -154,6 +155,13 @@ class _BaseEvalBaselines:
     def _perturb(self, x_s: jax.Array, masks: jax.Array) -> jax.Array:
         raise NotImplementedError
 
+    def _fan_cap(self, fan: int) -> int:
+        """Perturbation-fan chunk cap: ``batch_size="auto"`` consults the
+        tuned ``fan_cap`` schedule (wam_tpu.tune), ints pass through."""
+        from wam_tpu.tune import resolve_fan_cap
+
+        return resolve_fan_cap(self.batch_size, fan)
+
     def evaluate_auc(self, x, y, mode: str, n_iter: int = 128):
         x = jnp.asarray(x)
         y = np.asarray(y)
@@ -172,7 +180,7 @@ class _BaseEvalBaselines:
             (mode, tuple(expl.shape[1:])),
             inputs_fn,
             self.model_fn,
-            self.batch_size,
+            self._fan_cap(n_iter + 1),
             n_iter,
             x,
             expl,
@@ -202,7 +210,7 @@ class EvalImageBaselines(_BaseEvalBaselines):
         model,
         variables,
         method: str = "saliency",
-        batch_size: int = 128,
+        batch_size: int | str = 128,
         random_seed: int = 42,
         n_samples: int = 25,
         stdev_spread: float = 0.25,
@@ -229,7 +237,7 @@ class EvalImageBaselines(_BaseEvalBaselines):
     def _make_mu_runner(self, grid_size: int, sample_size: int, img_hw):
         """ONE-jit-dispatch pixel-domain μ-fidelity for the whole batch
         (VERDICT.md round-2 weak #3)."""
-        images_per_chunk, fan_chunk = fan_chunk_geometry(self.batch_size, sample_size)
+        images_per_chunk, fan_chunk = fan_chunk_geometry(self._fan_cap(sample_size), sample_size)
         forward = make_chunked_forward(self.model_fn, fan_chunk)
 
         def forward_probs(inputs, label):
@@ -298,7 +306,7 @@ class EvalAudioBaselines(_BaseEvalBaselines):
         model,
         variables,
         method: str = "saliency",
-        batch_size: int = 128,
+        batch_size: int | str = 128,
         random_seed: int = 42,
         n_samples: int = 25,
         stdev_spread: float = 0.001,
@@ -347,7 +355,7 @@ class EvalAudioBaselines(_BaseEvalBaselines):
             (mode, tuple(expl.shape[1:])),
             inputs_fn,
             self.model_fn,
-            self.batch_size,
+            self._fan_cap(n_iter + 1),
             n_iter,
             x,
             expl,

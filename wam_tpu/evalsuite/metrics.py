@@ -105,7 +105,14 @@ def mu_fidelity_draws(cache: dict, seed: int, n_images: int, grid_size: int,
     for a fixed seed, so cached per full config INCLUDING the seed:
     regenerating the 1024 `rng.choice` calls at production geometry cost
     ~40% of the μ wall time (round-4 trace). Returns (rand_masks, onehots)
-    or just onehots."""
+    or just onehots.
+
+    The two tensors are FUSED into one host→device upload (round 6): the
+    continuous masks (B, S, g, g) and the subset one-hots (B, S, g²) have
+    equal element counts, so they stack into one (B, 2, S, g²) host array
+    transferred once — on the tunneled platform each separate upload costs
+    its own ~100 ms round trip. The returned arrays are on-device slices of
+    that single buffer; call-site signature is unchanged."""
     import numpy as np
 
     key = (seed, n_images, grid_size, sample_size, subset_size, with_rand_masks)
@@ -128,8 +135,20 @@ def mu_fidelity_draws(cache: dict, seed: int, n_images: int, grid_size: int,
         onehot = np.zeros((sample_size, grid_size * grid_size), dtype=np.float32)
         np.put_along_axis(onehot, subsets, 1.0, axis=1)
         onehots.append(onehot)
-    oh = jnp.asarray(np.stack(onehots))
-    out = (jnp.asarray(np.stack(rand_masks)), oh) if with_rand_masks else oh
+    if with_rand_masks:
+        g2 = grid_size * grid_size
+        fused_host = np.stack(
+            [np.stack(rand_masks).reshape(n_images, sample_size, g2),
+             np.stack(onehots)],
+            axis=1,
+        )  # (B, 2, S, g²): ONE tunnel crossing for both tensors
+        fused = jnp.asarray(fused_host)
+        out = (
+            fused[:, 0].reshape(n_images, sample_size, grid_size, grid_size),
+            fused[:, 1],
+        )
+    else:
+        out = jnp.asarray(np.stack(onehots))
     cache[key] = out
     return out
 

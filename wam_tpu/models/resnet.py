@@ -240,6 +240,7 @@ def bind_inference(
     nchw: bool = True,
     compute_dtype: Any | None = None,
     fold_bn: bool = False,
+    fused_relu_vjp: bool = False,
 ) -> Callable[[jax.Array], jax.Array]:
     """Bind params into a pure `x -> logits` function.
 
@@ -255,7 +256,23 @@ def bind_inference(
 
     fold_bn=True folds BatchNorm multiplies into conv kernels (see
     `_fold_bn_variables`) — same function, cheaper VJP.
+
+    fused_relu_vjp=True swaps the model's ``act`` for
+    `wam_tpu.tune.fused_relu` — a `custom_vjp` ReLU whose residual is a
+    bit-packed sign mask (1/32 the bytes of the activation XLA's default
+    VJP saves) and whose backward is one masked multiply. Same values, same
+    gradients (gate x>0, like `jax.nn.relu`); parameters untouched, so it
+    composes with ``fold_bn``/``compute_dtype`` and checkpoint ingestion.
     """
+    if fused_relu_vjp:
+        if not hasattr(model, "act"):
+            raise ValueError(
+                "fused_relu_vjp=True requires a model with an `act` attribute "
+                f"(got {type(model).__name__})"
+            )
+        from wam_tpu.tune.fused_relu import fused_relu
+
+        model = model.clone(act=fused_relu)
     if fold_bn:
         variables = _fold_bn_variables(variables)
     if compute_dtype is not None:

@@ -186,6 +186,23 @@ class SeqShardedWam:
 
     # -- pieces ------------------------------------------------------------
 
+    def _resolve_seq_chunk(self, sample_chunk, x, n_samples: int):
+        """``sample_chunk="auto"``: consult the round-6 schedule cache under
+        workload ``"wamseq{ndim}d"`` (tuned via `wam_tpu.tune`); with no
+        matching entry, fall back to this module's sequential default (1) —
+        NOT the single-device 128-row law, whose full-vmap non-TPU branch
+        would materialize every sequence-sized sample graph at once."""
+        if sample_chunk != "auto":
+            return sample_chunk
+        from wam_tpu.tune import lookup_schedule
+
+        ent = lookup_schedule(f"wamseq{self.ndim}d", tuple(x.shape[1:]),
+                              x.shape[0])
+        if ent is not None and "sample_chunk" in ent:
+            chunk = ent["sample_chunk"]
+            return None if chunk is None else max(1, int(chunk))
+        return 1
+
     def _reconstruct(self, cs, spatial):
         sig = self._rec_signal(cs)
         idx = (Ellipsis,) + tuple(slice(0, s) for s in spatial)
@@ -359,7 +376,7 @@ class SeqShardedWam:
     # -- estimators --------------------------------------------------------
 
     def smoothgrad(self, x, y, key, *, n_samples: int, stdev_spread: float,
-                   sample_chunk: int | None = 1):
+                   sample_chunk: int | None | str = 1):
         """Mean over ``n_samples`` shard-local noisy passes. Same draws and
         per-sample gradients as `core.estimators.smoothgrad(step, x, key,
         .., materialize_noise=False)` wrapping the same single-device step
@@ -371,8 +388,10 @@ class SeqShardedWam:
         128-row schedule law; memory grows by the same factor). ``None``
         means ALL samples in one dispatch (the resolvers' full-vmap
         convention). Identical draws and per-sample gradients; only the
-        summation order differs."""
+        summation order differs. ``"auto"`` consults the round-6 schedule
+        cache (`_resolve_seq_chunk`)."""
         self._check_batched(x)
+        sample_chunk = self._resolve_seq_chunk(sample_chunk, x, n_samples)
         if sample_chunk is None:
             sample_chunk = n_samples
         spatial = tuple(x.shape[-self.ndim:])
@@ -409,14 +428,16 @@ class SeqShardedWam:
         return self._finalize(self._scale(acc, 1.0 / n_samples))
 
     def integrated(self, x, y, *, n_steps: int, dx: float = 1.0,
-                   sample_chunk: int | None = 1):
+                   sample_chunk: int | None | str = 1):
         """Trapezoidal path integral of the gradient over α·coeffs — the
         per-element `nan_to_num` and endpoint halving reproduce
         `core.estimators.trapezoid` up to float summation order. Returns
         (gathered coeffs, integral pytree); the caller multiplies by its
         baseline. ``sample_chunk`` batches that many α-steps per dispatch
-        (None = all), same mechanics as `smoothgrad`'s."""
+        (None = all, "auto" = schedule cache), same mechanics as
+        `smoothgrad`'s."""
         self._check_batched(x)
+        sample_chunk = self._resolve_seq_chunk(sample_chunk, x, n_steps)
         spatial = tuple(x.shape[-self.ndim:])
         coeffs = self.dec(x)
         alphas = jnp.linspace(0.0, 1.0, n_steps, dtype=jnp.float32)

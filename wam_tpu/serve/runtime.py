@@ -173,6 +173,15 @@ class AttributionServer:
 
             enable_compilation_cache()
         if self.warmup:
+            # Load the tuned schedule table BEFORE the warmup compiles: the
+            # entries' sample_batch_size="auto" resolution reads it at trace
+            # time, so a tuned chunk must be visible to the very first trace
+            # or the bucket compiles (and serves) the fallback law schedule
+            # (`wam_tpu.tune`; use `python -m wam_tpu.prewarm` to populate
+            # both this and the XLA cache offline).
+            from wam_tpu.tune import load_schedule_cache
+
+            load_schedule_cache()
             for bucket in self.table:
                 self._dispatch(*self._zeros_batch(bucket))
         self._worker = threading.Thread(

@@ -1,0 +1,66 @@
+"""wam_tpu.tune — schedule autotuner + fused backward kernels.
+
+Round 5's roofline put the flagship step at 29.7% of its HBM-traffic floor
+and ~7% of bf16 peak: the gap is schedule, not arithmetic. This package
+harvests it on two fronts:
+
+- **Schedule autotuning** (`cache`, `autotuner`, `workloads`): a measured,
+  persisted schedule table keyed by (workload, shape, batch, dtype,
+  dwt impl, backend) that `core.estimators.resolve_sample_chunk("auto")`,
+  the three engines, `parallel.SeqShardedWam`, and serve warmup consult —
+  replacing the single hand-fit 128-row-law constant. Run
+  ``python -m wam_tpu.tune`` to (re)tune; winners persist to
+  ``~/.cache/wam_tpu/schedules.json`` over the repo-pinned defaults.
+- **Fused backward kernels** (`fused_relu`): a packed-sign-mask
+  `custom_vjp` ReLU (residual 1/32 the bytes, backward one masked multiply)
+  enabled by ``models.bind_inference(..., fused_relu_vjp=True)``.
+"""
+
+from wam_tpu.tune.cache import (
+    SCHEDULE_CACHE_VERSION,
+    ScheduleCache,
+    default_cache_path,
+    invalidate_process_cache,
+    load_schedule_cache,
+    lookup_schedule,
+    record_schedule,
+    resolve_fan_cap,
+    schedule_key,
+)
+from wam_tpu.tune.fused_relu import (
+    fused_relu,
+    get_fused_relu_impl,
+    set_fused_relu_impl,
+)
+
+__all__ = [
+    "SCHEDULE_CACHE_VERSION",
+    "ScheduleCache",
+    "default_cache_path",
+    "invalidate_process_cache",
+    "load_schedule_cache",
+    "lookup_schedule",
+    "record_schedule",
+    "resolve_fan_cap",
+    "schedule_key",
+    "fused_relu",
+    "get_fused_relu_impl",
+    "set_fused_relu_impl",
+    "autotune",
+    "Candidate",
+    "chunk_candidates",
+]
+
+
+def __getattr__(name):
+    # autotuner/workloads import profiling + engines; keep `import
+    # wam_tpu.tune` light for the resolve_sample_chunk hot path.
+    if name in ("autotune", "Candidate", "chunk_candidates", "measure_candidate"):
+        from wam_tpu.tune import autotuner
+
+        return getattr(autotuner, name)
+    if name in ("get_workload", "WORKLOADS"):
+        from wam_tpu.tune import workloads
+
+        return getattr(workloads, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

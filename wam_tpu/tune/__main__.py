@@ -1,0 +1,85 @@
+"""Autotuner CLI.
+
+    python -m wam_tpu.tune --workload toy --dry-run --device cpu   # CI smoke
+    python -m wam_tpu.tune --workload flagship                      # tune + persist
+    python -m wam_tpu.tune --workload mu2d --k 5
+
+Sweeps the workload's candidate schedules (`wam_tpu.tune.workloads`),
+prints one progress line per candidate to stderr and ONE JSON summary line
+to stdout, and persists the winner to the user schedule cache
+(``$WAM_TPU_SCHEDULE_CACHE`` or ``~/.cache/wam_tpu/schedules.json``) unless
+``--dry-run``. Measurement plane is device (xplane module spans) on TPU,
+wall elsewhere — recorded in the output so numbers are never misread.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m wam_tpu.tune",
+        description="Sweep candidate schedules and persist the winner.",
+    )
+    p.add_argument("--workload", default="toy",
+                   help="preset name: toy | flagship | mu2d")
+    p.add_argument("--device", default="auto",
+                   help="backend: auto | tpu | cpu")
+    p.add_argument("--k", type=int, default=3, help="samples per candidate")
+    p.add_argument("--laps", type=int, default=2,
+                   help="calls per timed region (amortizes the tunnel RTT)")
+    p.add_argument("--dry-run", action="store_true",
+                   help="sweep and report but do not persist the winner")
+    args = p.parse_args(argv)
+
+    from wam_tpu.config import (
+        enable_compilation_cache,
+        ensure_usable_backend,
+        select_backend,
+    )
+
+    # Backend must be pinned BEFORE first jax use: the axon TPU plugin
+    # force-selects itself and ignores a late JAX_PLATFORMS env alone
+    # (verify-skill gotcha), and can hang when its pool is unreachable.
+    select_backend(args.device)
+    if args.device in ("auto", "tpu"):
+        ensure_usable_backend(timeout_s=180.0)
+    enable_compilation_cache()
+
+    import jax
+
+    from wam_tpu.tune.autotuner import autotune
+    from wam_tpu.tune.cache import default_cache_path
+    from wam_tpu.tune.workloads import get_workload
+
+    wl = get_workload(args.workload)
+    print(f"# backend={jax.default_backend()} workload={wl.name} "
+          f"candidates={len(wl.candidates)} k={args.k} laps={args.laps}",
+          file=sys.stderr)
+    res = autotune(wl, k=args.k, laps=args.laps, persist=not args.dry_run,
+                   log=lambda s: print(s, file=sys.stderr))
+    print(json.dumps({
+        "workload": wl.name,
+        "key": res["key"],
+        "winner": res["winner"]["label"],
+        "items_per_s": round(res["winner"]["items_per_s"], 3),
+        "median_s": round(res["winner"]["median_s"], 6),
+        "plane": res["winner"]["plane"],
+        "backend": jax.default_backend(),
+        "persisted": res["persisted"],
+        "cache": default_cache_path() if res["persisted"] else None,
+        "candidates": [
+            {"label": r["label"], "items_per_s": round(r["items_per_s"], 3),
+             "median_s": round(r["median_s"], 6),
+             "q1_s": round(r["q1_s"], 6), "q3_s": round(r["q3_s"], 6)}
+            for r in res["results"]
+        ],
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

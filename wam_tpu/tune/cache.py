@@ -1,0 +1,209 @@
+"""Versioned schedule cache — where autotuned schedules live.
+
+Round 5 proved the flagship step runs at 29.7% of its traffic floor with the
+schedule as the gap (VERDICT.md r5 weak #1); round 3 fit the 128-row chunk
+"law" by hand at one geometry. This module replaces the single
+`_AUTO_TARGET_ROWS` constant with a keyed, persisted schedule table:
+
+- **Key**: one canonical string per
+  (workload, input shape, batch, dtype, dwt impl, backend) — the axes the
+  round-3/5 studies showed change the optimum.
+- **Entry**: the winning knobs (``sample_chunk``, ``stream_noise``,
+  ``dwt_impl``, ``layout``, ``fan_cap``) plus the measurement that crowned
+  them (median seconds, items/s, measurement plane) so a future re-tune can
+  tell whether it actually improved anything.
+- **Two layers**: repo-pinned defaults (``default_schedules.json`` next to
+  this file — the schedules measured in BASELINE.md, shipped so the class
+  API delivers the recorded numbers out of the box) overlaid by the user
+  cache (``$WAM_TPU_SCHEDULE_CACHE`` or ``~/.cache/wam_tpu/schedules.json``)
+  where `wam_tpu.tune.autotune` persists winners. User entries win.
+- **Versioning**: files carry ``version``; a file with a different version
+  is IGNORED wholesale (stale-schema entries must not steer the schedule)
+  and overwritten on the next `save()`.
+
+Resolution (`core.estimators.resolve_sample_chunk`, the engines'
+``sample_batch_size="auto"``) consults `lookup_schedule` first and falls
+back to the 128-row law when no entry matches, so behavior without a cache
+file is exactly the round-5 build.
+
+Set ``WAM_TPU_NO_SCHEDULE_CACHE=1`` to disable all lookups (the law only) —
+the A/B kill switch every schedule experiment needs.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+
+__all__ = [
+    "SCHEDULE_CACHE_VERSION",
+    "schedule_key",
+    "default_cache_path",
+    "ScheduleCache",
+    "load_schedule_cache",
+    "lookup_schedule",
+    "record_schedule",
+    "resolve_fan_cap",
+    "invalidate_process_cache",
+]
+
+SCHEDULE_CACHE_VERSION = 1
+
+_lock = threading.Lock()
+_process_cache: "ScheduleCache | None" = None
+
+
+def default_cache_path() -> str:
+    """$WAM_TPU_SCHEDULE_CACHE or ~/.cache/wam_tpu/schedules.json (sibling
+    of the XLA compilation cache — `config.enable_compilation_cache`)."""
+    return os.environ.get(
+        "WAM_TPU_SCHEDULE_CACHE",
+        os.path.expanduser("~/.cache/wam_tpu/schedules.json"),
+    )
+
+
+def _pinned_path() -> str:
+    return os.path.join(os.path.dirname(__file__), "default_schedules.json")
+
+
+def schedule_key(
+    workload: str,
+    shape,
+    batch: int,
+    dtype: str = "f32",
+    dwt_impl: str | None = None,
+    backend: str | None = None,
+) -> str:
+    """Canonical cache key. ``shape`` is the per-item shape (no batch axis);
+    ``dtype`` is the DWT-boundary dtype label ("f32"/"bf16"); ``dwt_impl``
+    defaults to the RESOLVED current 2D impl (auto → pallas/conv) so a key
+    built under impl="auto" matches the impl that actually runs; ``backend``
+    defaults to the live `jax.default_backend()`."""
+    if dwt_impl is None or backend is None:
+        import jax
+
+        if backend is None:
+            backend = jax.default_backend()
+        if dwt_impl is None:
+            from wam_tpu.wavelets import transform as wt
+
+            dwt_impl = wt._resolved_dwt2_impl()
+    shape_s = "x".join(str(int(d)) for d in shape) if shape else "-"
+    return f"{workload}|{shape_s}|b{int(batch)}|{dtype}|{dwt_impl}|{backend}"
+
+
+class ScheduleCache:
+    """Pinned-defaults + user-file schedule table (see module docstring)."""
+
+    def __init__(self, path: str | None = None, pinned: bool = True):
+        self.path = path or default_cache_path()
+        self.entries: dict[str, dict] = {}
+        self.stale_files: list[str] = []
+        if pinned:
+            self._merge_file(_pinned_path())
+        self._merge_file(self.path)
+
+    def _merge_file(self, path: str) -> None:
+        try:
+            with open(path) as f:
+                data = json.load(f)
+        except (OSError, ValueError):
+            return
+        if not isinstance(data, dict) or data.get("version") != SCHEDULE_CACHE_VERSION:
+            # stale schema: ignore every entry rather than half-apply it
+            self.stale_files.append(path)
+            return
+        schedules = data.get("schedules", {})
+        if isinstance(schedules, dict):
+            for k, v in schedules.items():
+                if isinstance(v, dict):
+                    self.entries[k] = v
+
+    def get(self, key: str) -> dict | None:
+        return self.entries.get(key)
+
+    def put(self, key: str, entry: dict) -> None:
+        self.entries[key] = dict(entry)
+
+    def save(self, path: str | None = None) -> str:
+        """Write the USER layer (every current entry that is not a pinned
+        default, plus any tuned overrides of pinned keys) atomically."""
+        path = path or self.path
+        pinned = ScheduleCache(path=os.devnull, pinned=True).entries
+        user = {k: v for k, v in self.entries.items() if pinned.get(k) != v}
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        payload = {"version": SCHEDULE_CACHE_VERSION, "schedules": user}
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(payload, f, indent=1, sort_keys=True)
+        os.replace(tmp, path)
+        return path
+
+
+def load_schedule_cache(refresh: bool = False) -> ScheduleCache:
+    """Process-global cache, loaded once (file IO happens at first "auto"
+    resolution or at serve/prewarm warmup, never per trace)."""
+    global _process_cache
+    with _lock:
+        if _process_cache is None or refresh:
+            _process_cache = ScheduleCache()
+        return _process_cache
+
+
+def invalidate_process_cache() -> None:
+    """Drop the singleton (tests; after an external process wrote the file)."""
+    global _process_cache
+    with _lock:
+        _process_cache = None
+
+
+def _disabled() -> bool:
+    return os.environ.get("WAM_TPU_NO_SCHEDULE_CACHE", "") not in ("", "0")
+
+
+def lookup_schedule(
+    workload: str,
+    shape,
+    batch: int,
+    dtype: str = "f32",
+    dwt_impl: str | None = None,
+    backend: str | None = None,
+) -> dict | None:
+    """Entry for the key, or None (→ caller falls back to the 128-row law)."""
+    if _disabled():
+        return None
+    key = schedule_key(workload, shape, batch, dtype, dwt_impl, backend)
+    return load_schedule_cache().get(key)
+
+
+def record_schedule(
+    workload: str,
+    shape,
+    batch: int,
+    entry: dict,
+    dtype: str = "f32",
+    dwt_impl: str | None = None,
+    backend: str | None = None,
+    persist: bool = True,
+) -> str:
+    """Install (and by default persist) a tuned entry; returns the key."""
+    key = schedule_key(workload, shape, batch, dtype, dwt_impl, backend)
+    cache = load_schedule_cache()
+    cache.put(key, entry)
+    if persist:
+        cache.save()
+    return key
+
+
+def resolve_fan_cap(batch_size, fan: int, *, workload: str = "eval2d",
+                    shape=None, default: int = 128) -> int:
+    """Evaluation fan-chunk cap: explicit ints pass through; "auto" consults
+    the tuned ``fan_cap`` for (workload, fan) and falls back to ``default``
+    (the EvalConfig.batch_size the rounds 1-5 numbers were recorded at)."""
+    if batch_size != "auto":
+        return int(batch_size)
+    ent = lookup_schedule(workload, shape or (fan,), fan)
+    if ent is not None and ent.get("fan_cap"):
+        return int(ent["fan_cap"])
+    return default

@@ -1,0 +1,194 @@
+"""Fused ReLU VJP: packed sign-mask residual, one-multiply backward.
+
+The round-4 flagship backward trace's largest fusion family is the ReLU
+cotangent chain: XLA's default ReLU VJP saves the full f32/bf16 activation
+as the residual and re-derives the gate in the backward pass as a
+compare+select against that tensor — per ReLU site that is a full-activation
+HBM round trip (write forward, read backward) plus a compare the VPU repeats
+25× per SmoothGrad step. This module replaces it with a `jax.custom_vjp`
+ReLU whose residual is the **sign mask bit-packed 8/lane into uint8** (1/32
+the bytes of the f32 activation it replaces) and whose backward is **one
+masked multiply** — no compare, no full-precision residual traffic.
+
+Three interchangeable implementations (`set_fused_relu_impl` /
+``WAM_TPU_FUSED_RELU_IMPL``):
+
+- ``"xla"`` — portable jnp shift/or bit packing; XLA fuses pack into the
+  forward and unpack+multiply into one backward kernel. Default off-TPU.
+- ``"pallas"`` — one Pallas kernel per direction (forward emits y + packed
+  mask in a single pass; backward unpacks and multiplies in-register).
+  Default on TPU.
+- ``"pallas_interpret"`` — the same kernels under ``interpret=True`` so the
+  kernel *code path* (not just the math) regression-tests on CPU CI — the
+  round-5 shard_map/vma lesson: portable interpret coverage catches
+  real-hardware-only breakage classes before the chip does.
+
+Gradient convention matches `jax.nn.relu` exactly: gate is ``x > 0``, so
+the subgradient at 0 is 0 (jax.nn.relu's custom_jvp pins the same choice;
+`jnp.maximum`'s raw VJP would split ties 0.5/0.5).
+
+Wire-up: ``models.bind_inference(..., fused_relu_vjp=True)`` clones the
+model with ``act=fused_relu`` — parameters are untouched (ReLU has none),
+so the flag composes with ``fold_bn``/``compute_dtype`` and checkpoint
+ingestion. Gated by the attribution-cosine parity check in
+tests/test_tune.py before it may default on.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["fused_relu", "set_fused_relu_impl", "get_fused_relu_impl",
+           "pack_mask", "unpack_mask"]
+
+_LANES = 128
+_PACK = 8  # sign bits per uint8
+_BLOCK = _PACK * _LANES  # flat elements per packed row group
+
+_IMPLS = ("auto", "xla", "pallas", "pallas_interpret")
+_impl = "auto"
+
+
+def set_fused_relu_impl(name: str) -> None:
+    """Select the fused-ReLU backend for *not-yet-traced* calls (same jit
+    caching caveat as `wavelets.set_dwt2_impl`)."""
+    global _impl
+    if name not in _IMPLS:
+        raise ValueError(f"impl {name!r} not one of {_IMPLS}")
+    _impl = name
+
+
+set_fused_relu_impl(os.environ.get("WAM_TPU_FUSED_RELU_IMPL", "auto"))
+
+
+def get_fused_relu_impl() -> str:
+    return _impl
+
+
+def _resolved_impl() -> str:
+    if _impl == "auto":
+        return "pallas" if jax.default_backend() == "tpu" else "xla"
+    return _impl
+
+
+# -- packed-mask layout ------------------------------------------------------
+#
+# x is flattened, zero-padded to a multiple of 8·128, and viewed as
+# (R, 128) with R a multiple of 8. The mask packs the SUBLANE axis: 8
+# consecutive rows fold into one uint8 row, m[r, l] = Σ_b (x[8r+b, l] > 0)·2^b
+# — the lane axis stays 128-wide in both tensors, so the same (rows, 128)
+# tiling serves f32 input and uint8 mask on TPU. Zero pad rows pack to 0
+# bits and multiply pad cotangent rows that are sliced off, so padding never
+# leaks into real gradients.
+
+
+def _flat_rows(n: int) -> int:
+    return -(-n // _BLOCK) * _PACK
+
+
+def pack_mask(x: jax.Array) -> jax.Array:
+    """(R, 128) float → (R//8, 128) uint8 of sign bits (x > 0)."""
+    bits = (x > 0).astype(jnp.uint8).reshape(-1, _PACK, _LANES)
+    weights = jnp.uint8(1) << jnp.arange(_PACK, dtype=jnp.uint8)
+    return (bits * weights[None, :, None]).sum(axis=1, dtype=jnp.uint8)
+
+
+def unpack_mask(m: jax.Array) -> jax.Array:
+    """(R//8, 128) uint8 → (R, 128) float32 0/1 gate."""
+    shifts = jnp.arange(_PACK, dtype=jnp.uint8)
+    bits = (m[:, None, :] >> shifts[None, :, None]) & jnp.uint8(1)
+    return bits.reshape(-1, _LANES).astype(jnp.float32)
+
+
+# -- pallas kernels ----------------------------------------------------------
+
+
+def _fwd_kernel(x_ref, y_ref, m_ref):
+    x = x_ref[...]
+    y_ref[...] = jnp.maximum(x, jnp.zeros((), x.dtype))
+    m_ref[...] = pack_mask(x)
+
+
+def _bwd_kernel(m_ref, g_ref, dx_ref):
+    g = g_ref[...]
+    dx_ref[...] = g * unpack_mask(m_ref[...]).astype(g.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _pallas_fwd(x2, interpret: bool):
+    from jax.experimental import pallas as pl
+
+    rows = x2.shape[0]
+    return pl.pallas_call(
+        _fwd_kernel,
+        out_shape=(
+            jax.ShapeDtypeStruct(x2.shape, x2.dtype),
+            jax.ShapeDtypeStruct((rows // _PACK, _LANES), jnp.uint8),
+        ),
+        interpret=interpret,
+    )(x2)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _pallas_bwd(m, g2, interpret: bool):
+    from jax.experimental import pallas as pl
+
+    return pl.pallas_call(
+        _bwd_kernel,
+        out_shape=jax.ShapeDtypeStruct(g2.shape, g2.dtype),
+        interpret=interpret,
+    )(m, g2)
+
+
+# -- the custom-vjp op -------------------------------------------------------
+
+
+def _to_rows(a: jax.Array) -> jax.Array:
+    flat = a.reshape(-1)
+    rows = _flat_rows(flat.shape[0])
+    pad = rows * _LANES - flat.shape[0]
+    if pad:
+        flat = jnp.pad(flat, (0, pad))
+    return flat.reshape(rows, _LANES)
+
+
+def _from_rows(a2: jax.Array, shape, dtype) -> jax.Array:
+    n = 1
+    for d in shape:
+        n *= int(d)
+    return a2.reshape(-1)[:n].reshape(shape).astype(dtype)
+
+
+@jax.custom_vjp
+def fused_relu(x: jax.Array) -> jax.Array:
+    """ReLU with the packed-mask fused backward (module docstring). The
+    primal is a plain `jnp.maximum` so un-differentiated uses (and
+    `jax.linearize`-free paths) stay one op."""
+    return jnp.maximum(x, jnp.zeros((), x.dtype))
+
+
+def _fused_relu_fwd(x):
+    impl = _resolved_impl()
+    x2 = _to_rows(x)
+    if impl == "xla":
+        y2, m = jnp.maximum(x2, jnp.zeros((), x2.dtype)), pack_mask(x2)
+    else:
+        y2, m = _pallas_fwd(x2, impl == "pallas_interpret")
+    return _from_rows(y2, x.shape, x.dtype), m
+
+
+def _fused_relu_bwd(m, g):
+    impl = _resolved_impl()
+    g2 = _to_rows(g)
+    if impl == "xla":
+        dx2 = g2 * unpack_mask(m).astype(g2.dtype)
+    else:
+        dx2 = _pallas_bwd(m, g2, impl == "pallas_interpret")
+    return (_from_rows(dx2, g.shape, g.dtype),)
+
+
+fused_relu.defvjp(_fused_relu_fwd, _fused_relu_bwd)

@@ -1,0 +1,71 @@
+"""Chunk-size sweep for the non-flagship canonical workloads (audio 1D,
+3D volumes, ViT IG) — `scripts/sweep_chunks.py` folded into the tune
+package (that script is now a deprecation shim onto this module).
+
+Uses the SAME workload builders as bench_matrix.py (bench_workloads.py at
+the repo root), so a sweep measures exactly the benchmarked config, and the
+same measurement protocol as the autotuner (`measure_candidate`: device
+xplane medians on TPU, wall medians elsewhere — the plane is printed).
+Prints one JSON line per (workload, chunk).
+
+    python -m wam_tpu.tune.sweep audio 4 8 25 50
+    python -m wam_tpu.tune.sweep vol 5 25
+    python -m wam_tpu.tune.sweep vit 4 8 16
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if not argv:
+        sys.exit("usage: python -m wam_tpu.tune.sweep {audio|vol|vit} [chunk ...]")
+    kind = argv[0]
+    chunks = [int(c) for c in argv[1:]] or [None]
+
+    from wam_tpu.config import enable_compilation_cache, ensure_usable_backend
+
+    platform = ensure_usable_backend(timeout_s=180.0)
+    enable_compilation_cache()
+
+    import jax.numpy as jnp
+
+    try:
+        from bench_workloads import audio_workload, vit_workload, vol_workload
+    except ImportError:
+        # bench_workloads.py lives at the repo root, next to bench_matrix.py
+        sys.path.insert(0, os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__)))))
+        from bench_workloads import audio_workload, vit_workload, vol_workload
+
+    from wam_tpu.tune.autotuner import measure_candidate
+    from wam_tpu.profiling import median_iqr
+
+    for chunk in chunks:
+        if kind == "audio":
+            ex, x, y = audio_workload(chunk)
+        elif kind == "vol":
+            ex, x, y = vol_workload(chunk)
+        elif kind == "vit":
+            ex, x, y = vit_workload(chunk, compute_dtype=jnp.bfloat16)
+        else:
+            sys.exit(f"unknown workload {kind!r}")
+
+        samples, plane = measure_candidate(lambda x, y: ex(x, y), (x, y),
+                                           k=3, laps=4)
+        med, q1, q3, _ = median_iqr(samples)
+        print(json.dumps({
+            "platform": platform, "workload": kind, "chunk": chunk,
+            "step_s": round(med, 4), "q1_s": round(q1, 4),
+            "q3_s": round(q3, 4), "plane": plane,
+            "items_per_s": round(x.shape[0] / med, 2),
+        }), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

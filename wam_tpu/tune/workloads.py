@@ -1,0 +1,192 @@
+"""Autotune workload presets: each builds candidate runners for `autotune`.
+
+A `Workload` bundles the cache-key identity (workload/shape/batch/dtype),
+the candidate list, and a ``build(candidate)`` factory returning a freshly
+jitted ``(fn, args)`` runner with the candidate's knobs baked in as EXPLICIT
+values — the sweep never resolves "auto", so it cannot read the cache entry
+it is about to write.
+
+Presets:
+
+- ``toy`` — tiny haar/conv geometry that compiles in seconds on CPU: the
+  ``--dry-run`` smoke target (verify skill) and the structural test fixture.
+- ``flagship`` — the pinned north-star (ResNet-50, b32, 224², n25, bf16 +
+  dwt-bf16, NHWC, fold_bn), mirroring bench.py exactly; sweeps chunks at
+  128/256/512 rows + full vmap, stream_noise on/off, and an NCHW layout
+  probe.
+- ``mu2d`` — the μ-fidelity inner runner at production geometry (grid 28,
+  sample 128) sweeping the evaluation fan cap; winner feeds
+  `resolve_fan_cap("auto")` (VERDICT.md round-5 directive 3 — the slowest
+  eval row).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+from wam_tpu.tune.autotuner import Candidate, chunk_candidates
+
+__all__ = ["Workload", "WORKLOADS", "get_workload"]
+
+
+@dataclasses.dataclass
+class Workload:
+    name: str
+    workload: str  # cache-key workload field ("wam2d", "eval2d", ...)
+    shape: tuple  # per-item shape (cache-key field)
+    batch: int
+    items: int  # items per runner call (throughput denominator)
+    candidates: list
+    build: Callable[[Candidate], tuple[Callable, tuple]]
+    dtype: str = "f32"
+
+
+def _smoothgrad_runner(engine, x, y, key, *, n_samples: int, chunk,
+                       stream: bool, to_bf16: bool = False,
+                       channel_last: bool = False):
+    """The bench.py step shape: jitted SmoothGrad over engine.attribute with
+    the candidate's chunk/stream baked in."""
+    from wam_tpu.core.estimators import smoothgrad
+
+    @jax.jit
+    def run(x, key):
+        if channel_last:
+            x = jnp.transpose(x, (0, 2, 3, 1))
+
+        def step(noisy):
+            if to_bf16:
+                noisy = noisy.astype(jnp.bfloat16)
+            _, grads = engine.attribute(noisy, y)
+            return grads
+
+        return smoothgrad(step, x, key, n_samples=n_samples,
+                          stdev_spread=0.25, batch_size=chunk,
+                          materialize_noise=not stream)
+
+    return run, (x, key)
+
+
+def _toy_workload(n_samples: int = 8, batch: int = 4, size: int = 32) -> Workload:
+    """CPU-fast sweep over a toy conv model — structure identical to the
+    flagship runner (engine.attribute under chunked smoothgrad), geometry
+    small enough that the whole sweep (compiles included) takes seconds."""
+    from wam_tpu.core.engine import WamEngine
+    from wam_tpu.models.toy import toy_conv_model
+
+    model = toy_conv_model(ndim=2)
+    x = jax.random.normal(jax.random.PRNGKey(1), (batch, size, size))
+    y = jnp.arange(batch, dtype=jnp.int32) % 4
+    key = jax.random.PRNGKey(42)
+
+    def build(cand: Candidate):
+        if cand.dwt_impl is not None:
+            # read at trace time (first call of the fresh jit below), so
+            # setting the process-global selector here is candidate-scoped
+            from wam_tpu.wavelets.transform import set_dwt2_impl
+
+            set_dwt2_impl(cand.dwt_impl)
+        engine = WamEngine(model, ndim=2, wavelet="haar", level=2,
+                           mode="reflect")
+        return _smoothgrad_runner(
+            engine, x, y, key, n_samples=n_samples, chunk=cand.sample_chunk,
+            stream=bool(cand.stream_noise),
+        )
+
+    chunks = chunk_candidates(batch, n_samples, targets=(8, 16))
+    cands = [Candidate(sample_chunk=c, stream_noise=False) for c in chunks]
+    cands.append(Candidate(sample_chunk=chunks[0], stream_noise=True))
+    return Workload(name="toy", workload="wam2d_toy", shape=(size, size),
+                    batch=batch, items=batch, candidates=cands, build=build)
+
+
+def _flagship_workload(n_samples: int = 25, batch: int = 32,
+                       image: int = 224) -> Workload:
+    """The pinned north-star geometry, config-identical to bench.py (bf16 +
+    fold_bn + dwt-bf16 + stream). Sweeps the round-5 directive-1 space:
+    chunks ABOVE the 128-row law (256/512/full), stream on/off, and one
+    NCHW probe at the law chunk (layout A/B)."""
+    from wam_tpu.core.engine import WamEngine
+    from wam_tpu.models import bind_inference, resnet50
+
+    model = resnet50(num_classes=1000)
+    variables = model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, image, image, 3)))
+    x = jax.random.normal(jax.random.PRNGKey(1), (batch, 3, image, image),
+                          jnp.float32)
+    y = jnp.arange(batch, dtype=jnp.int32) % 1000
+    key = jax.random.PRNGKey(42)
+    bound: dict[bool, Callable] = {}
+
+    def build(cand: Candidate):
+        nchw = cand.layout == "nchw"
+        if nchw not in bound:
+            bound[nchw] = bind_inference(model, variables, nchw=nchw,
+                                         compute_dtype=jnp.bfloat16,
+                                         fold_bn=True)
+        engine = WamEngine(bound[nchw], ndim=2, wavelet="db4", level=3,
+                           mode="reflect", channel_last=not nchw)
+        return _smoothgrad_runner(
+            engine, x, y, key, n_samples=n_samples, chunk=cand.sample_chunk,
+            stream=cand.stream_noise is not False, to_bf16=True,
+            channel_last=not nchw,
+        )
+
+    chunks = chunk_candidates(batch, n_samples)  # 128/256/512 rows + full
+    cands = [Candidate(sample_chunk=c, stream_noise=True) for c in chunks]
+    cands.append(Candidate(sample_chunk=chunks[0], stream_noise=False))
+    cands.append(Candidate(sample_chunk=chunks[0], stream_noise=True,
+                           layout="nchw"))
+    return Workload(name="flagship", workload="wam2d",
+                    shape=(3, image, image), batch=batch, items=batch,
+                    candidates=cands, build=build, dtype="bf16")
+
+
+def _mu2d_workload(n_images: int = 4, image: int = 224, grid_size: int = 28,
+                   sample_size: int = 128, subset_size: int = 157) -> Workload:
+    """μ-fidelity inner runner (Eval2DWAM) at production fan geometry,
+    sweeping the per-chunk model-row cap. The winner's ``fan_cap`` is what
+    ``Eval2DWAM(batch_size="auto")`` resolves via `resolve_fan_cap` — μ is
+    the slowest eval row (29.6 img/s) and its fan cap was never swept."""
+    from wam_tpu.evalsuite.eval2d import Eval2DWAM
+    from wam_tpu.models import bind_inference, resnet50
+
+    model = resnet50(num_classes=1000)
+    variables = model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, image, image, 3)))
+    model_fn = bind_inference(model, variables, nchw=True, fold_bn=True)
+    x = jax.random.normal(jax.random.PRNGKey(1),
+                          (n_images, 3, image, image), jnp.float32)
+    y = jnp.arange(n_images, dtype=jnp.int32) % 1000
+    # fixed random mosaics: the sweep measures the masking/forward fan, the
+    # explainer is out of scope (and out of the timed region)
+    wams = jax.random.uniform(jax.random.PRNGKey(2), (n_images, image, image))
+
+    def build(cand: Candidate):
+        ev = Eval2DWAM(model_fn, explainer=lambda xx, yy: wams,
+                       batch_size=int(cand.fan_cap))
+        rand_all, onehot_all = ev._mu_random_draws(
+            n_images, grid_size, sample_size, subset_size)
+        runner = ev._make_mu_runner(grid_size, sample_size)
+        return runner, (x, wams, y, rand_all, onehot_all)
+
+    cands = [Candidate(fan_cap=c) for c in (64, 128, 256, 512)]
+    return Workload(name="mu2d", workload="eval2d", shape=(sample_size,),
+                    batch=sample_size, items=n_images, candidates=cands,
+                    build=build)
+
+
+WORKLOADS: dict[str, Callable[..., Workload]] = {
+    "toy": _toy_workload,
+    "flagship": _flagship_workload,
+    "mu2d": _mu2d_workload,
+}
+
+
+def get_workload(name: str, **overrides) -> Workload:
+    if name not in WORKLOADS:
+        raise ValueError(f"unknown workload {name!r}; have {sorted(WORKLOADS)}")
+    return WORKLOADS[name](**overrides)

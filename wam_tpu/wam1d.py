@@ -254,8 +254,13 @@ class WaveletAttribution1D(BaseWAM1D):
                 batch_axis=batch_axis,
             )
 
-    def _resolve_chunk(self, batch: int) -> int | None:
-        return resolve_sample_chunk(self.sample_batch_size, batch, self.n_samples)
+    def _resolve_chunk(self, x_shape) -> int | None:
+        # tuned schedule-cache entries win over the 128-row law (round-6
+        # autotuner; see core.estimators.resolve_sample_chunk)
+        return resolve_sample_chunk(
+            self.sample_batch_size, x_shape[0], self.n_samples,
+            workload="wam1d", shape=tuple(x_shape[1:]),
+        )
 
     def _tap_grads(self, x, y):
         """(mel grads, coeff grads) for one (possibly perturbed) batch."""
@@ -283,7 +288,7 @@ class WaveletAttribution1D(BaseWAM1D):
             key,
             n_samples=self.n_samples,
             stdev_spread=self.stdev_spread,
-            batch_size=self._resolve_chunk(x.shape[0]),
+            batch_size=self._resolve_chunk(x.shape),
             materialize_noise=not self.stream_noise,
         )
 
@@ -298,7 +303,7 @@ class WaveletAttribution1D(BaseWAM1D):
             grad_avg, mel_tap = self._seq.smoothgrad(
                 x, y, key, n_samples=self.n_samples,
                 stdev_spread=self.stdev_spread,
-                sample_chunk=self._resolve_chunk(x.shape[0]),
+                sample_chunk=self._resolve_chunk(x.shape),
             )
             mel_avg = mel_tap[:, 0, :, :]
         else:
@@ -316,7 +321,7 @@ class WaveletAttribution1D(BaseWAM1D):
             scaled = jax.tree_util.tree_map(lambda c: c * alpha, coeffs)
             return self._tap_grads_from_coeffs(scaled, y, x.shape[-1])
 
-        path = jax.lax.map(one, alphas, batch_size=self._resolve_chunk(x.shape[0]))
+        path = jax.lax.map(one, alphas, batch_size=self._resolve_chunk(x.shape))
         integ = jax.tree_util.tree_map(trapezoid, path)
         mel_attr = baseline_mel * integ[0]
         coeff_attr = [c * g for c, g in zip(coeffs, integ[1])]
@@ -330,7 +335,7 @@ class WaveletAttribution1D(BaseWAM1D):
         if self.mesh is not None:
             coeffs, (coeff_integ, mel_integ) = self._seq.integrated(
                 x, y, n_steps=self.n_samples,
-                sample_chunk=self._resolve_chunk(x.shape[0]),
+                sample_chunk=self._resolve_chunk(x.shape),
             )
             baseline_mel = self._seq_front(x)[:, 0]
             mel_attr = baseline_mel * mel_integ[:, 0, :, :]

@@ -230,19 +230,31 @@ class WaveletAttribution2D(BaseWAM2D):
     # -- scheduling --------------------------------------------------------
 
     def _resolve_chunk(self, x_shape) -> int | None:
-        """Trace-time resolution of sample_batch_size="auto": target ~128
-        model rows per mapped step on TPU (chunk · batch ≈ 128, the v5e
-        sweet spot — the shared law in `core.estimators.resolve_sample_chunk`),
-        full vmap elsewhere — exactly the schedule bench.py records."""
-        return resolve_sample_chunk(self.sample_batch_size, x_shape[0],
-                                    self.n_samples)
+        """Trace-time resolution of sample_batch_size="auto": a tuned
+        schedule-cache entry for this (shape, batch, dtype) wins
+        (`wam_tpu.tune`, round-6 autotuner), falling back to ~128 model rows
+        per mapped step on TPU (chunk · batch ≈ 128, the v5e sweet spot —
+        the shared law in `core.estimators.resolve_sample_chunk`) and full
+        vmap elsewhere — exactly the schedule bench.py records."""
+        return resolve_sample_chunk(
+            self.sample_batch_size, x_shape[0], self.n_samples,
+            workload="wam2d", shape=tuple(x_shape[1:]),
+            dtype="bf16" if self.dwt_bf16 else "f32",
+        )
 
     def _resolve_stream(self, x_shape) -> bool:
-        """stream_noise="auto": stream only when the materialized
+        """stream_noise="auto": a tuned schedule-cache entry's
+        ``stream_noise`` wins; otherwise stream only when the materialized
         (n_samples, *x.shape) noise buffer would exceed ~128 MB f32 —
         streaming is a large-buffer optimization only (round-3 matrix)."""
         if self.stream_noise != "auto":
             return bool(self.stream_noise)
+        from wam_tpu.tune import lookup_schedule
+
+        ent = lookup_schedule("wam2d", tuple(x_shape[1:]), x_shape[0],
+                              "bf16" if self.dwt_bf16 else "f32")
+        if ent is not None and ent.get("stream_noise") is not None:
+            return bool(ent["stream_noise"])
         if jax.default_backend() != "tpu":
             return False
         elements = self.n_samples

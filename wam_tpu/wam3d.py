@@ -271,8 +271,13 @@ class WaveletAttribution3D(BaseWAM3D):
         self._jit_smooth = functools.cache(self._build_smooth)
         self._jit_ig = functools.cache(self._build_ig)
 
-    def _resolve_chunk(self, batch: int) -> int | None:
-        return resolve_sample_chunk(self.sample_batch_size, batch, self.n_samples)
+    def _resolve_chunk(self, x_shape) -> int | None:
+        # tuned schedule-cache entries win over the 128-row law (round-6
+        # autotuner; see core.estimators.resolve_sample_chunk)
+        return resolve_sample_chunk(
+            self.sample_batch_size, x_shape[0], self.n_samples,
+            workload="wam3d", shape=tuple(x_shape[1:]),
+        )
 
     def _cube_step(self, vol, y):
         coeffs = self.engine.decompose(vol)
@@ -291,7 +296,7 @@ class WaveletAttribution3D(BaseWAM3D):
             key,
             n_samples=self.n_samples,
             stdev_spread=self.stdev_spread,
-            batch_size=self._resolve_chunk(vol.shape[0]),
+            batch_size=self._resolve_chunk(vol.shape),
             materialize_noise=not self.stream_noise,
         )
 
@@ -312,7 +317,7 @@ class WaveletAttribution3D(BaseWAM3D):
             self.grads = self._seq.smoothgrad(
                 vol, y_arr, key, n_samples=self.n_samples,
                 stdev_spread=self.stdev_spread,
-                sample_chunk=self._resolve_chunk(vol.shape[0]),
+                sample_chunk=self._resolve_chunk(vol.shape),
             )
         elif y is None:
             self.grads = self._jit_smooth(False)(vol, key)
@@ -334,7 +339,7 @@ class WaveletAttribution3D(BaseWAM3D):
 
             return cube3d(jax.grad(loss)(scaled))
 
-        path = jax.lax.map(one, alphas, batch_size=self._resolve_chunk(v.shape[0]))
+        path = jax.lax.map(one, alphas, batch_size=self._resolve_chunk(v.shape))
         return baseline * trapezoid(path)
 
     def _build_ig(self, has_label: bool):
@@ -352,7 +357,7 @@ class WaveletAttribution3D(BaseWAM3D):
             y_arr = None if y is None else jnp.asarray(y)
             coeffs, integral = self._seq.integrated(
                 vol, y_arr, n_steps=self.n_samples,
-                sample_chunk=self._resolve_chunk(vol.shape[0]),
+                sample_chunk=self._resolve_chunk(vol.shape),
             )
             self.grads = cube3d(coeffs) * integral
         elif y is None:
