@@ -38,9 +38,54 @@ F32 = "--f32" in sys.argv
 # 0.977 was the noise realization changing, not DWT rounding (BASELINE.md
 # round-3 note). Disable with --no-dwt-bf16.
 DWT_BF16 = "--no-dwt-bf16" not in sys.argv and not F32
+# --h2d: stream fresh HOST batches through pipeline.stage_to_device under a
+# profiler capture and report upload bytes + the fraction of upload time
+# that ran concurrently with device compute (profiling.h2d_stats). On CPU
+# device_put is an aliasing no-op — the capture carries no meaningful
+# transfer bytes and no device plane, so the analytic staged-bytes figure
+# is the real number there and overlap stays null.
+H2D = "--h2d" in sys.argv
 
 
-def tpu_throughput() -> tuple[float, float | None, str]:
+def _h2d_report(run, key, batch: int, image: int, platform: str) -> dict:
+    import shutil
+    import tempfile
+
+    import numpy as np
+
+    from wam_tpu.pipeline import stage_to_device
+    from wam_tpu.profiling import device_sync, h2d_stats, profile_to
+
+    k_batches = 2 if (QUICK or platform == "cpu") else 4
+    host_batches = [
+        np.random.default_rng(i).standard_normal(
+            (batch, 3, image, image)).astype(np.float32)
+        for i in range(k_batches)
+    ]
+    staged_bytes = sum(b.nbytes for b in host_batches)
+    d = tempfile.mkdtemp(prefix="wam_h2d_")
+    try:
+        with profile_to(d):
+            out = None
+            for xb in stage_to_device(iter(host_batches)):
+                out = run(xb, key)  # batch k computes while k+1 uploads
+            device_sync(out)
+        stats = h2d_stats(d)
+    finally:
+        shutil.rmtree(d, ignore_errors=True)
+    return {
+        "h2d_batches": k_batches,
+        "h2d_staged_bytes": staged_bytes,
+        "h2d_bytes": stats["h2d_bytes"] if stats else None,
+        "h2d_seconds": round(stats["h2d_seconds"], 6) if stats else None,
+        "h2d_overlap_frac": (
+            round(stats["overlap_frac"], 4)
+            if stats and stats["overlap_frac"] is not None else None
+        ),
+    }
+
+
+def tpu_throughput() -> tuple[float, float | None, str, dict | None]:
     from wam_tpu.config import enable_compilation_cache, ensure_usable_backend
 
     ensure_usable_backend(timeout_s=180.0)
@@ -158,7 +203,8 @@ def tpu_throughput() -> tuple[float, float | None, str]:
             from wam_tpu.profiling import median_iqr
 
             dev_tput = batch / median_iqr(dev)[0]
-    return batch / t, dev_tput, platform
+    h2d = _h2d_report(run, key, batch, image, platform) if H2D else None
+    return batch / t, dev_tput, platform, h2d
 
 
 def cpu_baseline_throughput(full: bool = False) -> float:
@@ -287,7 +333,7 @@ def main():
             )
         )
         return
-    tpu, tpu_device, backend = tpu_throughput()
+    tpu, tpu_device, backend, h2d = tpu_throughput()
     try:
         cpu = cpu_baseline_throughput()
     except Exception as e:  # baseline must never block reporting
@@ -314,6 +360,7 @@ def main():
                 "dtype": "f32" if F32 else ("bf16+dwt-bf16" if DWT_BF16 else "bf16"),
                 "baseline_dtype": "f32-torch-cpu",
                 "platform": backend,
+                **(h2d or {}),
             }
         )
     )

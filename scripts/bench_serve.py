@@ -79,6 +79,7 @@ def main():
         compilation_cache=cfg.compilation_cache,
         metrics=metrics,
         metrics_path=metrics_path,
+        pipelined=cfg.pipelined,
     )
 
     budget = threading.Semaphore(args.requests)

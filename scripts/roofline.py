@@ -129,7 +129,7 @@ def main():
     x2 = jax.random.normal(jax.random.PRNGKey(1), (batch, 3, image, image))
     y2 = jnp.arange(batch, dtype=jnp.int32) % 1000
 
-    analyze("flagship_2d_b32_n25", ex2._jit_smooth,
+    analyze("flagship_2d_b32_n25", ex2._smooth_jit(),
             (x2, y2, jax.random.PRNGKey(42)), batch * n_samples)
 
     # audio + 3D: the recorded bench_matrix configurations

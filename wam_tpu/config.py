@@ -185,6 +185,7 @@ class ServeConfig:
     deadline_ms: float = 0.0  # 0 = no per-request deadline
     buckets: str = ""
     warmup: bool = True
+    pipelined: bool = True  # one-in-flight overlapped dispatch (serve/runtime)
     compilation_cache: bool = True
     metrics_path: str = ""
     device: str = "auto"

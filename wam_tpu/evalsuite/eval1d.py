@@ -16,7 +16,11 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from wam_tpu.evalsuite.metrics import generate_masks, run_cached_auc
+from wam_tpu.evalsuite.metrics import (
+    batch_fingerprint as _batch_fingerprint,
+    generate_masks,
+    run_cached_auc,
+)
 from wam_tpu.evalsuite.packing import array_to_coeffs1d, coeffs_to_array1d
 from wam_tpu.ops.melspec import melspectrogram
 from wam_tpu.wam1d import normalize_waveforms
@@ -39,14 +43,21 @@ class Eval1DWAM:
         n_mels: int = 128,
         n_fft: int = 1024,
         sample_rate: int = 44100,
-        batch_size: int = 128,
+        batch_size: int | str = 128,
         mesh=None,
         data_axis: str = "data",
+        donate_inputs: bool | None = None,
+        aot_key: str | None = None,
     ):
         """Constructor args are frozen config (the reference's
         constructor-kwargs surface, SURVEY.md §5.6) — build a new evaluator
         to change them. ``mesh``: shard every metric's perturbation-inference
-        batch over ``data_axis`` (SURVEY.md §2.10 evaluation fan-out)."""
+        batch over ``data_axis`` (SURVEY.md §2.10 evaluation fan-out).
+        ``batch_size="auto"`` resolves the memory cap per metric from the
+        tuned schedule cache (`wam_tpu.tune.resolve_fan_cap`, workload
+        "eval1d"), falling back to 128 — the same auto plumbing eval2d and
+        the baseline evaluators grew in round 6. ``donate_inputs`` /
+        ``aot_key``: see `Eval2DWAM` (same policy and caveats)."""
         self.model_fn = model_fn
         self.explainer = explainer
         self.wavelet = wavelet
@@ -58,18 +69,39 @@ class Eval1DWAM:
         self.batch_size = batch_size
         self.mesh = mesh
         self.data_axis = data_axis
+        self.donate_inputs = donate_inputs
+        self.aot_key = aot_key
         self._auc_runners: dict = {}
         self.grad_wams = None
+        self._expl_key = None
         self.insertion_curves = []
         self.deletion_curves = []
 
     def precompute(self, x, y):
-        if self.grad_wams is None:
-            self.grad_wams = self.explainer(x, y)
+        """Compute (or reuse) the cached explanations, fingerprinted on
+        ``(shape, dtype, y)`` — a different batch recomputes instead of
+        silently reusing stale explanations; directly-assigned
+        ``grad_wams`` adopt the first fingerprint they are used with
+        (see `Eval2DWAM.precompute`)."""
+        key = _batch_fingerprint(x, y)
+        if self.grad_wams is not None:
+            if self._expl_key is None or self._expl_key == key:
+                self._expl_key = key
+                return self.grad_wams
+        self.grad_wams = self.explainer(x, y)
+        self._expl_key = key
         return self.grad_wams
 
     def reset(self):
         self.grad_wams = None
+        self._expl_key = None
+
+    def _fan_cap(self, fan: int) -> int:
+        """Explicit ints pass through; "auto" consults the tuned schedule
+        cache keyed by this metric's fan (workload "eval1d")."""
+        from wam_tpu.tune import resolve_fan_cap
+
+        return resolve_fan_cap(self.batch_size, fan, workload="eval1d")
 
     def _melspec(self, wave: jax.Array) -> jax.Array:
         mel = melspectrogram(
@@ -138,7 +170,7 @@ class Eval1DWAM:
             (mode, target),
             inputs_fn,
             self.model_fn,
-            self.batch_size,
+            self._fan_cap(n_iter + 1),
             n_iter,
             x,
             expl,
@@ -146,6 +178,8 @@ class Eval1DWAM:
             return_logits=argmax,
             mesh=self.mesh,
             data_axis=self.data_axis,
+            donate=self.donate_inputs,
+            aot_key=self.aot_key,
         )
 
     def insertion(self, x, y, target: str = "wavelet", n_iter: int = 64):

@@ -23,6 +23,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from wam_tpu.evalsuite.metrics import (
+    batch_fingerprint as _batch_fingerprint,
     fan_chunk_geometry,
     generate_masks,
     make_chunked_forward,
@@ -82,6 +83,8 @@ class Eval2DWAM:
         random_seed: int = 42,
         mesh=None,
         data_axis: str = "data",
+        donate_inputs: bool | None = None,
+        aot_key: str | None = None,
     ):
         """Constructor args are frozen config (the reference's
         constructor-kwargs surface, SURVEY.md §5.6) — build a new evaluator
@@ -92,7 +95,16 @@ class Eval2DWAM:
         fan-out). ``batch_size="auto"`` resolves the memory cap per metric
         from the tuned schedule cache (`wam_tpu.tune.resolve_fan_cap`,
         workload "eval2d"), falling back to the 128 the rounds 1-5 numbers
-        were recorded at."""
+        were recorded at.
+
+        ``donate_inputs`` (None = donate on TPU only, the serve policy)
+        donates the image/explanation buffers into the metric graphs,
+        freeing one batch-sized HBM buffer per call; instance-cached and
+        caller-held arrays are protected by `pipeline.donation
+        .donation_safe` copies. ``aot_key`` opts the single-device metric
+        runners into the AOT executable cache (`wam_tpu.pipeline.aot`) —
+        it must uniquely identify model + params; both are ignored on the
+        mesh path."""
         self.model_fn = model_fn
         self.explainer = explainer
         self.wavelet = wavelet
@@ -104,22 +116,39 @@ class Eval2DWAM:
         self.random_seed = random_seed
         self.mesh = mesh
         self.data_axis = data_axis
+        self.donate_inputs = donate_inputs
+        self.aot_key = aot_key
         self._auc_runners: dict = {}
         self._mu_runners: dict = {}
         self._mu_draw_cache: dict = {}
         self.grad_wams = None
+        self._expl_key = None
         self.insertion_curves = []
         self.deletion_curves = []
 
     # -- explanation cache -------------------------------------------------
 
     def precompute(self, x, y):
-        if self.grad_wams is None:
-            self.grad_wams = jnp.asarray(self.explainer(x, y))
+        """Compute (or reuse) the cached explanations for this batch.
+
+        The cache is fingerprinted on ``(shape, dtype, y)``: a second call
+        with a different batch recomputes instead of silently reusing the
+        first batch's explanations (the pre-round-7 footgun). Explanations
+        injected by direct ``grad_wams`` assignment adopt the first
+        fingerprint they are used with (scripts/bench_eval.py shares one
+        explainer pass across evaluator configs this way)."""
+        key = _batch_fingerprint(x, y)
+        if self.grad_wams is not None:
+            if self._expl_key is None or self._expl_key == key:
+                self._expl_key = key
+                return self.grad_wams
+        self.grad_wams = jnp.asarray(self.explainer(x, y))
+        self._expl_key = key
         return self.grad_wams
 
     def reset(self):
         self.grad_wams = None
+        self._expl_key = None
 
     def _fan_cap(self, fan: int) -> int:
         """Per-metric memory cap: explicit ints pass through; "auto"
@@ -192,6 +221,8 @@ class Eval2DWAM:
             y,
             mesh=self.mesh,
             data_axis=self.data_axis,
+            donate=self.donate_inputs,
+            aot_key=self.aot_key,
         )
 
     def insertion(self, x, y, n_iter: int = 64):
@@ -267,7 +298,17 @@ class Eval2DWAM:
             )
 
         if self.mesh is None:
-            return jax.jit(run)
+            from wam_tpu.pipeline.donation import resolve_donate
+
+            argnums = (0,) if resolve_donate(self.donate_inputs) else ()
+            if self.aot_key is not None:
+                from wam_tpu.pipeline.aot import cached_entry
+
+                return cached_entry(
+                    run, f"{self.aot_key}|mu|g{grid_size}|s{sample_size}",
+                    donate_argnums=argnums,
+                )
+            return jax.jit(run, donate_argnums=argnums)
         from wam_tpu.evalsuite.metrics import make_sharded_runner
 
         return make_sharded_runner(run, self.mesh, self.data_axis)
@@ -300,5 +341,9 @@ class Eval2DWAM:
         if runner is None:
             runner = self._make_mu_runner(grid_size, sample_size)
             self._mu_runners[key] = runner
-        out = runner(x, wams, jnp.asarray(y), rand_all, onehot_all)
+        from wam_tpu.pipeline.donation import donation_safe, resolve_donate
+
+        donating = self.mesh is None and resolve_donate(self.donate_inputs)
+        out = runner(donation_safe(x, donating), wams, jnp.asarray(y),
+                     rand_all, onehot_all)
         return [float(v) for v in np.asarray(out)]  # one device fetch

@@ -17,6 +17,7 @@ import numpy as np
 from wam_tpu.evalsuite import baselines as B
 from wam_tpu.evalsuite.eval2d import _minmax01, imagenet_denormalize, imagenet_preprocess
 from wam_tpu.evalsuite.metrics import (
+    batch_fingerprint as _batch_fingerprint,
     fan_chunk_geometry,
     generate_masks,
     make_chunked_forward,
@@ -53,7 +54,8 @@ class _BaseEvalBaselines:
                  random_seed: int,
                  n_samples: int, stdev_spread: float, cam_layer: str, nchw: bool,
                  methods: tuple[str, ...], mesh=None, data_axis: str = "data",
-                 compute_dtype=None):
+                 compute_dtype=None, donate_inputs: bool | None = None,
+                 aot_key: str | None = None):
         if method == "srd":
             raise NotImplementedError(
                 "'srd' is excluded by design: the reference imports it from a "
@@ -88,7 +90,10 @@ class _BaseEvalBaselines:
         self.nchw = nchw
         self.mesh = mesh
         self.data_axis = data_axis
+        self.donate_inputs = donate_inputs
+        self.aot_key = aot_key
         self.explanations = None
+        self._expl_key = None
         self.insertion_curves = []
         self.deletion_curves = []
 
@@ -145,12 +150,23 @@ class _BaseEvalBaselines:
         raise AssertionError(m)
 
     def precompute(self, x, y):
-        if self.explanations is None:
-            self.explanations = self.compute_explanations(x, y)
+        """Compute (or reuse) the cached explanations, fingerprinted on
+        ``(shape, dtype, y)`` — a different batch recomputes instead of
+        silently reusing stale explanations; directly-assigned
+        ``explanations`` adopt the first fingerprint they are used with
+        (see `Eval2DWAM.precompute`)."""
+        key = _batch_fingerprint(x, y)
+        if self.explanations is not None:
+            if self._expl_key is None or self._expl_key == key:
+                self._expl_key = key
+                return self.explanations
+        self.explanations = self.compute_explanations(x, y)
+        self._expl_key = key
         return self.explanations
 
     def reset(self):
         self.explanations = None
+        self._expl_key = None
 
     def _perturb(self, x_s: jax.Array, masks: jax.Array) -> jax.Array:
         raise NotImplementedError
@@ -187,6 +203,8 @@ class _BaseEvalBaselines:
             y,
             mesh=self.mesh,
             data_axis=self.data_axis,
+            donate=self.donate_inputs,
+            aot_key=self.aot_key,
         )
 
     def insertion(self, x, y, n_iter: int = 128):
@@ -221,11 +239,14 @@ class EvalImageBaselines(_BaseEvalBaselines):
         mesh=None,
         data_axis: str = "data",
         compute_dtype=None,
+        donate_inputs: bool | None = None,
+        aot_key: str | None = None,
     ):
         super().__init__(model, variables, method, batch_size, random_seed,
                          n_samples, stdev_spread, cam_layer, nchw=nchw,
                          methods=IMAGE_METHODS, mesh=mesh, data_axis=data_axis,
-                         compute_dtype=compute_dtype)
+                         compute_dtype=compute_dtype,
+                         donate_inputs=donate_inputs, aot_key=aot_key)
         self.denormalize_fn = denormalize_fn
         self.preprocess_fn = preprocess_fn
 
@@ -266,7 +287,17 @@ class EvalImageBaselines(_BaseEvalBaselines):
             )
 
         if self.mesh is None:
-            return jax.jit(run)
+            from wam_tpu.pipeline.donation import resolve_donate
+
+            argnums = (0,) if resolve_donate(self.donate_inputs) else ()
+            if self.aot_key is not None:
+                from wam_tpu.pipeline.aot import cached_entry
+
+                return cached_entry(
+                    run, f"{self.aot_key}|mu|g{grid_size}|s{sample_size}",
+                    donate_argnums=argnums,
+                )
+            return jax.jit(run, donate_argnums=argnums)
         from wam_tpu.evalsuite.metrics import make_sharded_runner
 
         return make_sharded_runner(run, self.mesh, self.data_axis)
@@ -292,7 +323,10 @@ class EvalImageBaselines(_BaseEvalBaselines):
         if runner is None:
             runner = self._make_mu_runner(grid_size, sample_size, tuple(x.shape[-2:]))
             self._mu_runners[key] = runner
-        out = runner(x, expl, jnp.asarray(y), onehot_all)
+        from wam_tpu.pipeline.donation import donation_safe, resolve_donate
+
+        donating = self.mesh is None and resolve_donate(self.donate_inputs)
+        out = runner(donation_safe(x, donating), expl, jnp.asarray(y), onehot_all)
         return [float(v) for v in np.asarray(out)]  # one device fetch
 
 
@@ -314,11 +348,14 @@ class EvalAudioBaselines(_BaseEvalBaselines):
         mesh=None,
         data_axis: str = "data",
         compute_dtype=None,
+        donate_inputs: bool | None = None,
+        aot_key: str | None = None,
     ):
         super().__init__(model, variables, method, batch_size, random_seed,
                          n_samples, stdev_spread, cam_layer, nchw=False,
                          methods=AUDIO_METHODS, mesh=mesh, data_axis=data_axis,
-                         compute_dtype=compute_dtype)
+                         compute_dtype=compute_dtype,
+                         donate_inputs=donate_inputs, aot_key=aot_key)
 
     def _perturb(self, x_s, masks):
         # x_s: (1, T, M); masks: (n_iter+1, T, M) -> (n_iter+1, 1, T, M)
@@ -363,6 +400,8 @@ class EvalAudioBaselines(_BaseEvalBaselines):
             return_logits=True,
             mesh=self.mesh,
             data_axis=self.data_axis,
+            donate=self.donate_inputs,
+            aot_key=self.aot_key,
         )
 
     def faithfulness_of_spectra(self, x, y):

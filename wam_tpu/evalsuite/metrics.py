@@ -19,12 +19,24 @@ __all__ = [
     "minmax_normalize",
     "spearman",
     "batched_auc_runner",
+    "batch_fingerprint",
     "make_sharded_runner",
     "mu_fidelity_draws",
     "run_cached_auc",
     "fan_chunk_geometry",
     "make_chunked_forward",
 ]
+
+
+def batch_fingerprint(x, y) -> tuple:
+    """Identity of an evaluation batch for the explanation caches:
+    ``(shape, dtype, labels)``. Cheap host-side values only — both inputs
+    are concrete (numpy or committed) arrays by the time the evaluators
+    fingerprint them."""
+    import numpy as np
+
+    ys = () if y is None else tuple(int(v) for v in np.asarray(y).reshape(-1))
+    return (tuple(x.shape), str(x.dtype), ys)
 
 
 def softmax_probs(logits: jax.Array) -> jax.Array:
@@ -224,6 +236,8 @@ def batched_auc_runner(
     fan_chunk: int | None = None,
     mesh=None,
     data_axis: str = "data",
+    donate: bool | None = None,
+    aot_key: str | None = None,
 ):
     """One-jit-dispatch insertion/deletion evaluation across an image batch.
 
@@ -255,6 +269,15 @@ def batched_auc_runner(
     on-mesh evaluation is STILL one dispatch (round-4 verdict #4; replaces
     the reference's per-image fan loop, `src/evaluators.py:605-647`). The
     batch is cyclically padded to the axis size and sliced back.
+
+    ``donate`` (None = the shared "TPU-only" policy) donates the ``xb``/
+    ``explb`` buffers into the graph — the perturbation fan is the HBM
+    hog, so aliasing the inputs frees one batch-sized buffer per call.
+    Callers who re-read their arrays after the call must pass copies
+    (`pipeline.donation.donation_safe`; `run_cached_auc` does). ``aot_key``
+    opts the single-device runner into the AOT executable cache; both are
+    ignored on the mesh path (shard_map programs neither donate cleanly
+    nor export on the pinned jax).
     """
 
     forward = make_chunked_forward(model_fn, fan_chunk)
@@ -277,7 +300,14 @@ def batched_auc_runner(
         return jnp.concatenate([compute_auc(out)[:, None], out], axis=1)
 
     if mesh is None:
-        return jax.jit(body)
+        from wam_tpu.pipeline.donation import resolve_donate
+
+        argnums = (0, 1) if resolve_donate(donate) else ()
+        if aot_key is not None:
+            from wam_tpu.pipeline.aot import cached_entry
+
+            return cached_entry(body, aot_key, donate_argnums=argnums)
+        return jax.jit(body, donate_argnums=argnums)
     return make_sharded_runner(body, mesh, data_axis)
 
 
@@ -294,25 +324,39 @@ def run_cached_auc(
     return_logits: bool = False,
     mesh=None,
     data_axis: str = "data",
+    donate: bool | None = None,
+    aot_key: str | None = None,
 ):
     """Memoized `batched_auc_runner` invocation shared by the evaluators.
 
     Chunk geometry honors the caller's ``batch_size`` memory cap in both
     regimes: several images per chunk when the fan is small, an inner
     fan-chunked forward when one sample's fan alone exceeds it. ``mesh``
-    shards the image batch (see `batched_auc_runner`)."""
+    shards the image batch (see `batched_auc_runner`). ``donate``/
+    ``aot_key`` are forwarded there; when donation is active the ``x`` /
+    ``expl`` arguments are routed through `donation_safe`, so caller-held
+    and instance-cached jax Arrays survive the donation (host arrays
+    upload fresh either way — no extra copy on the common path)."""
     import numpy as np
+
+    from wam_tpu.pipeline.donation import donation_safe, resolve_donate
 
     images_per_chunk, fan_chunk = fan_chunk_geometry(batch_size, n_iter + 1)
     key = (n_iter, return_logits, tuple(x.shape[1:]), key_extra)
     runner = cache.get(key)
     if runner is None:
+        if aot_key is not None:
+            # the caller's key identifies model+params; the runner-cache key
+            # carries the metric mode / fan geometry this body bakes in
+            aot_key = f"{aot_key}|auc|{key!r}"
         runner = batched_auc_runner(
             inputs_fn, model_fn, images_per_chunk, return_logits, fan_chunk,
-            mesh, data_axis,
+            mesh, data_axis, donate, aot_key,
         )
         cache[key] = runner
-    out = runner(x, expl, jnp.asarray(y))
+    donating = mesh is None and resolve_donate(donate)
+    out = runner(donation_safe(x, donating), donation_safe(expl, donating),
+                 jnp.asarray(y))
     if return_logits:
         return list(np.asarray(out))
     # ONE device fetch for the whole call: round 4 batched the per-element

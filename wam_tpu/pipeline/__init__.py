@@ -1,0 +1,44 @@
+"""Streaming attribution pipeline: the prefetch / donate / precompile
+trio threaded through the hot paths.
+
+- `stager` — double-buffered host→device staging (`stage_to_device`,
+  `put_committed`): batch *k+1* uploads while batch *k* computes.
+- `donation` — the shared "TPU-only by default" buffer-donation policy
+  (`resolve_donate`, `donating_jit`) and the `donation_safe` guard for
+  instance-cached / user-held arrays.
+- `aot` — versioned AOT executable cache over `jax.export`
+  (`cached_jit`, `cached_entry`): a fresh process with a populated cache
+  skips trace+compile entirely.
+
+See DESIGN.md "Streaming pipeline & AOT cache".
+"""
+
+from wam_tpu.pipeline.aot import (
+    AOT_CACHE_VERSION,
+    aot_entry_path,
+    aval_signature,
+    cached_entry,
+    cached_jit,
+    default_aot_dir,
+    load_aot,
+    save_aot,
+)
+from wam_tpu.pipeline.donation import donating_jit, donation_safe, resolve_donate
+from wam_tpu.pipeline.stager import DeviceStager, put_committed, stage_to_device
+
+__all__ = [
+    "AOT_CACHE_VERSION",
+    "aot_entry_path",
+    "aval_signature",
+    "cached_entry",
+    "cached_jit",
+    "default_aot_dir",
+    "load_aot",
+    "save_aot",
+    "donating_jit",
+    "donation_safe",
+    "resolve_donate",
+    "DeviceStager",
+    "put_committed",
+    "stage_to_device",
+]

@@ -1,0 +1,264 @@
+"""AOT executable cache — trace+compile skipping across processes.
+
+The round-6 schedule cache remembers *what* to compile (chunk sizes,
+stream mode, dwt impl) but every fresh process still pays the Python
+trace + XLA compile to turn that schedule into an executable. This layer
+caches the executable itself: `jax.jit(...)` is lowered once, exported
+with `jax.export`, and the serialized StableHLO module is written under a
+key in the round-6 `workload|shape|batch|dtype|impl|backend` style. A
+later process deserializes and calls the exported module directly — the
+Python callable is never retraced (the trace-count probes in
+tests/test_pipeline.py assert exactly this), and XLA recompilation of the
+deserialized module is absorbed by the persistent compilation cache
+(`config.enable_compilation_cache`).
+
+Keying is **opt-in and caller-owned**: an exported module bakes in every
+closed-over constant — model parameters above all — so a shape-only key
+would collide across models. Callers must pass an ``aot_key`` that
+uniquely identifies the model + config (prewarm derives one from the
+workload preset, whose fixed-seed init makes parameters process-stable);
+no ``aot_key`` → no AOT, plain jit. Consumers: `serve` warmup via
+`jit_entry(aot_key=...)`, `python -m wam_tpu.prewarm`, and the eval
+runner caches (`evalsuite.metrics.run_cached_auc`).
+
+Mirrors `tune/cache.py` versioning: entries carry `AOT_CACHE_VERSION` in
+a JSON header line and stale-version or corrupt files are ignored
+wholesale (re-exported on the next miss). `WAM_TPU_NO_AOT_CACHE=1` is the
+kill switch; `$WAM_TPU_AOT_CACHE` overrides the directory
+(~/.cache/wam_tpu/aot by default).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import tempfile
+import warnings
+from typing import Callable, Sequence
+
+import jax
+
+try:  # a submodule on jax 0.4.3x — not auto-imported via the jax namespace
+    from jax import export as jax_export
+except ImportError:  # pragma: no cover - very old jax
+    jax_export = None
+
+
+def _register_pytree_serializations() -> None:
+    """Serialization names for the repo's NamedTuple pytrees — without a
+    registered name, exporting any program whose output carries one of
+    these (e.g. wavedec2's Detail2D) fails at `Exported.serialize`."""
+    if jax_export is None or not hasattr(
+        jax_export, "register_namedtuple_serialization"
+    ):  # pragma: no cover - very old jax
+        return
+    from wam_tpu.parallel.halo_modes import TailedLeaf
+    from wam_tpu.wavelets.transform import Detail2D
+
+    for cls in (Detail2D, TailedLeaf):
+        try:
+            jax_export.register_namedtuple_serialization(
+                cls, serialized_name=f"wam_tpu.{cls.__name__}"
+            )
+        except ValueError:  # already registered (re-import)
+            pass
+
+
+_register_pytree_serializations()
+
+from wam_tpu.pipeline.donation import resolve_donate
+
+__all__ = [
+    "AOT_CACHE_VERSION",
+    "default_aot_dir",
+    "aot_entry_path",
+    "save_aot",
+    "load_aot",
+    "aval_signature",
+    "cached_jit",
+    "cached_entry",
+]
+
+AOT_CACHE_VERSION = 1
+
+_warned_keys: set[str] = set()
+
+
+def _disabled() -> bool:
+    return os.environ.get("WAM_TPU_NO_AOT_CACHE", "") not in ("", "0")
+
+
+def default_aot_dir() -> str:
+    return os.environ.get(
+        "WAM_TPU_AOT_CACHE", os.path.expanduser("~/.cache/wam_tpu/aot")
+    )
+
+
+def aot_entry_path(key: str, cache_dir: str | None = None) -> str:
+    digest = hashlib.sha1(key.encode()).hexdigest()[:20]
+    return os.path.join(cache_dir or default_aot_dir(), f"{digest}.aot")
+
+
+def save_aot(key: str, exported, cache_dir: str | None = None) -> str | None:
+    """Serialize an `jax.export.Exported` under ``key``. Atomic (tmp +
+    rename); returns the path, or None when serialization fails (some
+    programs — custom calls, shard_map on older jax — do not export)."""
+    try:
+        payload = bytes(exported.serialize())
+    except Exception as e:
+        _warn_once(key, f"serialize failed: {e}")
+        return None
+    header = json.dumps(
+        {"version": AOT_CACHE_VERSION, "key": key, "jax": jax.__version__}
+    ).encode()
+    path = aot_entry_path(key, cache_dir)
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    fd, tmp = tempfile.mkstemp(dir=os.path.dirname(path), suffix=".tmp")
+    try:
+        with os.fdopen(fd, "wb") as f:
+            f.write(header + b"\n" + payload)
+        os.replace(tmp, path)
+    except OSError:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        return None
+    return path
+
+
+def load_aot(key: str, cache_dir: str | None = None):
+    """Deserialize the entry for ``key``, or None on miss. Version
+    mismatch, key (hash) collision, wrong platform, and corrupt payloads
+    are all treated as misses — never an error on the consult path."""
+    path = aot_entry_path(key, cache_dir)
+    try:
+        with open(path, "rb") as f:
+            raw = f.read()
+        header_line, _, payload = raw.partition(b"\n")
+        header = json.loads(header_line)
+        if header.get("version") != AOT_CACHE_VERSION or header.get("key") != key:
+            return None
+        if jax_export is None:
+            return None
+        exported = jax_export.deserialize(bytearray(payload))
+    except FileNotFoundError:
+        return None
+    except Exception:
+        return None
+    platforms = tuple(getattr(exported, "platforms", ()) or ())
+    if platforms and jax.default_backend() not in platforms:
+        return None
+    return exported
+
+
+def aval_signature(tree) -> str:
+    """Stable shape/dtype signature of an argument pytree, e.g.
+    ``f32[8,3,224,224];i32[8]`` (None leaves print as ``-``)."""
+
+    def one(leaf):
+        if leaf is None:
+            return "-"
+        aval = jax.api_util.shaped_abstractify(leaf)
+        return f"{aval.dtype.name}[{','.join(str(d) for d in aval.shape)}]"
+
+    leaves = jax.tree_util.tree_leaves(tree, is_leaf=lambda x: x is None)
+    return ";".join(one(leaf) for leaf in leaves)
+
+
+def _warn_once(key: str, msg: str) -> None:
+    if key in _warned_keys:
+        return
+    _warned_keys.add(key)
+    warnings.warn(f"wam_tpu AOT cache [{key}]: {msg}; falling back to plain jit")
+
+
+def _specs_like(tree):
+    def one(leaf):
+        if leaf is None:
+            return None
+        aval = jax.api_util.shaped_abstractify(leaf)
+        return jax.ShapeDtypeStruct(aval.shape, aval.dtype)
+
+    return jax.tree_util.tree_map(one, tree, is_leaf=lambda x: x is None)
+
+
+def cached_jit(
+    fn: Callable,
+    example_args: tuple,
+    key: str,
+    *,
+    donate_argnums: Sequence[int] = (),
+    on_trace: Callable[[], None] | None = None,
+    cache_dir: str | None = None,
+):
+    """One executable for ``fn`` at ``example_args``' shapes/dtypes.
+
+    Cache hit: deserialize and splice the stored module — ``fn`` is never
+    traced (``on_trace`` never fires). Miss: trace+export ``fn`` once
+    (``on_trace`` fires once), persist, and serve the exported module.
+    Disabled cache or export failure falls back to a plain `jax.jit(fn)`.
+    Returns a callable with ``fn``'s signature.
+    """
+    donate_argnums = tuple(donate_argnums)
+
+    def probed(*args):
+        if on_trace is not None:
+            on_trace()
+        return fn(*args)
+
+    plain = jax.jit(probed, donate_argnums=donate_argnums)
+    if _disabled():
+        return plain
+    exported = load_aot(key, cache_dir)
+    if exported is None:
+        specs = [_specs_like(a) for a in example_args]
+        try:
+            if jax_export is None:
+                raise RuntimeError("jax.export unavailable")
+            exported = jax_export.export(plain)(*specs)
+        except Exception as e:
+            _warn_once(key, f"export failed: {type(e).__name__}: {e}")
+            return plain
+        save_aot(key, exported, cache_dir)
+    call = exported.call
+    return jax.jit(call, donate_argnums=donate_argnums)
+
+
+def cached_entry(
+    impl: Callable,
+    base_key: str,
+    *,
+    donate_argnums: Sequence[int] = (),
+    on_trace: Callable[[], None] | None = None,
+    cache_dir: str | None = None,
+):
+    """Shape-dispatching callable over the AOT cache.
+
+    ``entry(*args)`` resolves one `cached_jit` per argument signature,
+    keyed ``{base_key}|{aval_signature}|{backend}`` — the executable
+    analogue of the schedule cache's shape axis. ``base_key`` must
+    identify the model + params (see module docstring); callers resolve
+    the donation policy themselves and pass concrete ``donate_argnums``.
+    """
+    donate_argnums = tuple(donate_argnums)
+    fns: dict[str, Callable] = {}
+
+    def entry(*args):
+        sig = aval_signature(args)
+        fn = fns.get(sig)
+        if fn is None:
+            key = f"{base_key}|{sig}|{jax.default_backend()}"
+            fn = cached_jit(
+                impl,
+                args,
+                key,
+                donate_argnums=donate_argnums,
+                on_trace=on_trace,
+                cache_dir=cache_dir,
+            )
+            fns[sig] = fn
+        return fn(*args)
+
+    return entry

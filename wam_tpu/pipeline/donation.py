@@ -1,0 +1,67 @@
+"""Buffer-donation policy for the streaming pipeline.
+
+One policy, shared by every donating call site (`serve.entry.jit_entry`,
+`evalsuite.metrics.batched_auc_runner`, the μ-fidelity runners, the
+materialized-noise SmoothGrad path): ``donate=None`` resolves to "donate
+on TPU only". XLA:CPU gains nothing from aliasing (host memory is not
+the scarce resource) while the donated handle is still consumed — and on
+versions where CPU cannot alias at all it warns "Some donated buffers
+were not usable" per call — so donation defaults off everywhere except
+the backend it helps.
+
+Donation consumes the caller's buffer: after a donating call, the donated
+`jax.Array` is deleted and any later read raises. That is fine for
+freshly-uploaded host batches (the dominant case — every perturbation fan
+is built from numpy each call) but would poison instance-cached tensors
+(`grad_wams`, μ-draw caches) and user-held arrays reused across
+insertion/deletion. `donation_safe` is the guard: it uploads host arrays
+as usual and device-copies an existing `jax.Array` only when donation is
+actually active, so the CPU path (donation off) stays zero-copy.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["resolve_donate", "donating_jit", "donation_safe"]
+
+
+def resolve_donate(donate: bool | None) -> bool:
+    """``None`` → donate iff the default backend is TPU (the serve/entry
+    policy, now shared by the eval runners)."""
+    if donate is None:
+        return jax.default_backend() == "tpu"
+    return bool(donate)
+
+
+def donating_jit(
+    fn: Callable,
+    *,
+    donate_argnums: Sequence[int] = (0,),
+    donate: bool | None = None,
+    **jit_kwargs,
+):
+    """`jax.jit` with the shared donation policy: ``donate_argnums`` is
+    applied only when `resolve_donate(donate)` is true."""
+    argnums = tuple(donate_argnums) if resolve_donate(donate) else ()
+    return jax.jit(fn, donate_argnums=argnums, **jit_kwargs)
+
+
+def donation_safe(tree, donating: bool):
+    """Make ``tree`` safe to pass as a donated argument.
+
+    Host (numpy/python) leaves upload fresh either way. When ``donating``,
+    existing `jax.Array` leaves are device-copied so the caller's handle
+    (an instance cache, a user-held batch) survives the donation; when not
+    donating this is a plain `jnp.asarray` pass-through with no copy.
+    """
+
+    def one(leaf):
+        if donating and isinstance(leaf, jax.Array):
+            return jnp.array(leaf, copy=True)
+        return jnp.asarray(leaf)
+
+    return jax.tree_util.tree_map(one, tree)

@@ -1,0 +1,125 @@
+"""Double-buffered host→device staging.
+
+Every batch loop in the repo used to interleave host work (decode,
+`np.stack`, RNG) with a synchronous upload: batch *k*'s host time and
+transfer sat serially in front of batch *k*'s compute. `stage_to_device`
+moves both off the consumer's critical path: a producer thread pulls from
+the host iterator and `jax.device_put`s each batch (committed to an
+explicit `Sharding` when one is attached — the mesh path's data layout),
+parking up to ``depth`` staged batches in a bounded queue. `device_put`
+dispatch is asynchronous, so batch *k+1*'s transfer overlaps batch *k*'s
+compute; with ``depth=2`` (double buffering) the device never waits on the
+host unless the host is genuinely slower than the device end-to-end.
+
+Consumers: `bench.py --h2d`, the evalsuite batch loops
+(`scripts/bench_eval.py`), and the serve dispatcher's assemble stage
+(`serve/runtime.py` stages each padded batch before dispatch).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Any, Iterable, Iterator
+
+import jax
+
+__all__ = ["put_committed", "stage_to_device", "DeviceStager"]
+
+_DONE = object()
+
+
+def put_committed(tree, sharding=None):
+    """`jax.device_put` a batch pytree, committed to ``sharding`` when one
+    is given (a `Sharding` or a matching pytree of them). Dispatch is
+    asynchronous — the returned arrays are futures over the transfer."""
+    if sharding is None:
+        return jax.device_put(tree)
+    return jax.device_put(tree, sharding)
+
+
+class DeviceStager:
+    """Iterator over ``batches`` with each item already on device.
+
+    A daemon producer thread runs the host iterator and stages every batch
+    via `put_committed`; the bounded queue (``depth`` slots) is the double
+    buffer. Exceptions from the host iterator (and `StopIteration`) are
+    forwarded to the consumer in order. `close()` (also wired to context
+    exit) stops the producer without draining the host iterator.
+    """
+
+    def __init__(self, batches: Iterable[Any], *, depth: int = 2, sharding=None):
+        if depth < 1:
+            raise ValueError("depth must be >= 1")
+        self._queue: queue.Queue = queue.Queue(maxsize=depth)
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._produce,
+            args=(iter(batches), sharding),
+            name="wam-device-stager",
+            daemon=True,
+        )
+        self._thread.start()
+
+    def _produce(self, it: Iterator[Any], sharding) -> None:
+        try:
+            for item in it:
+                staged = put_committed(item, sharding)
+                while not self._stop.is_set():
+                    try:
+                        self._queue.put(staged, timeout=0.05)
+                        break
+                    except queue.Full:
+                        continue
+                if self._stop.is_set():
+                    return
+            payload = _DONE
+        except BaseException as exc:  # forwarded, not swallowed
+            payload = exc
+        while not self._stop.is_set():
+            try:
+                self._queue.put(payload, timeout=0.05)
+                return
+            except queue.Full:
+                continue
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self._stop.is_set():
+            raise StopIteration
+        item = self._queue.get()
+        if item is _DONE:
+            self.close()
+            raise StopIteration
+        if isinstance(item, BaseException):
+            self.close()
+            raise item
+        return item
+
+    def close(self) -> None:
+        """Stop the producer; staged-but-unconsumed batches are dropped."""
+        self._stop.set()
+        # unblock a producer parked on a full queue
+        try:
+            self._queue.get_nowait()
+        except queue.Empty:
+            pass
+        self._thread.join(timeout=5.0)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def stage_to_device(batches: Iterable[Any], *, depth: int = 2, sharding=None):
+    """Generator convenience over `DeviceStager` — guarantees the producer
+    thread is shut down when the loop ends, breaks, or raises."""
+    stager = DeviceStager(batches, depth=depth, sharding=sharding)
+    try:
+        yield from stager
+    finally:
+        stager.close()

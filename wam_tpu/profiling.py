@@ -12,7 +12,8 @@ from collections import defaultdict
 import jax
 
 __all__ = ["trace", "StageTimer", "start_server", "profile_to", "device_sync",
-           "bench_time", "bench_samples", "median_iqr", "device_time_samples"]
+           "bench_time", "bench_samples", "median_iqr", "device_time_samples",
+           "h2d_stats"]
 
 
 def device_sync(out) -> None:
@@ -129,6 +130,113 @@ def _device_busy_seconds(logdir: str) -> float | None:
         elif "XLA Ops" in lines:
             per_plane.append(_union_seconds(lines["XLA Ops"].events))
     return max(per_plane) if per_plane else None
+
+
+def _merged_intervals(iv):
+    """Sorted, overlap-merged [(start, end), ...] interval list."""
+    out: list[list[int]] = []
+    for s, e in sorted(iv):
+        if out and s <= out[-1][1]:
+            out[-1][1] = max(out[-1][1], e)
+        else:
+            out.append([s, e])
+    return out
+
+
+def _intersection_ps(a, b) -> int:
+    """Total overlap between two merged interval lists (picoseconds)."""
+    i = j = total = 0
+    while i < len(a) and j < len(b):
+        s = max(a[i][0], b[j][0])
+        e = min(a[i][1], b[j][1])
+        if s < e:
+            total += e - s
+        if a[i][1] < b[j][1]:
+            i += 1
+        else:
+            j += 1
+    return total
+
+
+_H2D_TOKENS = ("h2d", "hosttodevice", "host to device", "transfertodevice",
+               "copy to device", "transfer to device", "memcpyh", "infeed")
+
+
+def h2d_stats(logdir: str) -> dict | None:
+    """Host→device transfer stats from a profiler capture, or None.
+
+    Scans every plane of the newest xplane capture for transfer-shaped
+    events (line/event names matching H2D/infeed/copy-to-device tokens),
+    totals their bytes (largest byte-valued stat per event — events often
+    carry several byte stats describing the same buffer) and busy time,
+    and measures how much of that transfer time ran CONCURRENTLY with
+    device compute (the TPU planes' "XLA Modules" program spans). Event
+    offsets are rebased onto each line's absolute timestamp so intervals
+    compare across lines and planes.
+
+    Returns ``{"h2d_bytes", "h2d_seconds", "overlap_frac"}`` —
+    ``overlap_frac`` is None when the capture has no module spans to
+    compare against (any CPU capture: no TPU device plane). Returns None
+    when the xplane protos (tensorflow) are unavailable, no capture
+    exists, or no transfer events were recorded at all. On CPU
+    `jax.device_put` is a host-side aliasing no-op — a capture may still
+    carry a few zero-byte transfer-shaped host events, so callers should
+    treat the bytes/overlap fields as device-backend data only."""
+    import glob
+
+    try:
+        from tensorflow.tsl.profiler.protobuf import xplane_pb2
+    except ImportError:
+        return None
+
+    paths = glob.glob(f"{logdir}/plugins/profile/*/*.xplane.pb")
+    if not paths:
+        return None
+    space = xplane_pb2.XSpace()
+    with open(sorted(paths)[-1], "rb") as f:
+        space.ParseFromString(f.read())
+
+    transfer_iv: list[tuple[int, int]] = []
+    module_iv: list[tuple[int, int]] = []
+    transfer_bytes = 0
+    for plane in space.planes:
+        event_names = {m.id: m.name for m in plane.event_metadata.values()}
+        stat_names = {m.id: m.name for m in plane.stat_metadata.values()}
+        for line in plane.lines:
+            base_ps = line.timestamp_ns * 1000
+            if "TPU" in plane.name and line.name == "XLA Modules":
+                module_iv.extend(
+                    (base_ps + ev.offset_ps,
+                     base_ps + ev.offset_ps + ev.duration_ps)
+                    for ev in line.events
+                )
+            for ev in line.events:
+                label = f"{line.name} {event_names.get(ev.metadata_id, '')}".lower()
+                if not any(tok in label for tok in _H2D_TOKENS):
+                    continue
+                start = base_ps + ev.offset_ps
+                transfer_iv.append((start, start + ev.duration_ps))
+                nbytes = 0
+                for st in ev.stats:
+                    if "byte" not in stat_names.get(st.metadata_id, "").lower():
+                        continue
+                    nbytes = max(nbytes, st.int64_value, st.uint64_value,
+                                 int(st.double_value))
+                transfer_bytes += nbytes
+
+    if not transfer_iv:
+        return None
+    merged_t = _merged_intervals(transfer_iv)
+    h2d_s = sum(e - s for s, e in merged_t) / 1e12
+    overlap_frac = None
+    if module_iv and h2d_s > 0:
+        inter = _intersection_ps(merged_t, _merged_intervals(module_iv))
+        overlap_frac = inter / (h2d_s * 1e12)
+    return {
+        "h2d_bytes": transfer_bytes,
+        "h2d_seconds": h2d_s,
+        "overlap_frac": overlap_frac,
+    }
 
 
 def device_time_samples(fn, *args, k: int = 3, laps: int = 1, warmup: int = 1) -> list[float]:

@@ -6,13 +6,20 @@ attribute stashing (the worker loop is a thread; the engines' ``__call__``
 convenience surface mutates ``self.scales`` etc. and is NOT thread-safe),
 and jit applied here so the runtime can:
 
-- **donate** the padded input batch (the dispatcher builds a fresh host
+- **donate** the padded input batch (the dispatcher stages a fresh device
   buffer per batch, so aliasing it into the graph saves one HBM copy per
-  dispatch on TPU; donation is off on backends that cannot use it), and
+  dispatch on TPU; donation is off on backends that cannot use it),
 - **count jit cache misses** via ``on_trace``: the wrapped Python callable
   runs exactly once per compiled shape, so the hook is a direct cache-miss
   counter — the serve ledger's ``compile_count`` and the one-compile-per-
-  bucket test assertion.
+  bucket test assertion, and
+- **skip the trace entirely** on later processes via ``aot_key``: the
+  entry is routed through the AOT executable cache
+  (`wam_tpu.pipeline.aot.cached_entry`), so a warmup that already exported
+  this model's buckets deserializes instead of retracing — ``on_trace``
+  then never fires, which is exactly what the warm-start tests probe.
+  The key must uniquely identify the model + params (exported modules
+  bake in closed-over constants); no key → no AOT.
 """
 
 from __future__ import annotations
@@ -20,6 +27,8 @@ from __future__ import annotations
 from typing import Callable
 
 import jax
+
+from wam_tpu.pipeline.donation import resolve_donate
 
 __all__ = ["jit_entry"]
 
@@ -29,17 +38,26 @@ def jit_entry(
     *,
     donate: bool | None = None,
     on_trace: Callable[[], None] | None = None,
+    aot_key: str | None = None,
 ):
     """Wrap ``impl(x, y)`` as a serving entry (see module docstring).
 
     ``donate=None`` resolves to "donate on TPU only" — XLA:CPU leaves
-    donated buffers unused and warns per call."""
-    if donate is None:
-        donate = jax.default_backend() == "tpu"
+    donated buffers unused and warns per call. ``aot_key`` opts the entry
+    into the AOT executable cache."""
+    if aot_key is not None:
+        from wam_tpu.pipeline.aot import cached_entry
+
+        return cached_entry(
+            impl,
+            aot_key,
+            donate_argnums=(0,) if resolve_donate(donate) else (),
+            on_trace=on_trace,
+        )
 
     def wrapped(x, y):
         if on_trace is not None:
             on_trace()  # trace-time only: one call per jit cache miss
         return impl(x, y)
 
-    return jax.jit(wrapped, donate_argnums=(0,) if donate else ())
+    return jax.jit(wrapped, donate_argnums=(0,) if resolve_donate(donate) else ())

@@ -24,8 +24,15 @@ Operational semantics (DESIGN.md "Serving runtime"):
   (forced re-probe) says the accelerator is gone, the server swaps in the
   ``fallback_factory`` entry (a CPU-backend rebuild) once, replays the
   failed batch on it, and keeps serving degraded rather than failing hard.
-- **Shutdown**: `close()` stops intake immediately, drains queued work,
-  then joins the worker.
+- **Shutdown**: `close()` stops intake immediately, drains queued work
+  (including any in-flight batch), then joins the worker.
+- **Pipelining** (``pipelined=True``, the default): the worker keeps one
+  batch in flight — it assembles and stages batch *k+1* to the device
+  (`pipeline.put_committed`, an async upload) and dispatches it *before*
+  harvesting batch *k*'s results, so host assembly + H2D transfer overlap
+  device compute instead of serializing with it. Entry exceptions that
+  surface at the deferred `device_get` go through the same degradation
+  path as dispatch-time failures (the host batch is kept for replay).
 """
 
 from __future__ import annotations
@@ -38,6 +45,7 @@ from dataclasses import dataclass, field
 import jax
 import numpy as np
 
+from wam_tpu.pipeline.stager import put_committed
 from wam_tpu.serve.buckets import Bucket, BucketTable, pad_item
 from wam_tpu.serve.metrics import ServeMetrics
 
@@ -82,6 +90,24 @@ class _Request:
     future: Future = field(default_factory=Future)
 
 
+@dataclass
+class _Inflight:
+    """A dispatched-but-unharvested batch: ``out`` is the entry's (possibly
+    still computing) result; the host-side ``xs``/``ys`` are kept so a
+    failure surfacing at harvest can replay on the fallback entry."""
+
+    bucket: Bucket
+    live: list
+    depth: int
+    xs: np.ndarray
+    ys: np.ndarray | None
+    t0: float
+    out: object
+
+
+_NOT_READY = object()  # non-blocking _take_batch: nothing poppable yet
+
+
 class AttributionServer:
     """See module docstring.
 
@@ -112,6 +138,9 @@ class AttributionServer:
         degraded serving (see module docstring).
     dtype : host dtype items are staged as (one contiguous transfer per
         batch).
+    pipelined : keep one batch in flight — stage + dispatch batch *k+1*
+        before harvesting batch *k* (module docstring "Pipelining").
+        ``False`` restores the synchronous dispatch-then-distribute loop.
     """
 
     def __init__(
@@ -130,6 +159,7 @@ class AttributionServer:
         metrics_path: str | None = None,
         fallback_factory=None,
         dtype=np.float32,
+        pipelined: bool = True,
         auto_start: bool = True,
     ):
         if max_batch < 1:
@@ -149,6 +179,7 @@ class AttributionServer:
         self.metrics_path = metrics_path
         self._fallback_factory = fallback_factory
         self.dtype = dtype
+        self.pipelined = pipelined
         self.degraded = False
 
         self._cond = threading.Condition()
@@ -183,7 +214,7 @@ class AttributionServer:
 
             load_schedule_cache()
             for bucket in self.table:
-                self._dispatch(*self._zeros_batch(bucket))
+                self._sync_dispatch(*self._zeros_batch(bucket))
         self._worker = threading.Thread(
             target=self._worker_loop, name="wam-serve-worker", daemon=True
         )
@@ -220,6 +251,7 @@ class AttributionServer:
             "max_wait_ms": self.max_wait_s * 1e3,
             "queue_depth": self.queue_depth,
             "labeled": self.labeled,
+            "pipelined": self.pipelined,
             "degraded": self.degraded,
         }
 
@@ -266,36 +298,51 @@ class AttributionServer:
         y = np.zeros((self.max_batch,), np.int32) if self.labeled else None
         return x, y
 
-    def _dispatch(self, xs, ys):
-        """Run one padded batch through the entry, degrading to the CPU
-        fallback when the accelerator has actually gone away (forced
-        re-probe distinguishes a device loss from a plain bug: an
-        in-process exception with a healthy accelerator re-raises)."""
-        try:
-            if self.degraded:
-                self.metrics.note_fallback()
-            return jax.device_get(self._entry(xs, ys))
-        except Exception:
-            if self.degraded or self._fallback_factory is None:
-                raise
-            from wam_tpu import config
-
-            if config.probe_accelerator(force=True):
-                raise  # accelerator healthy: the failure is not the device
-            self._entry = self._fallback_factory()
-            self.degraded = True
+    def _call_entry(self, xs, ys):
+        if self.degraded:
             self.metrics.note_fallback()
-            return jax.device_get(self._entry(xs, ys))
+        return self._entry(xs, ys)
 
-    def _take_batch(self):
-        """Block until a batch is ready (bucket full, head waited
-        max_wait_ms, or draining at close). Returns (bucket, requests,
-        queue_depth_at_pop) or None when closed and drained."""
+    def _recover(self, xs, ys):
+        """Called from an ``except`` block after the entry failed (at
+        dispatch or at the deferred harvest): degrade to the CPU fallback
+        when the accelerator has actually gone away (forced re-probe
+        distinguishes a device loss from a plain bug — an in-process
+        exception with a healthy accelerator re-raises) and replay the
+        failed batch on it. ``xs``/``ys`` are the kept host buffers."""
+        if self.degraded or self._fallback_factory is None:
+            raise
+        from wam_tpu import config
+
+        if config.probe_accelerator(force=True):
+            raise  # accelerator healthy: the failure is not the device
+        self._entry = self._fallback_factory()
+        self.degraded = True
+        self.metrics.note_fallback()
+        return jax.device_get(self._entry(xs, ys))
+
+    def _sync_dispatch(self, xs, ys):
+        """Dispatch + harvest in one step (warmup and the non-pipelined
+        loop)."""
+        try:
+            return jax.device_get(self._call_entry(xs, ys))
+        except Exception:
+            return self._recover(xs, ys)
+
+    def _take_batch(self, block: bool = True):
+        """Pop a ready batch (bucket full, head waited max_wait_ms, or
+        draining at close). Returns (bucket, requests, queue_depth_at_pop),
+        None when closed and drained, or — with ``block=False`` — the
+        `_NOT_READY` sentinel as soon as nothing is poppable *right now*
+        (the pipelined worker uses this to go harvest the in-flight batch
+        instead of sleeping on the queue)."""
         with self._cond:
             while True:
                 if self._pending == 0:
                     if self._closed:
                         return None
+                    if not block:
+                        return _NOT_READY
                     self._cond.wait(0.05)
                     continue
                 # serve the bucket whose head request is oldest
@@ -314,13 +361,25 @@ class AttributionServer:
                     del q[: self.max_batch]
                     self._pending -= len(take)
                     return bucket, take, self._pending + len(take)
+                if not block:
+                    return _NOT_READY
                 self._cond.wait(self.max_wait_s - head_wait)
 
     def _worker_loop(self):
+        inflight: _Inflight | None = None
         while True:
-            got = self._take_batch()
-            if got is None:
+            # Only block on the queue when nothing is in flight; otherwise
+            # peek — either launch the next batch behind the in-flight one
+            # or, with nothing poppable, harvest and come back.
+            got = self._take_batch(block=inflight is None)
+            if got is None:  # closed and drained
+                if inflight is not None:
+                    self._complete(inflight)
                 return
+            if got is _NOT_READY:
+                self._complete(inflight)
+                inflight = None
+                continue
             bucket, reqs, depth = got
             now = time.perf_counter()
             live, expired = [], []
@@ -334,9 +393,21 @@ class AttributionServer:
                 self.metrics.note_expired(len(expired))
             if not live:
                 continue
-            self._serve_batch(bucket, live, depth)
+            batch = self._launch_batch(bucket, live, depth)
+            if batch is None:  # failed at dispatch; futures already failed
+                continue
+            if not self.pipelined:
+                self._complete(batch)
+                continue
+            if inflight is not None:
+                # batch k+1 is now queued on the device; harvesting k here
+                # is exactly the overlap window
+                self._complete(inflight)
+            inflight = batch
 
-    def _serve_batch(self, bucket: Bucket, live: list[_Request], depth: int):
+    def _launch_batch(self, bucket: Bucket, live: list[_Request], depth: int):
+        """Assemble the padded host batch, stage it to the device (async
+        upload), and dispatch the entry WITHOUT harvesting the result."""
         n_real = len(live)
         with self.metrics.stages.stage("assemble"):
             xs = np.stack([pad_item(r.x, bucket) for r in live])
@@ -354,16 +425,37 @@ class AttributionServer:
                     )
             else:
                 ys = None
+            staged = put_committed((xs, ys))
         t0 = time.perf_counter()
         try:
             with self.metrics.stages.stage("dispatch"):
-                out = self._dispatch(xs, ys)
-        except Exception as e:
-            for r in live:
-                r.future.set_exception(e)
-            self.metrics.note_failed(n_real)
-            return
-        service_s = time.perf_counter() - t0
+                out = self._call_entry(*staged)
+        except Exception:
+            try:
+                out = self._recover(xs, ys)  # already host-side on success
+            except Exception as e:
+                for r in live:
+                    r.future.set_exception(e)
+                self.metrics.note_failed(n_real)
+                return None
+        return _Inflight(bucket, live, depth, xs, ys, t0, out)
+
+    def _complete(self, batch: _Inflight):
+        """Harvest an in-flight batch (block on the device result — where
+        async entry failures surface) and distribute rows to futures."""
+        live, n_real = batch.live, len(batch.live)
+        try:
+            with self.metrics.stages.stage("harvest"):
+                out = jax.device_get(batch.out)
+        except Exception:
+            try:
+                out = self._recover(batch.xs, batch.ys)
+            except Exception as e:
+                for r in live:
+                    r.future.set_exception(e)
+                self.metrics.note_failed(n_real)
+                return
+        service_s = time.perf_counter() - batch.t0
         # EMA over batch service time feeds the retry-after estimate
         self._ema_batch_s = 0.8 * self._ema_batch_s + 0.2 * service_s
         with self.metrics.stages.stage("distribute"):
@@ -372,12 +464,12 @@ class AttributionServer:
                 row = jax.tree_util.tree_map(lambda a: np.asarray(a)[i], out)
                 r.future.set_result(row)
         self.metrics.note_batch(
-            bucket_shape=bucket.shape,
+            bucket_shape=batch.bucket.shape,
             n_real=n_real,
             max_batch=self.max_batch,
-            pad_waste=float(np.mean([bucket.pad_waste(r.x.shape) for r in live])),
-            queue_depth=depth,
+            pad_waste=float(np.mean([batch.bucket.pad_waste(r.x.shape) for r in live])),
+            queue_depth=batch.depth,
             service_s=service_s,
-            queue_waits_s=[t0 - r.t_submit for r in live],
+            queue_waits_s=[batch.t0 - r.t_submit for r in live],
             latencies_s=[done - r.t_submit for r in live],
         )

@@ -369,7 +369,8 @@ class WaveletAttribution1D(BaseWAM1D):
             return self.smooth_wam(x, y)
         return self.integrated_wam(x, y)
 
-    def serve_entry(self, donate: bool | None = None, on_trace=None):
+    def serve_entry(self, donate: bool | None = None, on_trace=None,
+                    aot_key: str | None = None):
         """Batched serving entry ``(x, y) -> (mel_attr, coeff_attr)`` for the
         `wam_tpu.serve` worker: x is (B, W) float32 waveforms (already
         peak-normalized — the list form of `normalize_waveforms` is a host
@@ -392,7 +393,7 @@ class WaveletAttribution1D(BaseWAM1D):
         else:
             impl = lambda x, y: self._ig_impl(  # noqa: E731
                 jnp.asarray(x, jnp.float32), y)
-        return jit_entry(impl, donate=donate, on_trace=on_trace)
+        return jit_entry(impl, donate=donate, on_trace=on_trace, aot_key=aot_key)
 
 
 def _minmax_normalize(a):

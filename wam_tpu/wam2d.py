@@ -94,12 +94,14 @@ class BaseWAM2D:
                                          channel_axis=self._caxis)
         return mosaic
 
-    def serve_entry(self, donate: bool | None = None, on_trace=None):
+    def serve_entry(self, donate: bool | None = None, on_trace=None,
+                    aot_key: str | None = None):
         """Batched serving entry: jitted ``(x, y) -> mosaic (B, S, S)`` with
         no instance-attribute stashing (unlike ``__call__``), safe to call
-        from the `wam_tpu.serve` worker thread. ``donate``/``on_trace`` are
-        forwarded to `serve.entry.jit_entry` (input-buffer donation on TPU,
-        jit cache-miss counting)."""
+        from the `wam_tpu.serve` worker thread. ``donate``/``on_trace``/
+        ``aot_key`` are forwarded to `serve.entry.jit_entry` (input-buffer
+        donation on TPU, jit cache-miss counting, AOT executable cache —
+        the key must identify the model + params)."""
         from wam_tpu.serve.entry import jit_entry
 
         def impl(x, y):
@@ -107,7 +109,7 @@ class BaseWAM2D:
             _, grads = self.engine.attribute(x, y)
             return mosaic2d(grads, self.normalize_coeffs, self._caxis)
 
-        return jit_entry(impl, donate=donate, on_trace=on_trace)
+        return jit_entry(impl, donate=donate, on_trace=on_trace, aot_key=aot_key)
 
     def disentangle_scales(self, grads, approx_coeffs: bool = False):
         return disentangle_scales(grads, approx_coeffs=approx_coeffs,
@@ -150,6 +152,14 @@ class WaveletAttribution2D(BaseWAM2D):
     (streaming is a large-buffer optimization; it loses on small buffers).
     Off-TPU, "auto" is the previous behavior (full vmap, materialized
     noise). Pass explicit values to override either.
+
+    ``donate_inputs`` (None = donate on TPU only, the shared
+    `wam_tpu.pipeline.donation` policy) donates the input batch into the
+    jitted SmoothGrad graph — the materialized-noise path's
+    (n_samples, B, C, H, W) buffer dominates HBM, and aliasing the input
+    frees one batch for it. A caller-held `jax.Array` passed to
+    ``smooth_wam`` survives (it is `donation_safe`-copied before the
+    call); off-TPU nothing changes.
     """
 
     def __init__(
@@ -171,6 +181,7 @@ class WaveletAttribution2D(BaseWAM2D):
         mesh=None,
         seq_axis: str = "data",
         batch_axis: str | None = None,
+        donate_inputs: bool | None = None,
     ):
         super().__init__(
             model_fn,
@@ -224,8 +235,21 @@ class WaveletAttribution2D(BaseWAM2D):
         self.stdev_spread = stdev_spread
         self.random_seed = random_seed
         self.sample_batch_size = sample_batch_size
-        self._jit_smooth = jax.jit(self._smooth_impl)
+        self.donate_inputs = donate_inputs
+        # the smooth jit is built lazily: resolving the donation policy
+        # (jax.default_backend()) at construction would initialize the
+        # backend before the caller's select_backend() had a say
+        self._jit_smooth = None
         self._jit_ig = jax.jit(self._ig_impl)
+
+    def _smooth_jit(self):
+        if self._jit_smooth is None:
+            from wam_tpu.pipeline.donation import donating_jit
+
+            self._jit_smooth = donating_jit(
+                self._smooth_impl, donate_argnums=(0,), donate=self.donate_inputs
+            )
+        return self._jit_smooth
 
     # -- scheduling --------------------------------------------------------
 
@@ -293,7 +317,12 @@ class WaveletAttribution2D(BaseWAM2D):
                 sample_chunk=self._resolve_chunk(x.shape),
             )
         else:
-            avg = self._jit_smooth(jnp.asarray(x), jnp.asarray(y), key)
+            from wam_tpu.pipeline.donation import donation_safe, resolve_donate
+
+            avg = self._smooth_jit()(
+                donation_safe(x, resolve_donate(self.donate_inputs)),
+                jnp.asarray(y), key,
+            )
         self.scales = reproject_mosaic(avg, self.J, self.approx_coeffs)
         return avg
 
@@ -338,7 +367,8 @@ class WaveletAttribution2D(BaseWAM2D):
             return self.smooth_wam(x, y)
         return self.integrated_wam(x, y)
 
-    def serve_entry(self, donate: bool | None = None, on_trace=None):
+    def serve_entry(self, donate: bool | None = None, on_trace=None,
+                    aot_key: str | None = None):
         """Batched serving entry ``(x, y) -> mosaic (B, S, S)`` for the
         `wam_tpu.serve` worker: the estimator body without the
         instance-attribute stashing (``self.scales``) that makes ``__call__``
@@ -357,4 +387,4 @@ class WaveletAttribution2D(BaseWAM2D):
             impl = lambda x, y: self._smooth_impl(x, y, key)  # noqa: E731
         else:
             impl = self._ig_impl
-        return jit_entry(impl, donate=donate, on_trace=on_trace)
+        return jit_entry(impl, donate=donate, on_trace=on_trace, aot_key=aot_key)

@@ -378,7 +378,8 @@ class WaveletAttribution3D(BaseWAM3D):
         cube (`lib/wam_3D.py:662-719`, orientation-sum typo fixed)."""
         return visualize_cube(self.grads, self.J)
 
-    def serve_entry(self, donate: bool | None = None, on_trace=None):
+    def serve_entry(self, donate: bool | None = None, on_trace=None,
+                    aot_key: str | None = None):
         """Batched serving entry ``(x, y) -> cube (B, S, S, S)`` for the
         `wam_tpu.serve` worker: x is (B, 1, D, H, W) volumes as fed to
         ``__call__``, y is (B,) int labels (the serve path is labeled-only).
@@ -397,4 +398,4 @@ class WaveletAttribution3D(BaseWAM3D):
             impl = lambda x, y: self._smooth_impl(x[:, 0], y, key)  # noqa: E731
         else:
             impl = lambda x, y: self._ig_impl(x[:, 0], y)  # noqa: E731
-        return jit_entry(impl, donate=donate, on_trace=on_trace)
+        return jit_entry(impl, donate=donate, on_trace=on_trace, aot_key=aot_key)
