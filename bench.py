@@ -45,6 +45,13 @@ DWT_BF16 = "--no-dwt-bf16" not in sys.argv and not F32
 # transfer bytes and no device plane, so the analytic staged-bytes figure
 # is the real number there and overlap stays null.
 H2D = "--h2d" in sys.argv
+# --synth: bucket the benched runner's device op time by the wavelet core's
+# named_scope tokens (wam_synth / wam_analysis — wavelets/transform.py wraps
+# every dispatch) and report the analysis-vs-synthesis split. Device-plane
+# data only: on CPU the capture carries no TPU op line, so the fields are
+# emitted as null with synth_split_plane="none" — an honest "not measured
+# here", never a wall-clock stand-in.
+SYNTH = "--synth" in sys.argv
 
 
 def _h2d_report(run, key, batch: int, image: int, platform: str) -> dict:
@@ -82,6 +89,24 @@ def _h2d_report(run, key, batch: int, image: int, platform: str) -> dict:
             round(stats["overlap_frac"], 4)
             if stats and stats["overlap_frac"] is not None else None
         ),
+    }
+
+
+def _synth_report(run, x, key, platform: str) -> dict:
+    from wam_tpu.profiling import synth_device_split
+    from wam_tpu.wavelets.transform import resolved_synth2_impl
+
+    split = synth_device_split(run, x, key,
+                               laps=1 if (QUICK or platform == "cpu") else 2)
+    return {
+        "synth_impl": resolved_synth2_impl(),
+        "synth_split_plane": "device" if split else "none",
+        "synth_s": round(split["wam_synth_s"], 6) if split else None,
+        "analysis_s": round(split["wam_analysis_s"], 6) if split else None,
+        "synth_frac": round(split["wam_synth_frac"], 4) if split else None,
+        "analysis_frac": (round(split["wam_analysis_frac"], 4)
+                          if split else None),
+        "op_total_s": round(split["op_total_s"], 6) if split else None,
     }
 
 
@@ -203,8 +228,12 @@ def tpu_throughput() -> tuple[float, float | None, str, dict | None]:
             from wam_tpu.profiling import median_iqr
 
             dev_tput = batch / median_iqr(dev)[0]
-    h2d = _h2d_report(run, key, batch, image, platform) if H2D else None
-    return batch / t, dev_tput, platform, h2d
+    extras: dict = {}
+    if H2D:
+        extras.update(_h2d_report(run, key, batch, image, platform))
+    if SYNTH:
+        extras.update(_synth_report(run, x, key, platform))
+    return batch / t, dev_tput, platform, extras or None
 
 
 def cpu_baseline_throughput(full: bool = False) -> float:
@@ -333,7 +362,7 @@ def main():
             )
         )
         return
-    tpu, tpu_device, backend, h2d = tpu_throughput()
+    tpu, tpu_device, backend, extras = tpu_throughput()
     try:
         cpu = cpu_baseline_throughput()
     except Exception as e:  # baseline must never block reporting
@@ -360,7 +389,7 @@ def main():
                 "dtype": "f32" if F32 else ("bf16+dwt-bf16" if DWT_BF16 else "bf16"),
                 "baseline_dtype": "f32-torch-cpu",
                 "platform": backend,
-                **(h2d or {}),
+                **(extras or {}),
             }
         )
     )

@@ -1,0 +1,357 @@
+"""The fused synthesis path (ISSUE 4): `idwt2_pallas`, level-collapsed
+waverec2, the 3D matmul synthesis form, and the `set_synth2_impl` knob.
+
+Golden values come from an independent numpy oracle (zero-stuffed full
+convolution with the rec filters, trimmed L-2 per side — the pywt upcoef
+definition; pywt itself is not installed here) so pallas/collapsed parity
+is never checked against the code under test. AOT assertions use the
+trace-count probe (`on_trace` fires once per jit miss, never on an AOT
+hit), so "the collapsed path hits the cache warm" is a counter check that
+cannot flake."""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from wam_tpu.wavelets import matmul as mm
+from wam_tpu.wavelets import transform as tf
+from wam_tpu.wavelets.filters import build_wavelet
+
+
+@pytest.fixture(autouse=True)
+def _restore_impls():
+    yield
+    tf.set_dwt2_impl("auto")
+    tf.set_dwt1_impl("auto")
+    tf.set_synth2_impl("auto")
+
+
+# -- numpy oracle -------------------------------------------------------------
+
+
+def _up_conv(c: np.ndarray, f: np.ndarray, axis: int) -> np.ndarray:
+    """pywt upcoef along one axis: zero-stuff, full convolution, trim L-2
+    per side -> length 2n - L + 2."""
+    L = len(f)
+    n = c.shape[axis]
+    shp = list(c.shape)
+    shp[axis] = 2 * n - 1
+    z = np.zeros(shp, dtype=np.float64)
+    sl = [slice(None)] * c.ndim
+    sl[axis] = slice(None, None, 2)
+    z[tuple(sl)] = c
+    y = np.apply_along_axis(lambda v: np.convolve(v, f, mode="full"), axis, z)
+    out = [slice(None)] * c.ndim
+    out[axis] = slice(L - 2, L - 2 + 2 * n - L + 2)
+    return y[tuple(out)]
+
+
+def _oracle_idwt2(sub: np.ndarray, wavelet: str) -> np.ndarray:
+    """sub: (4, h, w), quadrant order aa/ad/da/dd (row filter, col filter)."""
+    wav = build_wavelet(wavelet)
+    lo = np.asarray(wav.rec_lo, dtype=np.float64)
+    hi = np.asarray(wav.rec_hi, dtype=np.float64)
+    pairs = [(lo, lo), (lo, hi), (hi, lo), (hi, hi)]
+    out = None
+    for q, (fr, fc) in enumerate(pairs):
+        t = _up_conv(_up_conv(sub[q].astype(np.float64), fr, 0), fc, 1)
+        out = t if out is None else out + t
+    return out
+
+
+# -- idwt2_pallas golden parity (interpret mode — CPU tier-1) -----------------
+
+
+@pytest.mark.parametrize("wavelet", ["haar", "db4", "sym3"])
+@pytest.mark.parametrize("size", [(9, 9), (12, 10)])
+def test_idwt2_pallas_matches_numpy_oracle(wavelet, size):
+    sub = np.asarray(
+        jax.random.normal(jax.random.PRNGKey(0), (2, 4, *size)))
+    got = mm.idwt2_pallas(jnp.asarray(sub), wavelet)
+    for b in range(sub.shape[0]):
+        np.testing.assert_allclose(
+            got[b], _oracle_idwt2(sub[b], wavelet), atol=1e-5)
+
+
+@pytest.mark.parametrize("wavelet", ["haar", "db4"])
+@pytest.mark.parametrize("mode", ["reflect", "zero", "periodic", "symmetric"])
+def test_idwt2_pallas_roundtrip_and_conv_parity(wavelet, mode):
+    """dwt2 -> idwt2(pallas) round-trips, and the pallas synthesis equals
+    the conv synthesis on the same subbands for every boundary mode (the
+    synthesis operator itself is mode-independent; modes only change the
+    analysis — but the round-trip exercises the real coefficient shapes
+    each mode produces)."""
+    wav = build_wavelet(wavelet)
+    x = jax.random.normal(jax.random.PRNGKey(1), (2, 24, 24))
+    cA, det = tf.dwt2(x, wav, mode)
+    sub = jnp.stack([cA, det.vertical, det.horizontal, det.diagonal], axis=-3)
+    ref = tf._synthesis(sub, wav, 2, (24, 24))
+    got = mm.idwt2_pallas(sub, wav, (24, 24))
+    np.testing.assert_allclose(got, ref, atol=1e-5)
+    if mode in ("reflect", "periodic"):
+        np.testing.assert_allclose(got, x, atol=1e-4)
+
+
+def test_idwt2_pallas_vjp_matches_matmul():
+    """The custom VJP (backward = the fused analysis kernel) agrees with
+    the plain-XLA synthesis gradient, including through the output trim."""
+    wav = build_wavelet("db4")
+    sub = jax.random.normal(jax.random.PRNGKey(2), (2, 4, 11, 11))
+    w = jax.random.normal(jax.random.PRNGKey(3), (2, 13, 13))
+
+    def loss_pallas(s):
+        return jnp.sum(mm.idwt2_pallas(s, wav, (13, 13)) * w)
+
+    def loss_mm(s):
+        return jnp.sum(mm.synthesis2_mm(s, wav, (13, 13)) * w)
+
+    np.testing.assert_allclose(
+        jax.grad(loss_pallas)(sub), jax.grad(loss_mm)(sub), atol=1e-5)
+
+
+# -- level-collapsed waverec2 -------------------------------------------------
+
+
+@pytest.mark.parametrize("wavelet", ["haar", "db4", "sym3"])
+@pytest.mark.parametrize("mode", ["reflect", "periodic"])
+@pytest.mark.parametrize("size,level", [(64, 3), (96, 4)])
+def test_waverec2_collapsed_matches_per_level(wavelet, mode, size, level):
+    """The host-composed banded operator pair reproduces the per-level conv
+    reconstruction across wavelet x mode x depth."""
+    x = jax.random.normal(jax.random.PRNGKey(4), (2, size, size))
+    coeffs = tf.wavedec2(x, wavelet, level, mode)
+    ref = tf.waverec2(coeffs, wavelet)  # conv path (CPU auto)
+    got = mm.waverec2_collapsed(coeffs[0], coeffs[1:], wavelet)
+    np.testing.assert_allclose(got, ref, atol=1e-4)
+
+
+def test_waverec2_partial_collapse_dispatch(monkeypatch):
+    """With the crossover BETWEEN level sides, waverec2 collapses only the
+    coarse tail and runs the fine levels per-level — output still matches
+    the all-conv reconstruction."""
+    x = jax.random.normal(jax.random.PRNGKey(5), (1, 3, 64, 64))
+    coeffs = tf.wavedec2(x, "db4", 3, "reflect")
+    ref = tf.waverec2(coeffs, "db4")
+    # db4 level sides at 64: 35 / 21 / 14 -> crossover 30 collapses 2 of 3
+    monkeypatch.setattr(tf, "_SYNTH_COLLAPSE", 30)
+    assert tf._collapse_count(coeffs[1:]) == 2
+    tf.set_synth2_impl("pallas")
+    got = jax.jit(lambda c: tf.waverec2(c, "db4"))(coeffs)
+    np.testing.assert_allclose(got, ref, atol=1e-4)
+
+
+def test_waverec2_collapsed_vjp_matches_conv():
+    """Gradients through the collapsed operator pair match the per-level
+    conv reconstruction for the approximation AND every detail leaf."""
+    x = jax.random.normal(jax.random.PRNGKey(6), (1, 48, 48))
+    coeffs = tf.wavedec2(x, "db4", 3, "reflect")
+    w = jax.random.normal(jax.random.PRNGKey(7), (1, 48, 48))
+
+    def loss_conv(c):
+        return jnp.sum(tf.waverec2(c, "db4")[..., :48, :48] * w)
+
+    def loss_collapsed(c):
+        return jnp.sum(
+            mm.waverec2_collapsed(c[0], c[1:], "db4")[..., :48, :48] * w)
+
+    g_ref = jax.grad(loss_conv)(coeffs)
+    g_got = jax.grad(loss_collapsed)(coeffs)
+    for r, g in zip(jax.tree_util.tree_leaves(g_ref),
+                    jax.tree_util.tree_leaves(g_got)):
+        np.testing.assert_allclose(g, r, atol=1e-4)
+
+
+def test_waverec2_collapsed_aot_zero_trace(tmp_path, monkeypatch):
+    """The collapsed + pallas synthesis graph exports through the AOT
+    executable cache and a warm consumer runs it with ZERO traces — the
+    operator matrices are host-composed constants, so nothing in the path
+    defeats `jax.export` (coeffs are passed as FLAT leaves: Exported
+    signatures cannot carry the Detail2D NamedTuple)."""
+    from wam_tpu.pipeline import cached_jit
+
+    monkeypatch.setenv("WAM_TPU_AOT_CACHE", str(tmp_path))
+    # crossover between level sides: 2 levels collapse, 1 runs per-level
+    # through idwt2_pallas — the export covers BOTH new paths
+    monkeypatch.setattr(tf, "_SYNTH_COLLAPSE", 30)
+    tf.set_synth2_impl("pallas")
+    x = jax.random.normal(jax.random.PRNGKey(8), (1, 64, 64))
+    coeffs = tf.wavedec2(x, "db4", 3, "reflect")
+    assert tf._collapse_count(coeffs[1:]) == 2
+    flat, treedef = jax.tree_util.tree_flatten(coeffs)
+
+    def rec_flat(*leaves):
+        return tf.waverec2(
+            jax.tree_util.tree_unflatten(treedef, list(leaves)), "db4")
+
+    traces = []
+    fn1 = cached_jit(rec_flat, tuple(flat), "synth-aot",
+                     on_trace=lambda: traces.append(1),
+                     cache_dir=str(tmp_path))
+    out1 = np.asarray(fn1(*flat))
+    assert traces == [1]  # cold: exactly one export trace
+
+    fn2 = cached_jit(rec_flat, tuple(flat), "synth-aot",
+                     on_trace=lambda: traces.append(2),
+                     cache_dir=str(tmp_path))
+    out2 = np.asarray(fn2(*flat))
+    assert traces == [1]  # warm: ZERO traces — spliced from the cache
+    np.testing.assert_allclose(out2, out1)
+    np.testing.assert_allclose(out1[..., :64, :64],
+                               np.asarray(x), atol=1e-4)
+
+
+# -- bf16-in / f32-accumulate parity (satellite bugfix) -----------------------
+
+
+@pytest.mark.parametrize("impl", ["conv", "matmul", "pallas"])
+def test_idwt2_bf16_coeffs_return_f32(impl):
+    """dwt2 -> idwt2 round-trip with bf16 coefficients returns FLOAT32
+    pixels on every synthesis impl, tracking the f32 path — the mirror of
+    dwt2's bf16-in/f32-accumulate contract."""
+    tf.set_synth2_impl(impl)
+    x = jax.random.normal(jax.random.PRNGKey(9), (2, 32, 32), jnp.float32)
+    cA, det = tf.dwt2(x, "db4", "reflect")
+    ref = tf.idwt2(cA, det, "db4", (32, 32))
+    got = tf.idwt2(
+        cA.astype(jnp.bfloat16),
+        tf.Detail2D(*(d.astype(jnp.bfloat16) for d in det)),
+        "db4", (32, 32))
+    assert ref.dtype == jnp.float32 and got.dtype == jnp.float32
+    scale = float(jnp.abs(ref).max())
+    assert float(jnp.abs(got - ref).max()) < 0.02 * scale
+
+
+@pytest.mark.parametrize("impl", ["conv", "matmul"])
+def test_idwt3_bf16_coeffs_return_f32(impl):
+    """Same contract in 3D, on both the conv path and the new matmul
+    (`synthesis3_mm`) path."""
+    tf.set_synth2_impl(impl)
+    x = jax.random.normal(jax.random.PRNGKey(10), (1, 12, 12, 12), jnp.float32)
+    cA, det = tf.dwt3(x, "db2", "reflect")
+    ref = tf.idwt3(cA, det, "db2", (12, 12, 12))
+    got = tf.idwt3(
+        cA.astype(jnp.bfloat16),
+        {k: v.astype(jnp.bfloat16) for k, v in det.items()},
+        "db2", (12, 12, 12))
+    assert ref.dtype == jnp.float32 and got.dtype == jnp.float32
+    scale = float(jnp.abs(ref).max())
+    assert float(jnp.abs(got - ref).max()) < 0.02 * scale
+
+
+# -- 3D matmul synthesis ------------------------------------------------------
+
+
+@pytest.mark.parametrize("wavelet", ["haar", "db2"])
+def test_synthesis3_mm_matches_conv(wavelet):
+    wav = build_wavelet(wavelet)
+    sub = jax.random.normal(jax.random.PRNGKey(11), (2, 8, 7, 7, 7))
+    L = wav.filt_len
+    out_shape = (2 * 7 - L + 2,) * 3
+    ref = tf._synthesis(sub, wav, 3, out_shape)
+    got = mm.synthesis3_mm(sub, wav, out_shape)
+    np.testing.assert_allclose(got, ref, atol=1e-5)
+
+
+def test_idwt3_matmul_dispatch_roundtrip():
+    tf.set_synth2_impl("matmul")
+    x = jax.random.normal(jax.random.PRNGKey(12), (1, 16, 16, 16))
+    coeffs = tf.wavedec3(x, "haar", 2, "periodic")
+    rec = tf.waverec3(coeffs, "haar")
+    np.testing.assert_allclose(rec[..., :16, :16, :16], x, atol=1e-4)
+
+
+# -- 1D folded synthesis at intermediate levels (satellite fix) ---------------
+
+
+def test_waverec_folds_on_full_length_every_level(monkeypatch):
+    """`idwt` decides the folded1d kernel on the COEFFICIENT-determined full
+    reconstruction length at EVERY level — waverec's intermediate trims
+    must not disqualify the fold (the pre-fix code folded only the top
+    level, whose out_len is None)."""
+    calls = []
+    orig = tf._use_folded1d
+    monkeypatch.setattr(
+        tf, "_use_folded1d", lambda n: (calls.append(n), orig(n))[1])
+    x = jax.random.normal(jax.random.PRNGKey(13), (1, 64))
+    coeffs = tf.wavedec(x, "db4", 3, "reflect")
+    calls.clear()
+    tf.waverec(coeffs, "db4")
+    L = build_wavelet("db4").filt_len
+    expected = [2 * coeffs[i].shape[-1] - L + 2
+                for i in range(1, len(coeffs))]
+    assert calls == expected
+
+
+def test_waverec_folded_matches_conv():
+    """Multi-level waverec under the folded 1D impl (now engaged at every
+    level) equals the conv impl."""
+    x = jax.random.normal(jax.random.PRNGKey(14), (2, 128))
+    coeffs = tf.wavedec(x, "db4", 3, "reflect")
+    tf.set_dwt1_impl("conv")
+    ref = tf.waverec(coeffs, "db4")
+    tf.set_dwt1_impl("folded")
+    got = tf.waverec(coeffs, "db4")
+    np.testing.assert_allclose(got, ref, atol=1e-5)
+
+
+# -- knob + schedule plumbing -------------------------------------------------
+
+
+def test_bad_synth_impl_rejected():
+    with pytest.raises(ValueError):
+        tf.set_synth2_impl("cuda")
+
+
+def test_resolved_synth2_impl_follows_analysis_off_tpu():
+    """auto off-TPU pairs the synthesis with the resolved analysis impl, so
+    the seed's conv-with-conv CPU graphs stay byte-identical by default."""
+    assert tf.get_synth2_impl() == "auto"
+    if jax.default_backend() != "tpu":
+        tf.set_dwt2_impl("conv")
+        assert tf.resolved_synth2_impl() == "conv"
+        tf.set_dwt2_impl("matmul")
+        assert tf.resolved_synth2_impl() == "matmul"
+    tf.set_synth2_impl("pallas")
+    assert tf.resolved_synth2_impl() == "pallas"
+
+
+def test_candidate_synth_impl_in_label_and_entry():
+    from wam_tpu.tune.autotuner import Candidate
+
+    cand = Candidate(sample_chunk=4, synth_impl="pallas")
+    assert "synth=pallas" in cand.label()
+    assert cand.entry()["synth_impl"] == "pallas"
+    assert "synth_impl" not in Candidate(sample_chunk=4).entry()
+
+
+def test_default_schedules_pin_synth_impl():
+    """The flagship TPU entries ship with the fused synthesis path pinned,
+    so prewarm/serve bake it into their AOT keys out of the box."""
+    path = os.path.join(os.path.dirname(tf.__file__), os.pardir, "tune",
+                        "default_schedules.json")
+    with open(path) as f:
+        data = json.load(f)
+    for dtype in ("bf16", "f32"):
+        ent = data["schedules"][f"wam2d|3x224x224|b32|{dtype}|pallas|tpu"]
+        assert ent["synth_impl"] == "pallas"
+
+
+def test_apply_tuned_synth_impl_sets_knob():
+    from wam_tpu.tune import apply_tuned_synth_impl
+    from wam_tpu.tune.cache import invalidate_process_cache, record_schedule
+
+    try:
+        # no entry -> None, knob untouched
+        assert apply_tuned_synth_impl("nosuch", (1, 8, 8), 2) is None
+        assert tf.get_synth2_impl() == "auto"
+        record_schedule("synthtest", (1, 8, 8), 2,
+                        {"sample_chunk": 1, "synth_impl": "matmul"},
+                        persist=False)
+        assert apply_tuned_synth_impl("synthtest", (1, 8, 8), 2) == "matmul"
+        assert tf.get_synth2_impl() == "matmul"
+    finally:
+        invalidate_process_cache()

@@ -10,7 +10,31 @@ This module resolves whichever is available so every call site imports
 
 from __future__ import annotations
 
-__all__ = ["shard_map", "axis_size"]
+import jax
+
+__all__ = ["shard_map", "axis_size", "operand_vma", "shape_dtype_struct"]
+
+
+def operand_vma(*operands) -> frozenset:
+    """Union of the operands' varying-manual-axes sets (jax >= 0.6 inside
+    `shard_map` with check_vma). On jax 0.4.x avals carry no vma at all
+    (the legacy check_rep machinery) — empty set."""
+    typeof = getattr(jax, "typeof", None)
+    if typeof is None:  # pragma: no cover - exercised on jax 0.4.x only
+        return frozenset()
+    return frozenset().union(
+        *(getattr(typeof(a), "vma", frozenset()) for a in operands)
+    )
+
+
+def shape_dtype_struct(shape, dtype, *, vma=frozenset()):
+    """`jax.ShapeDtypeStruct` carrying the ``vma=`` aval annotation where
+    this jax supports it; on 0.4.x the kwarg does not exist and the
+    annotation is meaningless, so it is dropped."""
+    try:
+        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+    except TypeError:  # pragma: no cover - exercised on jax 0.4.x only
+        return jax.ShapeDtypeStruct(shape, dtype)
 
 try:
     from jax import shard_map  # jax >= 0.6: stable top-level export

@@ -347,8 +347,12 @@ def run_cached_auc(
     if runner is None:
         if aot_key is not None:
             # the caller's key identifies model+params; the runner-cache key
-            # carries the metric mode / fan geometry this body bakes in
-            aot_key = f"{aot_key}|auc|{key!r}"
+            # carries the metric mode / fan geometry this body bakes in, and
+            # the synth tag pins the synthesis impl the perturbation fan's
+            # reconstructions (eval2d waverec2) will trace under
+            from wam_tpu.wavelets.transform import resolved_synth2_impl
+
+            aot_key = f"{aot_key}|auc|{key!r}|synth-{resolved_synth2_impl()}"
         runner = batched_auc_runner(
             inputs_fn, model_fn, images_per_chunk, return_logits, fan_chunk,
             mesh, data_axis, donate, aot_key,

@@ -87,8 +87,14 @@ def main(argv=None) -> int:
                                  shape=wl.shape, dtype=wl.dtype)
     cand = Candidate(sample_chunk=chunk,
                      stream_noise=ent.get("stream_noise"),
+                     synth_impl=ent.get("synth_impl"),
                      fan_cap=ent.get("fan_cap", 128))
     fn, wargs = wl.build(cand)
+    # wl.build applied the candidate's synth knob; record what it RESOLVES
+    # to on this backend — the AOT key must pin the baked synthesis path
+    from wam_tpu.wavelets.transform import resolved_synth2_impl
+
+    synth = resolved_synth2_impl()
 
     # Third persistent layer: export the runner's executable so the NEXT
     # process skips the Python trace too. The key extends the schedule-cache
@@ -105,6 +111,7 @@ def main(argv=None) -> int:
             schedule_key(wl.workload, wl.shape, wl.batch, wl.dtype),
             f"chunk{chunk}",
             f"stream{ent.get('stream_noise')}",
+            f"synth{synth}",
             aot_cache.aval_signature(wargs),
         ))
         hit = aot_cache.load_aot(aot_key) is not None
@@ -126,6 +133,7 @@ def main(argv=None) -> int:
         "batch": wl.batch,
         "sample_chunk": chunk,
         "stream_noise": ent.get("stream_noise"),
+        "synth_impl": synth,
         "schedule_entries": len(cache.entries),
         "schedule_stale_files": cache.stale_files,
         "xla_cache_dir": xla_dir,

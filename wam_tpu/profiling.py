@@ -13,7 +13,7 @@ import jax
 
 __all__ = ["trace", "StageTimer", "start_server", "profile_to", "device_sync",
            "bench_time", "bench_samples", "median_iqr", "device_time_samples",
-           "h2d_stats"]
+           "h2d_stats", "named_op_split", "synth_device_split"]
 
 
 def device_sync(out) -> None:
@@ -237,6 +237,118 @@ def h2d_stats(logdir: str) -> dict | None:
         "h2d_seconds": h2d_s,
         "overlap_frac": overlap_frac,
     }
+
+
+def named_op_split(logdir: str,
+                   tokens=("wam_synth", "wam_analysis")) -> dict | None:
+    """Per-token device-time buckets from a profiler capture, or None.
+
+    `jax.named_scope` annotations propagate into XLA op metadata (the
+    scope joins the op's long name / op_name stat), so device ops traced
+    under ``jax.named_scope("wam_synth")`` carry the token. This scans the
+    BUSIEST TPU plane's "XLA Ops" line of the newest capture (max over
+    planes, the `_device_busy_seconds` multi-chip convention), matches each
+    op's metadata name / display name / string stats against the tokens,
+    and reports the interval-UNION seconds per token — op events overlap
+    and nest (fusions), a plain sum double-counts ~2x.
+
+    Returns ``{token: seconds..., "total": seconds}`` (``total`` = union of
+    every op on the line; tokens can overlap it partially — an op both
+    inside and outside a scope buckets by its own metadata only). None when
+    the xplane protos (tensorflow) are unavailable, no capture exists, or
+    no TPU device plane carries an op line (any CPU capture)."""
+    import glob
+
+    try:
+        from tensorflow.tsl.profiler.protobuf import xplane_pb2
+    except ImportError:
+        return None
+
+    paths = glob.glob(f"{logdir}/plugins/profile/*/*.xplane.pb")
+    if not paths:
+        return None
+    space = xplane_pb2.XSpace()
+    with open(sorted(paths)[-1], "rb") as f:
+        space.ParseFromString(f.read())
+
+    best = None  # (busy_seconds, plane, op_line) of the busiest TPU plane
+    for plane in space.planes:
+        if "TPU" not in plane.name:
+            continue
+        for line in plane.lines:
+            if line.name != "XLA Ops" or not line.events:
+                continue
+            busy = _union_seconds(line.events)
+            if best is None or busy > best[0]:
+                best = (busy, plane, line)
+    if best is None:
+        return None
+    _, plane, ops = best
+    event_meta = dict(plane.event_metadata)
+    stat_names = {m.id: m.name for m in plane.stat_metadata.values()}
+    per_token: dict[str, list] = {t: [] for t in tokens}
+    all_iv = []
+    for ev in ops.events:
+        md = event_meta.get(ev.metadata_id)
+        parts = []
+        if md is not None:
+            parts.append(md.name)
+            parts.append(getattr(md, "display_name", ""))
+        for st in ev.stats:
+            if st.str_value:
+                parts.append(st.str_value)
+            elif st.ref_value:
+                # string stats may be interned in the stat_metadata table
+                parts.append(stat_names.get(st.ref_value, ""))
+        label = " ".join(parts).lower()
+        iv = (ev.offset_ps, ev.offset_ps + ev.duration_ps)
+        all_iv.append(iv)
+        for t in tokens:
+            if t.lower() in label:
+                per_token[t].append(iv)
+    out = {
+        t: sum(e - s for s, e in _merged_intervals(per_token[t])) / 1e12
+        for t in tokens
+    }
+    out["total"] = sum(e - s for s, e in _merged_intervals(all_iv)) / 1e12
+    return out
+
+
+def synth_device_split(fn, *args, laps: int = 1, warmup: int = 1) -> dict | None:
+    """Analysis-vs-synthesis device-time split of one runner: traces one
+    lap-amortized region and buckets device op time by the wavelet core's
+    `named_scope` tokens (``wam_synth`` wraps every synthesis dispatch,
+    ``wam_analysis`` the analysis ones — wavelets/transform.py). Seconds are
+    per call (divided by ``laps``); fractions are of the op-union total.
+    None on backends with no TPU device plane (CPU) or without the xplane
+    protos — callers must treat the split as device-backend data only."""
+    import shutil
+    import tempfile
+
+    for _ in range(max(1, warmup)):
+        device_sync(fn(*args))
+    d = tempfile.mkdtemp(prefix="wam_synth_split_")
+    try:
+        jax.profiler.start_trace(d)
+        try:
+            out = None
+            for _ in range(laps):
+                out = fn(*args)
+            device_sync(out)
+        finally:
+            jax.profiler.stop_trace()
+        split = named_op_split(d)
+    finally:
+        shutil.rmtree(d, ignore_errors=True)
+    if split is None:
+        return None
+    total = split.pop("total")
+    res = {f"{k}_s": v / laps for k, v in split.items()}
+    res["op_total_s"] = total / laps
+    if total > 0:
+        for k, v in split.items():
+            res[f"{k}_frac"] = v / total
+    return res
 
 
 def device_time_samples(fn, *args, k: int = 3, laps: int = 1, warmup: int = 1) -> list[float]:

@@ -19,6 +19,7 @@ harvests it on two fronts:
 from wam_tpu.tune.cache import (
     SCHEDULE_CACHE_VERSION,
     ScheduleCache,
+    apply_tuned_synth_impl,
     default_cache_path,
     invalidate_process_cache,
     load_schedule_cache,
@@ -36,6 +37,7 @@ from wam_tpu.tune.fused_relu import (
 __all__ = [
     "SCHEDULE_CACHE_VERSION",
     "ScheduleCache",
+    "apply_tuned_synth_impl",
     "default_cache_path",
     "invalidate_process_cache",
     "load_schedule_cache",

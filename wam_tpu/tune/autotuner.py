@@ -34,6 +34,7 @@ class Candidate:
     sample_chunk: int | None = None
     stream_noise: bool | None = None
     dwt_impl: str | None = None
+    synth_impl: str | None = None  # 2D synthesis backend (set_synth2_impl)
     layout: str | None = None  # "nhwc" | "nchw" (2D engines)
     fan_cap: int | None = None  # evaluation fan chunk cap (eval workloads)
 
@@ -43,6 +44,8 @@ class Candidate:
             parts.append(f"stream={'on' if self.stream_noise else 'off'}")
         if self.dwt_impl is not None:
             parts.append(f"dwt={self.dwt_impl}")
+        if self.synth_impl is not None:
+            parts.append(f"synth={self.synth_impl}")
         if self.layout is not None:
             parts.append(self.layout)
         if self.fan_cap is not None:
@@ -52,7 +55,8 @@ class Candidate:
     def entry(self) -> dict:
         """The knob fields of a schedule-cache entry."""
         out: dict = {"sample_chunk": self.sample_chunk}
-        for field in ("stream_noise", "dwt_impl", "layout", "fan_cap"):
+        for field in ("stream_noise", "dwt_impl", "synth_impl", "layout",
+                      "fan_cap"):
             v = getattr(self, field)
             if v is not None:
                 out[field] = v

@@ -45,6 +45,7 @@ __all__ = [
     "lookup_schedule",
     "record_schedule",
     "resolve_fan_cap",
+    "apply_tuned_synth_impl",
     "invalidate_process_cache",
 ]
 
@@ -194,6 +195,28 @@ def record_schedule(
     if persist:
         cache.save()
     return key
+
+
+def apply_tuned_synth_impl(
+    workload: str,
+    shape,
+    batch: int,
+    dtype: str = "f32",
+) -> str | None:
+    """Apply the tuned ``synth_impl`` for this schedule key (if any) via
+    `set_synth2_impl`, and return it. No entry / no synth field → None and
+    the process-global knob is left alone (whatever the user set, default
+    "auto"). Engines call this at TRACE time, right before the first
+    reconstruction, so an AOT-cached executable bakes in the tuned synthesis
+    path exactly like the tuned chunk/stream knobs."""
+    ent = lookup_schedule(workload, shape, batch, dtype)
+    impl = ent.get("synth_impl") if ent else None
+    if impl:
+        from wam_tpu.wavelets.transform import set_synth2_impl
+
+        set_synth2_impl(impl)
+        return impl
+    return None
 
 
 def resolve_fan_cap(batch_size, fan: int, *, workload: str = "eval2d",

@@ -89,6 +89,12 @@ def _toy_workload(n_samples: int = 8, batch: int = 4, size: int = 32) -> Workloa
             from wam_tpu.wavelets.transform import set_dwt2_impl
 
             set_dwt2_impl(cand.dwt_impl)
+        # unlike dwt_impl, ALWAYS reset: a synth probe earlier in the sweep
+        # must not leak into the no-synth candidates that follow
+        from wam_tpu.wavelets.transform import set_synth2_impl
+
+        set_synth2_impl(cand.synth_impl if cand.synth_impl is not None
+                        else "auto")
         engine = WamEngine(model, ndim=2, wavelet="haar", level=2,
                            mode="reflect")
         return _smoothgrad_runner(
@@ -99,6 +105,10 @@ def _toy_workload(n_samples: int = 8, batch: int = 4, size: int = 32) -> Workloa
     chunks = chunk_candidates(batch, n_samples, targets=(8, 16))
     cands = [Candidate(sample_chunk=c, stream_noise=False) for c in chunks]
     cands.append(Candidate(sample_chunk=chunks[0], stream_noise=True))
+    # synthesis-impl probe (matmul only: interpret-mode pallas is minutes of
+    # CPU for zero signal — the pallas probe lives in the flagship sweep)
+    cands.append(Candidate(sample_chunk=chunks[0], stream_noise=False,
+                           synth_impl="matmul"))
     return Workload(name="toy", workload="wam2d_toy", shape=(size, size),
                     batch=batch, items=batch, candidates=cands, build=build)
 
@@ -122,6 +132,10 @@ def _flagship_workload(n_samples: int = 25, batch: int = 32,
     bound: dict[bool, Callable] = {}
 
     def build(cand: Candidate):
+        from wam_tpu.wavelets.transform import set_synth2_impl
+
+        set_synth2_impl(cand.synth_impl if cand.synth_impl is not None
+                        else "auto")
         nchw = cand.layout == "nchw"
         if nchw not in bound:
             bound[nchw] = bind_inference(model, variables, nchw=nchw,
@@ -140,6 +154,12 @@ def _flagship_workload(n_samples: int = 25, batch: int = 32,
     cands.append(Candidate(sample_chunk=chunks[0], stream_noise=False))
     cands.append(Candidate(sample_chunk=chunks[0], stream_noise=True,
                            layout="nchw"))
+    # synthesis A/B at the law chunk: fused pallas+collapse vs the plain
+    # matmul form (ISSUE 4 — synthesis dominates the per-sample inner loop)
+    cands.append(Candidate(sample_chunk=chunks[0], stream_noise=True,
+                           synth_impl="pallas"))
+    cands.append(Candidate(sample_chunk=chunks[0], stream_noise=True,
+                           synth_impl="matmul"))
     return Workload(name="flagship", workload="wam2d",
                     shape=(3, image, image), batch=batch, items=batch,
                     candidates=cands, build=build, dtype="bf16")

@@ -30,6 +30,18 @@ from wam_tpu.ops.packing2d import disentangle_scales, mosaic2d, reproject_mosaic
 __all__ = ["BaseWAM2D", "WaveletAttribution2D"]
 
 
+def _synth_tagged(aot_key: str | None) -> str | None:
+    """Append the currently-resolved 2D synthesis impl to an AOT cache key:
+    the synthesis path is baked into an exported executable exactly like the
+    dwt impl, so an entry exported under one synth backend must not be
+    replayed under another (`wavelets.transform.set_synth2_impl`)."""
+    if aot_key is None:
+        return None
+    from wam_tpu.wavelets.transform import resolved_synth2_impl
+
+    return f"{aot_key}|synth-{resolved_synth2_impl()}"
+
+
 class BaseWAM2D:
     """Single-pass WAM-2D (`lib/wam_2D.py:50-131`).
 
@@ -109,7 +121,8 @@ class BaseWAM2D:
             _, grads = self.engine.attribute(x, y)
             return mosaic2d(grads, self.normalize_coeffs, self._caxis)
 
-        return jit_entry(impl, donate=donate, on_trace=on_trace, aot_key=aot_key)
+        return jit_entry(impl, donate=donate, on_trace=on_trace,
+                         aot_key=_synth_tagged(aot_key))
 
     def disentangle_scales(self, grads, approx_coeffs: bool = False):
         return disentangle_scales(grads, approx_coeffs=approx_coeffs,
@@ -286,9 +299,23 @@ class WaveletAttribution2D(BaseWAM2D):
             elements *= int(d)
         return elements > (1 << 25)  # 32M f32 elements = 128 MB
 
+    def _apply_tuned_synth(self, x_shape) -> None:
+        """Trace-time application of a tuned ``synth_impl`` schedule entry
+        (same key axes as `_resolve_chunk`): runs right before the first
+        reconstruction is traced, so jitted AND AOT-exported graphs bake in
+        the tuned synthesis path. No entry → the process-global knob (user's
+        `set_synth2_impl`, default "auto") stands."""
+        from wam_tpu.tune import apply_tuned_synth_impl
+
+        apply_tuned_synth_impl(
+            "wam2d", tuple(x_shape[1:]), x_shape[0],
+            "bf16" if self.dwt_bf16 else "f32",
+        )
+
     # -- SmoothGrad --------------------------------------------------------
 
     def _smooth_impl(self, x, y, key):
+        self._apply_tuned_synth(x.shape)
         x = self._to_internal(x)  # once, OUTSIDE the sample map
 
         def step(noisy):
@@ -329,6 +356,7 @@ class WaveletAttribution2D(BaseWAM2D):
     # -- Integrated gradients ---------------------------------------------
 
     def _ig_impl(self, x, y):
+        self._apply_tuned_synth(x.shape)
         x = self._to_internal(x)
         if self.dwt_bf16:
             # same boundary cast as the smooth path: the analysis reads
@@ -387,4 +415,5 @@ class WaveletAttribution2D(BaseWAM2D):
             impl = lambda x, y: self._smooth_impl(x, y, key)  # noqa: E731
         else:
             impl = self._ig_impl
-        return jit_entry(impl, donate=donate, on_trace=on_trace, aot_key=aot_key)
+        return jit_entry(impl, donate=donate, on_trace=on_trace,
+                         aot_key=_synth_tagged(aot_key))

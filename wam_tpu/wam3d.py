@@ -289,7 +289,16 @@ class WaveletAttribution3D(BaseWAM3D):
 
         return cube3d(jax.grad(loss)(coeffs))
 
+    def _apply_tuned_synth(self, x_shape) -> None:
+        # trace-time, same key axes as _resolve_chunk: the 3D reconstruct
+        # inside the grad loss dispatches on the synth knob (idwt3 matmul
+        # form), so jitted/AOT graphs bake in the tuned synthesis path
+        from wam_tpu.tune import apply_tuned_synth_impl
+
+        apply_tuned_synth_impl("wam3d", tuple(x_shape[1:]), x_shape[0])
+
     def _smooth_impl(self, vol, y, key):
+        self._apply_tuned_synth(vol.shape)
         return smoothgrad(
             lambda noisy: self._cube_step(noisy, y),
             vol,
@@ -326,6 +335,7 @@ class WaveletAttribution3D(BaseWAM3D):
         return self.grads
 
     def _ig_impl(self, v, y):
+        self._apply_tuned_synth(v.shape)
         coeffs = self.engine.decompose(v)
         baseline = cube3d(coeffs)
         alphas = jnp.linspace(0.0, 1.0, self.n_samples, dtype=v.dtype)
@@ -398,4 +408,7 @@ class WaveletAttribution3D(BaseWAM3D):
             impl = lambda x, y: self._smooth_impl(x[:, 0], y, key)  # noqa: E731
         else:
             impl = lambda x, y: self._ig_impl(x[:, 0], y)  # noqa: E731
-        return jit_entry(impl, donate=donate, on_trace=on_trace, aot_key=aot_key)
+        from wam_tpu.wam2d import _synth_tagged
+
+        return jit_entry(impl, donate=donate, on_trace=on_trace,
+                         aot_key=_synth_tagged(aot_key))

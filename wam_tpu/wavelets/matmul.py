@@ -29,6 +29,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from wam_tpu import compat
 from wam_tpu.wavelets.filters import Wavelet, build_wavelet
 
 __all__ = [
@@ -36,7 +37,10 @@ __all__ = [
     "synthesis_matrices",
     "analysis2_mm",
     "synthesis2_mm",
+    "synthesis3_mm",
     "dwt2_pallas",
+    "idwt2_pallas",
+    "waverec2_collapsed",
 ]
 
 
@@ -197,10 +201,8 @@ def _pallas_forward(x3: jax.Array, A: jax.Array, Bt: jax.Array) -> jax.Array:
     # Inside shard_map (check_vma=True, the jax 0.9 default) every output
     # aval must carry its varying-manual-axes set; the kernel is elementwise
     # in the grid dim, so outputs vary over exactly the axes the operands
-    # do. Outside shard_map all vmas are empty frozensets — a no-op.
-    out_vma = frozenset().union(
-        *(getattr(jax.typeof(a), "vma", frozenset()) for a in (x3, A, Bt))
-    )
+    # do. Outside shard_map (and on legacy jax) all vmas are empty — a no-op.
+    out_vma = compat.operand_vma(x3, A, Bt)
     return pl.pallas_call(
         _fused_kernel,
         grid=(n,),
@@ -212,8 +214,8 @@ def _pallas_forward(x3: jax.Array, A: jax.Array, Bt: jax.Array) -> jax.Array:
         out_specs=pl.BlockSpec(
             (1, 4, h_out, w_out), lambda i: (i, 0, 0, 0), memory_space=pltpu.VMEM
         ),
-        out_shape=jax.ShapeDtypeStruct((n, 4, h_out, w_out), jnp.float32,
-                                       vma=out_vma),
+        out_shape=compat.shape_dtype_struct((n, 4, h_out, w_out), jnp.float32,
+                                            vma=out_vma),
         interpret=interpret,
     )(A, Bt, x3)
 
@@ -244,6 +246,34 @@ def _core_bwd(res, g):
 _dwt2_pallas_core.defvjp(_core_fwd, _core_bwd)
 
 
+def synthesis3_mm(subbands: jax.Array, wavelet, out_shape) -> jax.Array:
+    """Inverse of one 3D level as three banded matmuls (MXU form of the
+    conv-transpose in `transform._synthesis(ndim=3)`).
+
+    subbands: (..., 8, d0, d1, d2) in the binary a/d channel order over axes
+    (-3, -2, -1) -> (..., out_shape). bf16 subbands are upcast here so the
+    contraction accumulates f32 (bf16-in/f32-accumulate); f64 inputs keep
+    f64 matrices/contractions (x64 mode)."""
+    d0, d1, d2 = subbands.shape[-3:]
+    batch_shape = subbands.shape[:-4]
+    if subbands.dtype == jnp.bfloat16:
+        subbands = subbands.astype(jnp.float32)
+    S0 = synthesis_matrices(d0, wavelet, subbands.dtype)
+    S1 = synthesis_matrices(d1, wavelet, subbands.dtype)
+    S2 = synthesis_matrices(d2, wavelet, subbands.dtype)
+    # channel (b0, b1, b2) is the (b0*d0.., b1*d1.., b2*d2..) block of the
+    # stacked coefficient tensor [lo; hi] per axis — the layout the
+    # [S_lo | S_hi] matrices consume (reshape keeps blocks contiguous).
+    y = subbands.reshape(batch_shape + (2, 2, 2, d0, d1, d2))
+    y = jnp.moveaxis(y, (-6, -5, -4), (-6, -4, -2))  # (..., 2, d0, 2, d1, 2, d2)
+    y = y.reshape(batch_shape + (2 * d0, 2 * d1, 2 * d2))
+    hi = lax.Precision.HIGHEST
+    y = jnp.einsum("ij,...jkl->...ikl", S0, y, precision=hi)
+    y = jnp.einsum("ij,...kjl->...kil", S1, y, precision=hi)
+    y = jnp.einsum("ij,...klj->...kli", S2, y, precision=hi)
+    return y[..., : out_shape[0], : out_shape[1], : out_shape[2]]
+
+
 def dwt2_pallas(x: jax.Array, wavelet, mode: str) -> jax.Array:
     """One 2D analysis level via the fused Pallas kernel (interpreted off-TPU).
 
@@ -272,4 +302,240 @@ def dwt2_pallas(x: jax.Array, wavelet, mode: str) -> jax.Array:
     out = _dwt2_pallas_core(x3, A, B.T)
     if wide:
         out = out.astype(x.dtype)
+    return out.reshape(batch_shape + out.shape[1:])
+
+
+# ---------------------------------------------------------------------------
+# Fused Pallas synthesis: subband merge + both synthesis matmuls, one kernel
+# ---------------------------------------------------------------------------
+
+
+def _fused_synth_kernel(sr_ref, sct_ref, sub_ref, out_ref):
+    # bf16 subbands are upcast HERE, in VMEM (see _fused_kernel): the merge
+    # and both matmuls run with f32 operands/accumulators.
+    sub = sub_ref[0].astype(jnp.float32)  # (4, h, w): aa, ad, da, dd
+    top = jnp.concatenate([sub[0], sub[1]], axis=-1)
+    bot = jnp.concatenate([sub[2], sub[3]], axis=-1)
+    y = jnp.concatenate([top, bot], axis=-2)  # (2h, 2w) block matrix
+    t = jnp.dot(sr_ref[:], y, preferred_element_type=jnp.float32,
+                precision=lax.Precision.HIGHEST)
+    out_ref[0] = jnp.dot(t, sct_ref[:], preferred_element_type=jnp.float32,
+                         precision=lax.Precision.HIGHEST)
+
+
+def _synth_pallas_forward(sub3: jax.Array, Sr: jax.Array, Sct: jax.Array) -> jax.Array:
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    n, _, h, w = sub3.shape
+    full_h, full_w = Sr.shape[0], Sct.shape[1]
+    interpret = jax.default_backend() != "tpu"
+    out_vma = compat.operand_vma(sub3, Sr, Sct)
+    return pl.pallas_call(
+        _fused_synth_kernel,
+        grid=(n,),
+        in_specs=[
+            pl.BlockSpec((full_h, 2 * h), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((2 * w, full_w), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 4, h, w), lambda i: (i, 0, 0, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, full_h, full_w), lambda i: (i, 0, 0), memory_space=pltpu.VMEM
+        ),
+        out_shape=compat.shape_dtype_struct((n, full_h, full_w), jnp.float32,
+                                            vma=out_vma),
+        interpret=interpret,
+    )(Sr, Sct, sub3)
+
+
+@jax.custom_vjp
+def _idwt2_pallas_core(sub3: jax.Array, Sr: jax.Array, Sct: jax.Array) -> jax.Array:
+    return _synth_pallas_forward(sub3, Sr, Sct)
+
+
+def _synth_fwd(sub3, Sr, Sct):
+    return _synth_pallas_forward(sub3, Sr, Sct), (Sr, Sct,
+                                                  jnp.zeros((0,), sub3.dtype))
+
+
+def _synth_bwd(res, g):
+    # The adjoint of out = Sr @ Y @ Sct w.r.t. the quadrant-stacked subbands
+    # is quadrant-split(Sr^T @ g @ Sct^T) — exactly the fused ANALYSIS kernel
+    # with A = Sr^T, B^T = Sct^T, so both directions of the per-sample
+    # reconstruct/grad loop run as single fused VMEM-resident kernels.
+    Sr, Sct, dtype_token = res
+    dsub = _pallas_forward(g, Sr.T, Sct.T)
+    return dsub.astype(dtype_token.dtype), jnp.zeros_like(Sr), jnp.zeros_like(Sct)
+
+
+_idwt2_pallas_core.defvjp(_synth_fwd, _synth_bwd)
+
+
+def idwt2_pallas(subbands: jax.Array, wavelet, out_shape=None) -> jax.Array:
+    """Inverse of one 2D level via the fused Pallas kernel (interpreted
+    off-TPU): subband merge + both synthesis matmuls in one VMEM-resident
+    pass per image. subbands: (..., 4, h, w) in the conv channel order
+    (aa, ad, da, dd) -> (..., out_shape) (full 2h-L+2 x 2w-L+2 when None).
+
+    Dtype contract mirrors `dwt2_pallas`: bf16 subbands are read natively
+    and upcast in VMEM, bf16 and f32 both return FLOAT32 pixels; f64 inputs
+    round-trip to f64-TYPED output but compute in f32 (select the conv or
+    matmul synthesis impl for genuine f64). Custom VJP: the backward is the
+    fused analysis kernel `_pallas_forward` (the exact adjoint)."""
+    h, w = subbands.shape[-2:]
+    Sr = synthesis_matrices(h, wavelet, jnp.float32)
+    Sc = synthesis_matrices(w, wavelet, jnp.float32)
+    batch_shape = subbands.shape[:-3]
+    sub3 = subbands.reshape((-1, 4, h, w))
+    wide = sub3.dtype == jnp.float64
+    if sub3.dtype != jnp.bfloat16:
+        sub3 = sub3.astype(jnp.float32)
+    out = _idwt2_pallas_core(sub3, Sr, Sc.T)
+    if out_shape is not None:
+        out = out[..., : out_shape[0], : out_shape[1]]
+    if wide:
+        out = out.astype(subbands.dtype)
+    return out.reshape(batch_shape + out.shape[1:])
+
+
+# ---------------------------------------------------------------------------
+# Level-collapsed waverec2: the deep tail of tiny levels as ONE operator pair
+# ---------------------------------------------------------------------------
+
+
+@functools.lru_cache(maxsize=256)
+def _collapsed_axis_np(sizes: tuple, rec_lo: tuple, rec_hi: tuple) -> np.ndarray:
+    """Per-axis level-collapsed synthesis operator.
+
+    ``sizes`` are the per-level coefficient lengths along one axis,
+    COARSEST FIRST (n_J, ..., n_1) — the `waverec` loop order. Since the
+    loop is linear in the coefficients, the whole cascade composes into one
+    banded matrix: with S_l = [S_lo | S_hi] the level-l synthesis matrix and
+    the inter-level trim folded in as a row slice (level l's full output is
+    trimmed to level l-1's coefficient length before re-entering),
+
+        C_1 = S_1,   C_l = C_{l-1}[:, :n_{l-1}] @ S_l[:n_{l-1}, :]
+
+    maps level-l [lo; hi] coefficients straight to the FINEST level's full
+    output. Returns [C_J | C_{J-1} | ... | C_1], shape
+    (2*n_1 - L + 2, 2*sum(sizes)) — the cascade's lo chain rides inside
+    each C_l, so the collapsed 2D apply needs the approx block only at the
+    coarsest level (see `waverec2_collapsed`)."""
+    fine_first = sizes[::-1]
+    blocks: list[np.ndarray] = []
+    e_lo = None  # C_{l-1}[:, :n_{l-1}]: the lo chain up to the previous level
+    for i, n in enumerate(fine_first):
+        S = _synthesis_np(int(n), rec_lo, rec_hi)  # (2n - L + 2, 2n)
+        if e_lo is None:
+            C = S
+        else:
+            n_prev = int(fine_first[i - 1])
+            C = e_lo @ S[:n_prev, :]
+        blocks.append(C)
+        e_lo = C[:, : int(n)]
+    return np.concatenate(blocks[::-1], axis=1)
+
+
+def _pair_kernel(r_ref, ct_ref, y_ref, out_ref):
+    t = jnp.dot(r_ref[:], y_ref[0].astype(jnp.float32),
+                preferred_element_type=jnp.float32,
+                precision=lax.Precision.HIGHEST)
+    out_ref[0] = jnp.dot(t, ct_ref[:], preferred_element_type=jnp.float32,
+                         precision=lax.Precision.HIGHEST)
+
+
+def _pair_forward(y3: jax.Array, R: jax.Array, Ct: jax.Array) -> jax.Array:
+    """out[i] = R @ y3[i] @ Ct, one fused VMEM pass per item on TPU; the
+    plain-XLA matmul pair elsewhere (identical math, and keeps the graph
+    free of pallas custom calls where `jax.export` cannot serialize them)."""
+    if jax.default_backend() != "tpu":
+        y = y3 if y3.dtype != jnp.bfloat16 else y3.astype(jnp.float32)
+        return jnp.matmul(jnp.matmul(R, y, precision=lax.Precision.HIGHEST),
+                          Ct, precision=lax.Precision.HIGHEST)
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    n, wr, wc = y3.shape
+    fr, fc = R.shape[0], Ct.shape[1]
+    out_vma = compat.operand_vma(y3, R, Ct)
+    return pl.pallas_call(
+        _pair_kernel,
+        grid=(n,),
+        in_specs=[
+            pl.BlockSpec((fr, wr), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((wc, fc), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, wr, wc), lambda i: (i, 0, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, fr, fc), lambda i: (i, 0, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=compat.shape_dtype_struct((n, fr, fc), jnp.float32,
+                                            vma=out_vma),
+        interpret=False,
+    )(R, Ct, y3)
+
+
+@jax.custom_vjp
+def _pair_core(y3: jax.Array, R: jax.Array, Ct: jax.Array) -> jax.Array:
+    return _pair_forward(y3, R, Ct)
+
+
+def _pair_fwd(y3, R, Ct):
+    return _pair_forward(y3, R, Ct), (R, Ct, jnp.zeros((0,), y3.dtype))
+
+
+def _pair_bwd(res, g):
+    R, Ct, dtype_token = res
+    dy = jnp.matmul(jnp.matmul(R.T, g, precision=lax.Precision.HIGHEST), Ct.T,
+                    precision=lax.Precision.HIGHEST)  # adjoint of R y Ct
+    return dy.astype(dtype_token.dtype), jnp.zeros_like(R), jnp.zeros_like(Ct)
+
+
+_pair_core.defvjp(_pair_fwd, _pair_bwd)
+
+
+def waverec2_collapsed(cA: jax.Array, details, wavelet) -> jax.Array:
+    """Multi-level 2D synthesis of the given levels as ONE banded operator
+    pair: out = R @ Y @ C^T with R/C the host-composed per-axis collapsed
+    operators (`_collapsed_axis_np`, cached, static under jit) and Y the
+    block-diagonal coefficient matrix — per level a 2x2 quadrant block
+    [[aa, V], [H, D]] whose aa slot is ZERO except at the coarsest level
+    (the approx cascade is already folded into the operators). The J
+    sub-tile per-level launches of the deep `waverec2` tail become one
+    MXU-shaped matmul pair.
+
+    ``details`` are Detail2D-shaped levels COARSEST FIRST (the `waverec2`
+    slice to collapse). Returns the FULL reconstruction of the finest given
+    level (2n - L + 2 per side) — the caller trims, exactly like the
+    per-level loop. bf16 leaves are upcast at assembly (f32 accumulate);
+    f64 runs the plain-XLA f64 matmul pair."""
+    w = _wav(wavelet)
+    rlo, rhi = tuple(w.rec_lo), tuple(w.rec_hi)
+    rsizes = tuple(int(d.horizontal.shape[-2]) for d in details)
+    csizes = tuple(int(d.horizontal.shape[-1]) for d in details)
+    wide = cA.dtype == jnp.float64
+    dtype = jnp.float64 if wide else jnp.float32
+    R = jnp.asarray(_collapsed_axis_np(rsizes, rlo, rhi), dtype)
+    C = jnp.asarray(_collapsed_axis_np(csizes, rlo, rhi), dtype)
+    batch_shape = cA.shape[:-2]
+    Y = jnp.zeros(batch_shape + (R.shape[1], C.shape[1]), dtype)
+    off_r = off_c = 0
+    for i, det in enumerate(details):
+        hr, wc = rsizes[i], csizes[i]
+        if i == 0:  # coarsest: the only level whose aa slot carries data
+            a = cA[..., :hr, :wc].astype(dtype)
+            Y = Y.at[..., off_r : off_r + hr, off_c : off_c + wc].set(a)
+        Y = Y.at[..., off_r : off_r + hr, off_c + wc : off_c + 2 * wc].set(
+            det.vertical.astype(dtype))
+        Y = Y.at[..., off_r + hr : off_r + 2 * hr, off_c : off_c + wc].set(
+            det.horizontal.astype(dtype))
+        Y = Y.at[..., off_r + hr : off_r + 2 * hr, off_c + wc : off_c + 2 * wc].set(
+            det.diagonal.astype(dtype))
+        off_r += 2 * hr
+        off_c += 2 * wc
+    if wide:  # x64 mode: genuine f64 via the plain pair (no f32 kernel)
+        out = jnp.matmul(jnp.matmul(R, Y, precision=lax.Precision.HIGHEST),
+                         C.T, precision=lax.Precision.HIGHEST)
+        return out
+    y3 = Y.reshape((-1,) + Y.shape[-2:])
+    out = _pair_core(y3, R, C.T)
     return out.reshape(batch_shape + out.shape[1:])

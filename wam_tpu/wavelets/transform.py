@@ -54,6 +54,9 @@ __all__ = [
     "set_dwt2_impl",
     "get_dwt2_impl",
     "set_dwt1_impl",
+    "set_synth2_impl",
+    "get_synth2_impl",
+    "resolved_synth2_impl",
 ]
 
 # 2D transform backend: "conv" = fused strided lax.conv, "matmul" =
@@ -120,6 +123,57 @@ def _resolved_dwt2_impl() -> str:
     if _dwt2_impl == "auto":
         return "pallas" if jax.default_backend() == "tpu" else "conv"
     return _dwt2_impl
+
+
+# 2D SYNTHESIS backend, the mirror knob of set_dwt2_impl (ISSUE 4): "conv" =
+# dilated conv-transpose, "matmul" = banded-matmul `synthesis2_mm`, "pallas" =
+# the fused `idwt2_pallas` kernel (subband merge + both synthesis matmuls in
+# one VMEM pass, backward = the fused analysis kernel), "auto" (default) =
+# pallas on TPU / follow the analysis impl elsewhere. On the pallas impl,
+# `waverec2` additionally COLLAPSES every contiguous coarsest level whose
+# side length is below `_SYNTH_COLLAPSE` into one host-composed banded
+# operator pair (matmul.waverec2_collapsed) — the deep tail of sub-tile
+# levels becomes a single MXU-shaped matmul instead of J tiny launches.
+_SYNTH2_IMPLS = ("auto", "conv", "matmul", "pallas")
+
+
+def set_synth2_impl(name: str) -> None:
+    """Select the 2D synthesis backend for *not-yet-traced* calls (see
+    set_dwt2_impl's note on jit caching)."""
+    global _synth2_impl
+    if name not in _SYNTH2_IMPLS:
+        raise ValueError(f"impl {name!r} not one of {_SYNTH2_IMPLS}")
+    _synth2_impl = name
+
+
+_synth2_impl = "auto"
+set_synth2_impl(os.environ.get("WAM_TPU_SYNTH2_IMPL", "auto"))
+
+# Level-collapse tile crossover: levels with every detail side BELOW this
+# are folded into the collapsed operator pair (default 128 = one TPU tile's
+# lane width; a level at or past it occupies the MXU on its own).
+_SYNTH_COLLAPSE = int(os.environ.get("WAM_TPU_SYNTH_COLLAPSE", "128"))
+
+
+def get_synth2_impl() -> str:
+    return _synth2_impl
+
+
+def _resolved_synth2_impl() -> str:
+    if _synth2_impl == "auto":
+        if jax.default_backend() == "tpu":
+            return "pallas"
+        # Off-TPU, follow the analysis impl so dwt2/idwt2 stay paired
+        # (conv-with-conv keeps the seed CPU graphs byte-identical).
+        return "conv" if _resolved_dwt2_impl() == "conv" else "matmul"
+    return _synth2_impl
+
+
+def resolved_synth2_impl() -> str:
+    """The impl `idwt2`/`waverec2` would trace RIGHT NOW ("conv" | "matmul" |
+    "pallas") — engines tag AOT cache keys with this so an exported
+    executable records which synthesis path it baked in."""
+    return _resolved_synth2_impl()
 
 DETAIL3D_KEYS = ("aad", "ada", "add", "daa", "dad", "dda", "ddd")
 
@@ -296,30 +350,42 @@ def dwt(x: jax.Array, wavelet, mode: str = "symmetric"):
     if x.dtype == jnp.bfloat16:
         x = x.astype(jnp.float32)
     n = x.shape[-1]
-    if _use_folded1d(n):
-        from wam_tpu.wavelets.folded1d import fold_analysis1d
+    with jax.named_scope("wam_analysis"):
+        if _use_folded1d(n):
+            from wam_tpu.wavelets.folded1d import fold_analysis1d
 
-        L = wav.filt_len
-        xp = _pad_axes(x, L - 1, (-1,), mode)[..., 1:]
-        n_out = (n + L - 1) // 2
-        out = fold_analysis1d(xp, wav, n_out)
-    else:
-        out = _analysis(x, wav, mode, 1)
+            L = wav.filt_len
+            xp = _pad_axes(x, L - 1, (-1,), mode)[..., 1:]
+            n_out = (n + L - 1) // 2
+            out = fold_analysis1d(xp, wav, n_out)
+        else:
+            out = _analysis(x, wav, mode, 1)
     return out[..., 0, :], out[..., 1, :]
 
 
 def idwt(cA: jax.Array, cD: jax.Array, wavelet, out_len: int | None = None):
-    """Single-level inverse 1D DWT. Output length 2n - L + 2 unless trimmed."""
+    """Single-level inverse 1D DWT. Output length 2n - L + 2 unless trimmed.
+
+    bf16 coefficients are upcast before the synthesis conv (bf16-in /
+    f32-accumulate, same contract as the forward `dwt`)."""
     wav = _resolve(wavelet)
     n = cA.shape[-1]
     full = 2 * n - wav.filt_len + 2
     target = full if out_len is None else out_len
+    if cA.dtype == jnp.bfloat16 or cD.dtype == jnp.bfloat16:
+        cA = cA.astype(jnp.float32)
+        cD = cD.astype(jnp.float32)
     sub = jnp.stack([cA, cD], axis=-2)
-    if _use_folded1d(target):
-        from wam_tpu.wavelets.folded1d import fold_synthesis1d
+    # The fold decision is made on the COEFFICIENT-determined full length,
+    # not the requested crop: a caller-supplied out_len (waverec's
+    # intermediate levels) must not disqualify the folded kernel — it
+    # produces the full reconstruction anyway and cropping is free.
+    with jax.named_scope("wam_synth"):
+        if _use_folded1d(full):
+            from wam_tpu.wavelets.folded1d import fold_synthesis1d
 
-        return fold_synthesis1d(sub, wav)[..., :target]
-    return _synthesis(sub, wav, 1, (target,))
+            return fold_synthesis1d(sub, wav)[..., :target]
+        return _synthesis(sub, wav, 1, (target,))
 
 
 def wavedec(x: jax.Array, wavelet, level: int, mode: str = "symmetric"):
@@ -335,13 +401,20 @@ def wavedec(x: jax.Array, wavelet, level: int, mode: str = "symmetric"):
 
 
 def waverec(coeffs: Sequence[jax.Array], wavelet):
-    """Inverse of `wavedec`. Trims each level to the next detail's length."""
+    """Inverse of `wavedec`. Trims each level to the next detail's length.
+
+    Every level goes through `idwt` with an explicit out_len, and `idwt`
+    decides the folded1d kernel on the coefficient length — so when
+    `_use_folded1d` holds at an intermediate level it folds there too, not
+    just at the (untrimmed) top level."""
     wav = _resolve(wavelet)
     a = coeffs[0]
-    for d in coeffs[1:]:
+    for i in range(1, len(coeffs)):
+        d = coeffs[i]
         if a.shape[-1] > d.shape[-1]:
             a = a[..., : d.shape[-1]]
-        a = idwt(a, d, wav)
+        nxt = coeffs[i + 1].shape[-1] if i + 1 < len(coeffs) else None
+        a = idwt(a, d, wav, out_len=nxt)
     return a
 
 
@@ -362,15 +435,16 @@ def dwt2(x: jax.Array, wavelet, mode: str = "reflect"):
     impl = _resolved_dwt2_impl()
     if x.dtype == jnp.bfloat16 and impl != "pallas":
         x = x.astype(jnp.float32)
-    if impl != "conv":
-        from wam_tpu.wavelets import matmul as _mm
+    with jax.named_scope("wam_analysis"):
+        if impl != "conv":
+            from wam_tpu.wavelets import matmul as _mm
 
-        if impl == "pallas":
-            out = _mm.dwt2_pallas(x, wav, mode)
+            if impl == "pallas":
+                out = _mm.dwt2_pallas(x, wav, mode)
+            else:
+                out = _mm.analysis2_mm(x, wav, mode)
         else:
-            out = _mm.analysis2_mm(x, wav, mode)
-    else:
-        out = _analysis(x, wav, mode, 2)
+            out = _analysis(x, wav, mode, 2)
     # channel order (row, col): 0=aa, 1=ad, 2=da, 3=dd
     return out[..., 0, :, :], Detail2D(
         horizontal=out[..., 2, :, :], vertical=out[..., 1, :, :], diagonal=out[..., 3, :, :]
@@ -378,16 +452,27 @@ def dwt2(x: jax.Array, wavelet, mode: str = "reflect"):
 
 
 def idwt2(cA: jax.Array, detail: Detail2D, wavelet, out_shape=None):
+    """Single-level inverse 2D DWT, dispatched on `set_synth2_impl`.
+
+    bf16 coefficients produce FLOAT32 pixels on every impl (bf16-in /
+    f32-accumulate, the mirror of dwt2's contract): the pallas kernel reads
+    bf16 natively and upcasts in VMEM; conv/matmul upcast at this dispatch."""
     wav = _resolve(wavelet)
     n0, n1 = cA.shape[-2:]
     L = wav.filt_len
     target = (2 * n0 - L + 2, 2 * n1 - L + 2) if out_shape is None else tuple(out_shape)
+    impl = _resolved_synth2_impl()
     sub = jnp.stack([cA, detail.vertical, detail.horizontal, detail.diagonal], axis=-3)
-    if _resolved_dwt2_impl() != "conv":
-        from wam_tpu.wavelets import matmul as _mm
+    if sub.dtype == jnp.bfloat16 and impl != "pallas":
+        sub = sub.astype(jnp.float32)
+    with jax.named_scope("wam_synth"):
+        if impl != "conv":
+            from wam_tpu.wavelets import matmul as _mm
 
-        return _mm.synthesis2_mm(sub, wav, target)
-    return _synthesis(sub, wav, 2, target)
+            if impl == "pallas":
+                return _mm.idwt2_pallas(sub, wav, target)
+            return _mm.synthesis2_mm(sub, wav, target)
+        return _synthesis(sub, wav, 2, target)
 
 
 def wavedec2(x: jax.Array, wavelet, level: int, mode: str = "reflect"):
@@ -402,11 +487,39 @@ def wavedec2(x: jax.Array, wavelet, level: int, mode: str = "reflect"):
     return coeffs[::-1]
 
 
+def _collapse_count(details) -> int:
+    """How many contiguous COARSEST levels fall below the collapse
+    crossover (every detail side < _SYNTH_COLLAPSE). Those levels are
+    sub-tile on the MXU individually; `waverec2_collapsed` runs them as one
+    operator pair."""
+    k = 0
+    for det in details:
+        if max(det.horizontal.shape[-2:]) >= _SYNTH_COLLAPSE:
+            break
+        k += 1
+    return k
+
+
 def waverec2(coeffs, wavelet):
-    """Inverse of `wavedec2` (reference reconstruction path, lib/wam_2D.py:113)."""
+    """Inverse of `wavedec2` (reference reconstruction path, lib/wam_2D.py:113).
+
+    On the pallas synthesis impl, the deep tail of sub-tile levels (every
+    side below `_SYNTH_COLLAPSE`, coarsest-first contiguous run of >= 2) is
+    collapsed into ONE banded operator pair (matmul.waverec2_collapsed);
+    remaining fine levels then run per-level through `idwt2`."""
     wav = _resolve(wavelet)
     a = coeffs[0]
-    for det in coeffs[1:]:
+    details = list(coeffs[1:])
+    start = 0
+    if _resolved_synth2_impl() == "pallas":
+        k = _collapse_count(details)
+        if k >= 2:
+            from wam_tpu.wavelets import matmul as _mm
+
+            with jax.named_scope("wam_synth"):
+                a = _mm.waverec2_collapsed(a, details[:k], wav)
+            start = k
+    for det in details[start:]:
         tgt = det.horizontal.shape[-2:]
         a = a[..., : tgt[0], : tgt[1]]
         L = wav.filt_len
@@ -427,19 +540,33 @@ def dwt3(x: jax.Array, wavelet, mode: str = "symmetric"):
     wav = _resolve(wavelet)
     if x.dtype == jnp.bfloat16:
         x = x.astype(jnp.float32)
-    out = _analysis(x, wav, mode, 3)
+    with jax.named_scope("wam_analysis"):
+        out = _analysis(x, wav, mode, 3)
     keys = ("aaa",) + DETAIL3D_KEYS
     coeffs = {k: out[..., i, :, :, :] for i, k in enumerate(keys)}
     return coeffs.pop("aaa"), coeffs
 
 
 def idwt3(cA: jax.Array, details: dict, wavelet, out_shape=None):
+    """Single-level inverse 3D DWT. On the matmul/pallas synthesis impls the
+    conv-transpose is replaced by three banded matmuls (`synthesis3_mm` —
+    the MXU form; there is no 3D pallas kernel, so "pallas" resolves to the
+    matmul form here). bf16 coefficients are upcast on every path (bf16-in /
+    f32-accumulate, the mirror of dwt3's contract)."""
     wav = _resolve(wavelet)
     L = wav.filt_len
     n = cA.shape[-3:]
     target = tuple(2 * s - L + 2 for s in n) if out_shape is None else tuple(out_shape)
+    impl = _resolved_synth2_impl()
     sub = jnp.stack([cA] + [details[k] for k in DETAIL3D_KEYS], axis=-4)
-    return _synthesis(sub, wav, 3, target)
+    with jax.named_scope("wam_synth"):
+        if impl != "conv":
+            from wam_tpu.wavelets import matmul as _mm
+
+            return _mm.synthesis3_mm(sub, wav, target)
+        if sub.dtype == jnp.bfloat16:
+            sub = sub.astype(jnp.float32)
+        return _synthesis(sub, wav, 3, target)
 
 
 def wavedec3(x: jax.Array, wavelet, level: int, mode: str = "symmetric"):
