@@ -52,6 +52,12 @@ H2D = "--h2d" in sys.argv
 # emitted as null with synth_split_plane="none" — an honest "not measured
 # here", never a wall-clock stand-in.
 SYNTH = "--synth" in sys.argv
+# --audio: A/B the 1D DWT backends (plain conv, polyphase "folded", and the
+# chunks-outer "folded_nhc" layout that drops one transpose copy per
+# direction — wavelets/folded1d.py) on the audio wavedec+waverec round trip.
+# One JSON row per impl; headline device-plane when the profiler yields one,
+# wall otherwise (CPU rows are honest wall-only).
+AUDIO = "--audio" in sys.argv
 
 
 def _h2d_report(run, key, batch: int, image: int, platform: str) -> dict:
@@ -395,6 +401,71 @@ def main():
     )
 
 
+def audio_mode():
+    """--audio: one JSON row per 1D-DWT impl (conv / folded / folded_nhc)
+    of the jitted wavedec+waverec round trip at the audio geometry
+    (db6, J=5, 220500 samples; --quick shrinks to 2×16384). The folded
+    layouts are exact re-expressions — each row carries its max abs
+    deviation from the conv reference so the A/B stays a pure layout
+    comparison."""
+    from wam_tpu.config import enable_compilation_cache, ensure_usable_backend
+
+    ensure_usable_backend(timeout_s=180.0)
+    enable_compilation_cache()
+
+    import jax
+    import jax.numpy as jnp
+
+    from wam_tpu.profiling import (bench_samples, device_time_samples,
+                                   median_iqr)
+    from wam_tpu.wavelets import transform as tf
+    from wam_tpu.wavelets.transform import wavedec, waverec
+
+    platform = jax.default_backend()
+    b, n = (2, 16384) if QUICK else (8, 220500)
+    wavelet, levels = "db6", 5
+    x = jax.random.normal(jax.random.PRNGKey(0), (b, n), jnp.float32)
+    ref_out = None
+
+    for impl in ("conv", "folded", "folded_nhc"):
+        tf.set_dwt1_impl(impl)
+        try:
+            step = jax.jit(
+                lambda v: waverec(wavedec(v, wavelet, levels, "symmetric"),
+                                  wavelet)[..., :n]
+            )
+            out = jax.block_until_ready(step(x))
+            wall = bench_samples(step, x, k=5, warmup=0)
+            dev = device_time_samples(step, x, k=3, warmup=0)
+        finally:
+            tf.set_dwt1_impl("auto")
+        if impl == "conv":
+            ref_out, dev_vs_conv = out, 0.0
+        else:
+            dev_vs_conv = float(jnp.max(jnp.abs(out - ref_out)))
+        wall_med, _q1, _q3, iqr = median_iqr(wall)
+        dev_med = median_iqr(dev)[0] if dev else None
+        headline = dev_med if dev_med is not None else wall_med
+        print(
+            json.dumps(
+                {
+                    "metric": f"audio_dwt_roundtrip_b{b}_len{n}_{impl}",
+                    "value": round(b / headline, 3),
+                    "value_plane": "device" if dev_med is not None else "wall",
+                    "unit": "signals/s",
+                    "wall_value": round(b / wall_med, 3),
+                    "device_value": (round(b / dev_med, 3)
+                                     if dev_med is not None else None),
+                    "iqr_pct": round(100 * iqr / wall_med, 2),
+                    "max_abs_diff_vs_conv": dev_vs_conv,
+                    "wavelet": wavelet, "levels": levels,
+                    "dtype": "f32", "platform": platform,
+                },
+            ),
+            flush=True,
+        )
+
+
 def spread_mode():
     """--spread [N]: run the bench in N FRESH processes (default 3) and
     report how tightly the headline agrees — the acceptance check that the
@@ -445,5 +516,7 @@ def spread_mode():
 if __name__ == "__main__":
     if "--spread" in sys.argv:
         spread_mode()
+    elif AUDIO:
+        audio_mode()
     else:
         main()

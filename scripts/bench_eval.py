@@ -1,7 +1,16 @@
 """Evaluation-suite throughput on TPU: insertion/deletion AUC and
 μ-fidelity at a realistic config (ResNet-50, 224², b8, n_iter=64,
 μ sample_size=128) — the paths VERDICT r2 #3 batched into single jit
-dispatches. Prints one JSON line per metric.
+dispatches. Prints one JSON line per metric and appends the same lines to
+``results/eval_<platform>_r6.jsonl`` (override with ``--out``).
+
+Round 9 (fan engine): every row now carries ``result_fetches`` — the number
+of `jax.device_get` round trips the metric call made, counted by
+`wam_tpu.evalsuite.fan.fetch_count` — and the μ-fidelity row adds the
+`profiling.metric_fetch_split` wall/device/residue decomposition. Off TPU
+the device fields are honest None (``plane: "wall"``); ``--toy`` shrinks
+the geometry (ResNet-18, 64², tiny fans) so the fetch accounting can run
+on a 1-core CPU box.
 
 The reference runs these as per-image host loops of 65 pywt
 reconstructions + model calls (`src/evaluators.py:605-765`); there is no
@@ -9,6 +18,7 @@ practical CPU-torch baseline to run in-session (hours), so the record is
 absolute TPU throughput.
 """
 
+import argparse
 import json
 import os
 import sys
@@ -18,6 +28,14 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def main():
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--toy", action="store_true",
+                    help="tiny geometry (ResNet-18, 64², small fans) for "
+                         "CPU smoke runs of the fetch accounting")
+    ap.add_argument("--out", default=None,
+                    help="results jsonl path (default "
+                         "results/eval_<platform>_r6.jsonl)")
+    opts = ap.parse_args()
     from wam_tpu.config import enable_compilation_cache, ensure_usable_backend
 
     ensure_usable_backend(timeout_s=180.0)
@@ -27,18 +45,33 @@ def main():
     import jax.numpy as jnp
 
     platform = jax.default_backend()
-    dtype_label = "bfloat16"
+    toy = opts.toy
+    compute_dtype = jnp.float32 if toy else jnp.bfloat16
+    dtype_label = "float32" if toy else "bfloat16"
+    out_path = opts.out or os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "results", f"eval_{platform}_r6.jsonl")
+    out_rows: list[dict] = []
 
+    from wam_tpu.evalsuite import fan as fan_engine
     from wam_tpu.evalsuite.eval2d import Eval2DWAM
     from wam_tpu.evalsuite.eval_baselines import EvalImageBaselines
-    from wam_tpu.models import bind_inference, resnet50
+    from wam_tpu.models import bind_inference, resnet18, resnet50
     from wam_tpu.wam2d import WaveletAttribution2D
 
-    b, image = 8, 224
-    model = resnet50(num_classes=1000)
+    # full: the rounds-1..5 flagship eval geometry; toy: same code paths at
+    # a size a 1-core CPU box can finish (labels stay honest via b/n_iter)
+    if toy:
+        b, image, n_iter = 2, 64, 8
+        mu_grid, mu_sample, mu_subset = 8, 16, 24
+        caps, repeats, model = (32, 64), 3, resnet18(num_classes=10)
+    else:
+        b, image, n_iter = 8, 224, 64
+        mu_grid, mu_sample, mu_subset = 28, 128, 157
+        caps, repeats, model = (256, 512), 5, resnet50(num_classes=1000)
     variables = model.init(jax.random.PRNGKey(0), jnp.zeros((1, image, image, 3)))
     model_fn = bind_inference(model, variables, nchw=True,
-                              compute_dtype=jnp.bfloat16, fold_bn=True)
+                              compute_dtype=compute_dtype, fold_bn=True)
     x = jax.random.normal(jax.random.PRNGKey(1), (b, 3, image, image), jnp.float32)
     y = list(range(b))
 
@@ -47,10 +80,13 @@ def main():
     ev = Eval2DWAM(model_fn, expl, wavelet="haar", J=3, batch_size=128)
     ev.precompute(x, y)
 
-    def timed(label, fn, n_items, unit, repeats=5, extra=None):
-        from wam_tpu.profiling import median_iqr
+    def timed(label, fn, n_items, unit, repeats=repeats, extra=None,
+              split=False):
+        from wam_tpu.profiling import median_iqr, metric_fetch_split
 
-        fn()  # warm (compile)
+        fan_engine.reset_fetch_count()
+        fn()  # warm (compile); also the fetch-accounting probe call
+        fetches = fan_engine.fetch_count()
         samples = []
         for _ in range(repeats):
             t0 = time.perf_counter()
@@ -61,22 +97,36 @@ def main():
             "metric": label, "value": round(n_items / dt, 3), "unit": unit,
             "seconds": round(dt, 4), "iqr_pct": round(100 * iqr / dt, 2),
             "platform": platform, "batch": n_items, "dtype": dtype_label,
+            "result_fetches": fetches,
         }
+        if split:
+            # wall/device/residue decomposition of the same runner — the
+            # device fields are honest None off TPU (plane stays "wall")
+            s = metric_fetch_split(fn, k=min(3, repeats), warmup=0)
+            rec["plane"] = s["plane"]
+            rec["device_s"] = (round(s["device_s"], 4)
+                               if s["device_s"] is not None else None)
+            rec["residue_s"] = (round(s["residue_s"], 4)
+                                if s["residue_s"] is not None else None)
+            if s["device_s"]:
+                rec["value_plane"] = round(n_items / s["device_s"], 3)
         if extra:
             rec.update(extra)
+        out_rows.append(rec)
         print(json.dumps(rec), flush=True)
         return n_items / dt
 
     # -- forward-only ceiling at the insertion fan's exact geometry --------
-    # The fan pushes B·(n_iter+1) = 520 ResNet-50 rows per insertion call.
-    # Measure bare model-forward throughput over the same 520 rows at the
-    # fan's row-batch (65), the 128-row sweet spot (130), and one giant
-    # dispatch — the schedule-independent ceiling the fan can't beat
+    # The fan pushes B·(n_iter+1) ResNet rows per insertion call (520 at the
+    # full config). Measure bare model-forward throughput over the same rows
+    # at the fan's row-batch, the 128-row sweet spot (2× the fan), and giant
+    # dispatches — the schedule-independent ceiling the fan can't beat
     # (round-4 verdict #6: the eval numbers need a floor argument).
-    rows = b * 65
+    fan_rows = n_iter + 1
+    rows = b * fan_rows
     xrows = jax.random.normal(jax.random.PRNGKey(2), (rows, 3, image, image),
                               jnp.float32)
-    for rb in (65, 130, 260, 520):
+    for rb in [fan_rows * m for m in (1, 2, 4, 8) if fan_rows * m <= rows]:
         fwd = jax.jit(lambda xs: jax.lax.map(model_fn,
                       xs.reshape(rows // rb, rb, 3, image, image)))
         out = fwd(xrows); jax.block_until_ready(out)  # warm
@@ -84,23 +134,25 @@ def main():
               lambda fwd=fwd: jax.block_until_ready(fwd(xrows)),
               rows, "rows/s", extra={"row_batch": rb})
 
-    timed("eval2d_insertion_auc_b8_niter64", lambda: ev.insertion(x, y, n_iter=64),
-          b, "images/s")
+    timed(f"eval2d_insertion_auc_b{b}_niter{n_iter}",
+          lambda: ev.insertion(x, y, n_iter=n_iter), b, "images/s")
     # chunk-cap sweep: batch_size caps the live fan at images_per_chunk×65
     # model rows; 256 → two images (130 rows) per chunk = the flagship's
     # 128-row scheduling sweet spot
-    for cap in (256, 512):
+    for cap in caps:
         ev_cap = Eval2DWAM(model_fn, expl, wavelet="haar", J=3, batch_size=cap)
         ev_cap.grad_wams = ev.grad_wams  # reuse cached explanations
-        timed(f"eval2d_insertion_auc_b8_niter64_cap{cap}",
-              lambda ev_cap=ev_cap: ev_cap.insertion(x, y, n_iter=64),
+        timed(f"eval2d_insertion_auc_b{b}_niter{n_iter}_cap{cap}",
+              lambda ev_cap=ev_cap: ev_cap.insertion(x, y, n_iter=n_iter),
               b, "images/s", extra={"batch_size_cap": cap})
-    timed("eval2d_deletion_auc_b8_niter64", lambda: ev.deletion(x, y, n_iter=64),
-          b, "images/s")
-    timed("eval2d_mu_fidelity_b8_s128",
-          lambda: ev.mu_fidelity(x, y, grid_size=28, sample_size=128,
-                                 subset_size=157),
-          b, "images/s")
+    timed(f"eval2d_deletion_auc_b{b}_niter{n_iter}",
+          lambda: ev.deletion(x, y, n_iter=n_iter), b, "images/s")
+    timed(f"eval2d_mu_fidelity_b{b}_s{mu_sample}",
+          lambda: ev.mu_fidelity(x, y, grid_size=mu_grid,
+                                 sample_size=mu_sample,
+                                 subset_size=mu_subset),
+          b, "images/s", split=True,
+          extra={"grid_size": mu_grid, "sample_size": mu_sample})
 
     # -- streamed multi-batch loop: fresh HOST batches ride
     # pipeline.stage_to_device, so batch k+1's upload (and the host RNG)
@@ -112,7 +164,7 @@ def main():
 
     from wam_tpu.pipeline import stage_to_device
 
-    n_stream = 4
+    n_stream = 2 if toy else 4
     rng = np.random.default_rng(7)
 
     def host_batches():
@@ -122,31 +174,35 @@ def main():
     def stream_once():
         for xb in stage_to_device(host_batches()):
             ev.reset()
-            ev.insertion(xb, y, n_iter=64)
+            ev.insertion(xb, y, n_iter=n_iter)
 
-    timed("eval2d_insertion_streamed_4x_b8_niter64", stream_once,
-          n_stream * b, "images/s", repeats=2,
+    timed(f"eval2d_insertion_streamed_{n_stream}x_b{b}_niter{n_iter}",
+          stream_once, n_stream * b, "images/s", repeats=2,
           extra={"staged_batches": n_stream})
 
     # compute_dtype keeps BOTH evaluators at bf16 so the WAM-vs-baseline
     # comparison is precision-matched (round-3 advisor finding)
     evb = EvalImageBaselines(model, variables, method="saliency", batch_size=128,
-                             compute_dtype=jnp.bfloat16)
+                             compute_dtype=compute_dtype)
     evb.precompute(x, jnp.asarray(y))
-    timed("eval_baselines_saliency_insertion_b8_niter64",
-          lambda: evb.insertion(x, y, n_iter=64), b, "images/s")
-    timed("eval_baselines_saliency_mu_fidelity_b8_s128",
-          lambda: evb.mu_fidelity(x, y, grid_size=28, sample_size=128,
-                                  subset_size=157),
+    timed(f"eval_baselines_saliency_insertion_b{b}_niter{n_iter}",
+          lambda: evb.insertion(x, y, n_iter=n_iter), b, "images/s")
+    timed(f"eval_baselines_saliency_mu_fidelity_b{b}_s{mu_sample}",
+          lambda: evb.mu_fidelity(x, y, grid_size=mu_grid,
+                                  sample_size=mu_sample,
+                                  subset_size=mu_subset),
           b, "images/s")
 
-    # 1D audio evaluator: wavelet-domain insertion = 65 waverec(220k) +
-    # melspec + model forwards per sample — rides the folded 1D DWT
+    # 1D audio evaluator: wavelet-domain insertion = (n_iter+1)
+    # waverec(220k) + melspec + model forwards per sample — rides the
+    # folded 1D DWT
     from bench_workloads import audio_workload
     from wam_tpu.evalsuite.eval1d import Eval1DWAM
     from wam_tpu.models.audio import AudioCNN, bind_audio_inference
 
-    wave_len, ab = 220500, 4
+    # AudioCNN pools T/64 then takes a 2×2 VALID conv, so mel_t (=len/512+1)
+    # must stay ≥ 128 — 65536 is the smallest pow-2 toy length that fits
+    wave_len, ab = (65536, 2) if toy else (220500, 4)
     amodel = AudioCNN(num_classes=50)
     avars = amodel.init(
         jax.random.PRNGKey(0), jnp.zeros((1, 1, wave_len // 512 + 1, 128))
@@ -157,9 +213,19 @@ def main():
     ex1, _, _ = audio_workload(8, b=ab, n=8, wave_len=wave_len)
     ev1 = Eval1DWAM(afn, ex1, wavelet="db6", J=5, batch_size=32)
     ev1.precompute(xw, yw)
-    timed("eval1d_insertion_wavelet_b4_niter64",
-          lambda: ev1.insertion(xw, yw, target="wavelet", n_iter=64),
+    timed(f"eval1d_insertion_wavelet_b{ab}_niter{n_iter}",
+          lambda: ev1.insertion(xw, yw, target="wavelet", n_iter=n_iter),
           ab, "waveforms/s")
+    # input fidelity = the argmax-prediction fan (single-fetch logits path)
+    timed(f"eval1d_input_fidelity_b{ab}",
+          lambda: ev1.input_fidelity(xw, yw, target="wavelet"),
+          ab, "waveforms/s")
+
+    os.makedirs(os.path.dirname(out_path) or ".", exist_ok=True)
+    with open(out_path, "w") as f:
+        for rec in out_rows:
+            f.write(json.dumps(rec) + "\n")
+    print(f"# wrote {len(out_rows)} rows -> {out_path}", flush=True)
 
 
 if __name__ == "__main__":

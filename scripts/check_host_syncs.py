@@ -18,9 +18,12 @@ Two-tier AST scan, no imports of the scanned code:
      donating_jit, smoothgrad). Defs nested inside a traced def are
      traced too.
   2. Flag host-sync calls inside traced code: `np.asarray` /
-     `numpy.asarray` / `onp.asarray`, `<expr>.item()`, and
+     `numpy.asarray` / `onp.asarray`, `<expr>.item()`,
      `float(x)`/`int(x)` where x is a name/attribute/call (constants are
-     fine).
+     fine), and `jax.device_get` / `device_fetch` — a result fetch INSIDE
+     a fan step would break the fan engine's one-fetch-per-metric
+     contract (`wam_tpu.evalsuite.fan`: fetches happen in `run_fan`,
+     after the jitted body returns, never inside it).
 
 Scope: wam_tpu/{core,evalsuite,serve,pipeline,wavelets}. The wavelet core
 entered scope with the fused synthesis path: its matrix builders are
@@ -46,6 +49,7 @@ TRACING_CALLS = {
     "jit", "vmap", "pmap", "grad", "value_and_grad", "checkpoint", "remat",
     "map", "scan", "shard_map", "make_sharded_runner", "jit_entry",
     "cached_jit", "cached_entry", "donating_jit", "smoothgrad",
+    "fan_runner",
 }
 NP_MODULES = {"np", "numpy", "onp"}
 
@@ -105,6 +109,9 @@ def _sync_findings(fn: ast.AST, path: str) -> list[str]:
               and len(node.args) == 1
               and isinstance(node.args[0], (ast.Name, ast.Attribute, ast.Call))):
             found.append(f"{loc}: {f.id}() on a value in traced function")
+        elif _tail_name(f) in ("device_get", "device_fetch"):
+            found.append(f"{loc}: {_tail_name(f)}() in traced function "
+                         "(fetches belong in run_fan, after the fan step)")
     return found
 
 

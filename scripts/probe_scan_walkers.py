@@ -1,0 +1,87 @@
+"""Measure whether the LRP-style per-stage `lax.scan` consolidation has
+anything to win on the guided-backprop / CAM walkers.
+
+The LRP walker earned its scan (evalsuite/lrp.py): ~260 conv/VJP relevance
+sites made its first call ~3× the compile cost of a plain fwd+bwd, and
+scanning the homogeneous blocks of each stage collapsed that multiplier
+(BASELINE.md round-4). Guided backprop and the CAM family are structurally
+different: each is ONE whole-model apply under `value_and_grad` (guided =
+grad through a `clone(act=guided_relu)`; CAM = perturbation-tap gradients
+at a single layer). This probe times the first call (trace + XLA compile)
+and the steady state of each explainer on the same model/input so the
+compile classes can be compared directly — if guided/CAM first calls sit
+in saliency's class rather than LRP's, there is no multiplier for a scan
+to collapse.
+
+Usage: JAX_PLATFORMS=cpu python scripts/probe_scan_walkers.py [--full]
+(default geometry: ResNet-18, 64², b2, f32 CPU; --full: ResNet-50 224²).
+Prints one JSON row per method: {method, first_call_s, steady_s}.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    from wam_tpu.config import ensure_usable_backend
+
+    ensure_usable_backend(timeout_s=180.0)
+
+    import jax
+    import jax.numpy as jnp
+
+    from wam_tpu.evalsuite.baselines import (
+        gradcam,
+        guided_backprop,
+        lrp,
+        saliency,
+    )
+    from wam_tpu.models import bind_inference, resnet18, resnet50
+
+    full = "--full" in sys.argv
+    b, image = (8, 224) if full else (2, 64)
+    model = (resnet50(num_classes=1000) if full else
+             resnet18(num_classes=10))
+    variables = model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, image, image, 3)))
+    model_fn = bind_inference(model, variables, nchw=True)
+    x = jax.random.normal(jax.random.PRNGKey(1), (b, 3, image, image),
+                          jnp.float32)
+    y = jnp.zeros((b,), jnp.int32)
+
+    # jit each explainer so first_call_s is trace + XLA compile, the
+    # quantity the LRP scan consolidation reduced
+    methods = {
+        "saliency": jax.jit(lambda v, t: saliency(model_fn, v, t)),
+        "guided_backprop": jax.jit(
+            lambda v, t: guided_backprop(model, variables, v, t)),
+        "gradcam": jax.jit(
+            lambda v, t: gradcam(model, variables, v, t,
+                                 layer="stage4")),
+        # the scan-consolidated precedent, for scale
+        "lrp": lambda v, t: lrp(model, variables, v, t),
+    }
+    for name, fn in methods.items():
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn(x, y))
+        first = time.perf_counter() - t0
+        steady = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            jax.block_until_ready(fn(x, y))
+            steady.append(time.perf_counter() - t0)
+        print(json.dumps({
+            "method": name,
+            "first_call_s": round(first, 3),
+            "steady_s": round(min(steady), 4),
+            "batch": b, "image": image, "dtype": "f32",
+            "platform": jax.default_backend(),
+        }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -230,6 +230,33 @@ def test_folded1d_synthesis_matches_conv_and_roundtrips(wavelet, n):
     np.testing.assert_allclose(np.asarray(rt), np.asarray(x), atol=2e-4)
 
 
+@pytest.mark.parametrize("wavelet", ["haar", "db6"])
+@pytest.mark.parametrize("n", [4096, 5003])
+def test_folded1d_nhc_layout_matches_nch(wavelet, n):
+    """The chunks-outer "folded_nhc" layout is the same folded linear map
+    with transposed conv layouts — analysis and synthesis must match the
+    "nch" fold exactly at f32 (same kernel entries, same summation per
+    output element)."""
+    from wam_tpu.wavelets import transform as tf
+
+    x = jax.random.normal(jax.random.PRNGKey(3), (2, n), jnp.float32)
+    tf.set_dwt1_impl("folded")
+    try:
+        a_ref, d_ref = dwt(x, wavelet, "symmetric")
+        rec_ref = idwt(a_ref, d_ref, wavelet, out_len=n)
+        tf.set_dwt1_impl("folded_nhc")
+        a, d = dwt(x, wavelet, "symmetric")
+        rec = idwt(a, d, wavelet, out_len=n)
+        coeffs = wavedec(x, wavelet, 3, "symmetric")
+        rt = waverec(coeffs, wavelet)[..., :n]
+    finally:
+        tf.set_dwt1_impl("auto")
+    np.testing.assert_allclose(np.asarray(a), np.asarray(a_ref), atol=1e-6)
+    np.testing.assert_allclose(np.asarray(d), np.asarray(d_ref), atol=1e-6)
+    np.testing.assert_allclose(np.asarray(rec), np.asarray(rec_ref), atol=1e-6)
+    np.testing.assert_allclose(np.asarray(rt), np.asarray(x), atol=2e-4)
+
+
 def test_folded1d_gradients_match_conv():
     """VJP through the folded transforms equals the conv path's VJP —
     the attribution engine differentiates through these."""

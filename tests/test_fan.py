@@ -1,0 +1,286 @@
+"""Eval fan engine (`wam_tpu/evalsuite/fan.py`, round 9).
+
+- plan geometry: int caps reproduce the cap//fan law, "auto" resolves the
+  tuned fan_cap AND the fan_chunk images-per-chunk override;
+- the single-fetch contract: exactly ONE `jax.device_get` per metric call
+  (μ-fidelity, insertion/deletion AUC, input fidelity, baseline fans) —
+  probed by patching `jax.device_get` itself;
+- parity: the fan-engine metric paths reproduce the per-chunk reference
+  path bit for bit at f32 on CPU, across chunk geometries;
+- tuned-chunk plumbing through Eval1DWAM / Eval2DWAM / EvalImageBaselines.
+"""
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from wam_tpu.evalsuite import fan
+from wam_tpu.evalsuite.fan import FanPlan, fan_chunk_geometry, plan_fan
+from wam_tpu.tune import invalidate_process_cache, record_schedule
+
+
+@pytest.fixture
+def sched_cache(tmp_path, monkeypatch):
+    """Isolated user-layer schedule cache (the test_tune fixture)."""
+    path = tmp_path / "schedules.json"
+    monkeypatch.setenv("WAM_TPU_SCHEDULE_CACHE", str(path))
+    monkeypatch.delenv("WAM_TPU_NO_SCHEDULE_CACHE", raising=False)
+    invalidate_process_cache()
+    yield path
+    invalidate_process_cache()
+
+
+class TinyImgModel(nn.Module):
+    classes: int = 5
+
+    @nn.compact
+    def __call__(self, x):
+        x = jnp.transpose(x, (0, 2, 3, 1))
+        x = nn.Conv(8, (3, 3), strides=(2, 2))(x)
+        x = nn.relu(x).mean(axis=(1, 2))
+        return nn.Dense(self.classes)(x)
+
+
+class TinyAudioModel(nn.Module):
+    classes: int = 4
+
+    @nn.compact
+    def __call__(self, x):  # (B, 1, T, M)
+        return nn.Dense(self.classes)(x.reshape((x.shape[0], -1)))
+
+
+@pytest.fixture(scope="module")
+def img_model_fn():
+    model = TinyImgModel()
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 3, 32, 32)))
+    return lambda x: model.apply(params, x)
+
+
+@pytest.fixture
+def count_device_get(monkeypatch):
+    """Patch `jax.device_get` with a counting wrapper; yields the counter.
+    `fan.device_fetch` late-binds the attribute, so every fan-engine fetch
+    lands here — and so would any stray fetch a metric path grew back."""
+    calls = []
+    real = jax.device_get
+    monkeypatch.setattr(jax, "device_get", lambda t: (calls.append(1), real(t))[1])
+    return calls
+
+
+# -- geometry / planning ----------------------------------------------------
+
+
+def test_plan_fan_int_cap_reproduces_law():
+    # fan smaller than cap: several images per chunk, no inner split
+    assert plan_fan(256, 65) == FanPlan(256, 3, None)
+    # fan exceeds cap: one image per chunk, inner fan chunk = cap
+    assert plan_fan(64, 129) == FanPlan(64, 1, 64)
+    assert plan_fan(128, 128) == FanPlan(128, 1, None)
+    for cap, f in [(256, 65), (64, 129), (16, 6)]:
+        assert (plan_fan(cap, f).images_per_chunk,
+                plan_fan(cap, f).fan_chunk) == fan_chunk_geometry(cap, f)
+
+
+def test_plan_fan_auto_resolves_tuned_cap_and_chunk(sched_cache):
+    # no entry: default cap, law geometry
+    assert plan_fan("auto", 65) == FanPlan(128, 1, None)
+    record_schedule("eval2d", (65,), 65, {"fan_cap": 256, "fan_chunk": 4})
+    assert plan_fan("auto", 65) == FanPlan(256, 4, None)
+    # cap-only entry falls back to the law for the chunk
+    record_schedule("eval1d", (65,), 65, {"fan_cap": 512})
+    assert plan_fan("auto", 65, workload="eval1d") == FanPlan(512, 7, None)
+    # fan_chunk=1 with an over-cap fan keeps the inner fan split
+    record_schedule("eval2d", (300,), 300, {"fan_cap": 64, "fan_chunk": 1})
+    assert plan_fan("auto", 300) == FanPlan(64, 1, 64)
+
+
+def test_tuned_plan_plumbs_through_evaluators(sched_cache, img_model_fn):
+    from wam_tpu.evalsuite.eval1d import Eval1DWAM
+    from wam_tpu.evalsuite.eval2d import Eval2DWAM
+    from wam_tpu.evalsuite.eval_baselines import EvalImageBaselines
+
+    record_schedule("eval2d", (9,), 9, {"fan_cap": 32, "fan_chunk": 3})
+    record_schedule("eval1d", (9,), 9, {"fan_cap": 48, "fan_chunk": 5})
+
+    ev2 = Eval2DWAM(img_model_fn, explainer=lambda x, y: None,
+                    batch_size="auto")
+    assert ev2._fan_plan(9) == FanPlan(32, 3, None)
+    assert ev2._fan_cap(9) == 32
+
+    model = TinyImgModel()
+    variables = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 3, 32, 32)))
+    evb = EvalImageBaselines(model, variables, method="saliency",
+                             batch_size="auto", nchw=False)
+    assert evb._fan_plan(9) == FanPlan(32, 3, None)
+
+    ev1 = Eval1DWAM(img_model_fn, explainer=lambda x, y: None,
+                    batch_size="auto")
+    assert ev1._fan_plan(9) == FanPlan(48, 5, None)
+    # explicit ints still pin the cap, tuned entries notwithstanding
+    assert Eval2DWAM(img_model_fn, explainer=None,
+                     batch_size=16)._fan_plan(9) == FanPlan(16, 1, None)
+
+
+# -- the single-fetch contract ----------------------------------------------
+
+
+def test_device_fetch_counter():
+    fan.reset_fetch_count()
+    out = fan.device_fetch(jnp.arange(3.0))
+    assert isinstance(out, np.ndarray)
+    assert fan.fetch_count() == 1
+    fan.reset_fetch_count()
+    assert fan.fetch_count() == 0
+
+
+def test_one_fetch_per_metric_call_eval2d(img_model_fn, count_device_get):
+    from wam_tpu.evalsuite.eval2d import Eval2DWAM
+
+    ev = Eval2DWAM(img_model_fn,
+                   explainer=lambda x, y: jnp.ones(x.shape[:1] + x.shape[-2:]),
+                   wavelet="haar", J=2, batch_size=16)
+    x = jnp.asarray(np.random.default_rng(0).standard_normal((2, 3, 32, 32)),
+                    dtype=jnp.float32)
+    y = [1, 3]
+    ev.precompute(x, np.asarray(y))
+    count_device_get.clear()
+    ev.insertion(x, y, n_iter=8)
+    assert len(count_device_get) == 1
+    count_device_get.clear()
+    ev.deletion(x, y, n_iter=8)
+    assert len(count_device_get) == 1
+    count_device_get.clear()
+    ev.mu_fidelity(x, y, grid_size=8, sample_size=6, subset_size=12)
+    assert len(count_device_get) == 1
+
+
+def test_one_fetch_per_metric_call_baselines(count_device_get):
+    from wam_tpu.evalsuite.eval_baselines import EvalImageBaselines
+
+    model = TinyImgModel()
+    variables = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 3, 32, 32)))
+    ev = EvalImageBaselines(model, variables, method="saliency",
+                            batch_size=16, nchw=False)
+    x = jnp.asarray(np.random.default_rng(1).standard_normal((1, 3, 32, 32)),
+                    dtype=jnp.float32)
+    ev.precompute(x, np.asarray([0]))
+    count_device_get.clear()
+    ev.insertion(x, [0], n_iter=8)
+    assert len(count_device_get) == 1
+    count_device_get.clear()
+    ev.mu_fidelity(x, [0], grid_size=8, sample_size=5, subset_size=10)
+    assert len(count_device_get) == 1
+
+
+def test_one_fetch_per_metric_call_eval1d_input_fidelity(count_device_get):
+    from wam_tpu.evalsuite.eval1d import Eval1DWAM
+    from wam_tpu.wam1d import normalize_waveforms
+
+    model = TinyAudioModel()
+    x = jnp.asarray(np.random.default_rng(2).standard_normal((2, 2048)),
+                    dtype=jnp.float32)
+    ev = Eval1DWAM(lambda m: None, explainer=None, n_fft=256, n_mels=16)
+    mel = ev._melspec(normalize_waveforms(x))
+    variables = model.init(jax.random.PRNGKey(0), mel)
+    ev.model_fn = lambda m: model.apply(variables, m)
+    ev.explainer = lambda xx, yy: (jnp.ones(mel[:, 0].shape), [])
+
+    y = [0, 1]
+    ev.precompute(normalize_waveforms(x), np.asarray(y))
+    count_device_get.clear()
+    preds = ev.input_fidelity(x, y, target="melspec")
+    assert len(count_device_get) == 1  # the raw-logits tensor, fetched once
+    assert len(preds) == 2
+    count_device_get.clear()
+    ev.faithfulness_of_spectra(x, y, target="melspec")
+    assert len(count_device_get) == 1
+
+
+# -- parity vs the per-chunk reference path ---------------------------------
+
+
+def test_auc_fan_matches_reference_bit_for_bit(img_model_fn):
+    """The evaluator's fan path (plan-chunked, run_fan-fetched) must equal
+    the direct per-chunk runner + plain fetch — and itself across chunk
+    geometries — exactly at f32 on CPU."""
+    from wam_tpu.evalsuite.eval2d import Eval2DWAM
+    from wam_tpu.evalsuite.metrics import batched_auc_runner
+
+    rng = np.random.default_rng(3)
+    x = jnp.asarray(rng.standard_normal((4, 3, 32, 32)), dtype=jnp.float32)
+    y = [0, 1, 2, 3]
+    wams = jnp.asarray(rng.standard_normal((4, 32, 32)), dtype=jnp.float32)
+    n_iter = 8
+
+    def build(batch_size):
+        return Eval2DWAM(img_model_fn, explainer=lambda xx, yy: wams,
+                         wavelet="haar", J=2, batch_size=batch_size)
+
+    ev = build(16)
+    scores, curves = ev.evaluate_auc(x, y, "insertion", n_iter=n_iter)
+
+    # reference: the same body dispatched directly, fetched via np.asarray
+    # (the pre-fan path), at a DIFFERENT chunk geometry
+    ref_runner = batched_auc_runner(
+        lambda img, wam: ev._perturb_for_auc(img, wam, "insertion", n_iter),
+        img_model_fn, images_per_chunk=1)
+    ref = np.asarray(ref_runner(x, wams, jnp.asarray(y)))
+    np.testing.assert_array_equal(np.asarray(scores), ref[:, 0])
+    np.testing.assert_array_equal(np.asarray(curves), ref[:, 1:])
+
+    # and a third geometry through the full evaluator path
+    scores2, curves2 = build(9 * 4).evaluate_auc(x, y, "insertion",
+                                                 n_iter=n_iter)
+    np.testing.assert_array_equal(np.asarray(scores), np.asarray(scores2))
+    np.testing.assert_array_equal(np.asarray(curves), np.asarray(curves2))
+
+
+def test_mu_fan_matches_reference_bit_for_bit(img_model_fn):
+    from wam_tpu.evalsuite.eval2d import Eval2DWAM
+
+    rng = np.random.default_rng(4)
+    x = jnp.asarray(rng.standard_normal((2, 3, 32, 32)), dtype=jnp.float32)
+    y = [1, 4]
+    wams = jnp.asarray(rng.standard_normal((2, 32, 32)), dtype=jnp.float32)
+
+    ev = Eval2DWAM(img_model_fn, explainer=lambda xx, yy: wams,
+                   wavelet="haar", J=2, batch_size=16)
+    mus = ev.mu_fidelity(x, y, grid_size=8, sample_size=6, subset_size=12)
+
+    # reference: the same runner at images_per_chunk=1, invoked directly and
+    # fetched with np.asarray (the pre-fan path)
+    rand_all, onehot_all = ev._mu_random_draws(2, 8, 6, 12)
+    ref_runner = ev._make_mu_runner(8, 6, plan=FanPlan(16, 1, None))
+    ref = np.asarray(ref_runner(x, wams, jnp.asarray(y), rand_all, onehot_all))
+    np.testing.assert_array_equal(np.asarray(mus, dtype=np.float32),
+                                  ref.astype(np.float32))
+
+
+def test_run_cached_auc_accepts_plan_and_int(img_model_fn):
+    """Back-compat: `run_cached_auc` takes either a FanPlan or a plain int
+    cap, and the two agree when the plan is the law plan."""
+    from wam_tpu.evalsuite.metrics import (
+        fan_chunk_geometry as geom,
+        generate_masks,
+        run_cached_auc,
+    )
+
+    rng = np.random.default_rng(5)
+    x = jnp.asarray(rng.standard_normal((2, 3, 32, 32)), dtype=jnp.float32)
+    expl = jnp.asarray(rng.standard_normal((2, 32, 32)), dtype=jnp.float32)
+    y = np.array([0, 1])
+    n_iter = 4
+
+    def inputs_fn(x_s, e_s):
+        ins, _ = generate_masks(n_iter, e_s)
+        return x_s[None] * ins[:, None]
+
+    s_int, c_int = run_cached_auc({}, "m", inputs_fn, img_model_fn, 16,
+                                  n_iter, x, expl, y)
+    plan = FanPlan(16, *geom(16, n_iter + 1))
+    s_plan, c_plan = run_cached_auc({}, "m", inputs_fn, img_model_fn, plan,
+                                    n_iter, x, expl, y)
+    np.testing.assert_array_equal(np.asarray(s_int), np.asarray(s_plan))
+    np.testing.assert_array_equal(np.asarray(c_int), np.asarray(c_plan))

@@ -7,6 +7,15 @@ from wam_tpu.evalsuite.baselines import (
     smoothgrad_pixel,
 )
 from wam_tpu.evalsuite.eval1d import Eval1DWAM
+from wam_tpu.evalsuite.fan import (
+    FanPlan,
+    device_fetch,
+    fan_runner,
+    fetch_count,
+    plan_fan,
+    reset_fetch_count,
+    run_fan,
+)
 from wam_tpu.evalsuite.eval2d import Eval2DWAM, imagenet_denormalize, imagenet_preprocess
 from wam_tpu.evalsuite.eval_baselines import AUDIO_METHODS, IMAGE_METHODS, EvalAudioBaselines, EvalImageBaselines
 from wam_tpu.evalsuite.metrics import compute_auc, generate_masks, minmax_normalize, softmax_probs, spearman
@@ -21,6 +30,13 @@ from wam_tpu.evalsuite.packing import (
 __all__ = [
     "Eval1DWAM",
     "Eval2DWAM",
+    "FanPlan",
+    "plan_fan",
+    "fan_runner",
+    "run_fan",
+    "device_fetch",
+    "fetch_count",
+    "reset_fetch_count",
     "EvalImageBaselines",
     "EvalAudioBaselines",
     "IMAGE_METHODS",

@@ -16,6 +16,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from wam_tpu.evalsuite.fan import FanPlan, plan_fan
 from wam_tpu.evalsuite.metrics import (
     batch_fingerprint as _batch_fingerprint,
     generate_masks,
@@ -96,12 +97,14 @@ class Eval1DWAM:
         self.grad_wams = None
         self._expl_key = None
 
-    def _fan_cap(self, fan: int) -> int:
-        """Explicit ints pass through; "auto" consults the tuned schedule
-        cache keyed by this metric's fan (workload "eval1d")."""
-        from wam_tpu.tune import resolve_fan_cap
+    def _fan_plan(self, fan: int) -> FanPlan:
+        """Explicit int ``batch_size`` pins the memory cap; "auto" consults
+        the tuned schedule cache keyed by this metric's fan (workload
+        "eval1d": fan_cap + fan_chunk override)."""
+        return plan_fan(self.batch_size, fan, workload="eval1d")
 
-        return resolve_fan_cap(self.batch_size, fan, workload="eval1d")
+    def _fan_cap(self, fan: int) -> int:
+        return self._fan_plan(fan).cap
 
     def _melspec(self, wave: jax.Array) -> jax.Array:
         mel = melspectrogram(
@@ -170,7 +173,7 @@ class Eval1DWAM:
             (mode, target),
             inputs_fn,
             self.model_fn,
-            self._fan_cap(n_iter + 1),
+            self._fan_plan(n_iter + 1),
             n_iter,
             x,
             expl,

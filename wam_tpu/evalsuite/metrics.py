@@ -12,6 +12,16 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from wam_tpu.evalsuite.fan import (  # noqa: F401  (re-exported: pre-fan import sites)
+    FanPlan,
+    fan_chunk_geometry,
+    fan_runner,
+    make_chunked_forward,
+    make_sharded_runner,
+    plan_fan,
+    run_fan,
+)
+
 __all__ = [
     "softmax_probs",
     "compute_auc",
@@ -165,69 +175,6 @@ def mu_fidelity_draws(cache: dict, seed: int, n_images: int, grid_size: int,
     return out
 
 
-def fan_chunk_geometry(batch_size: int, fan: int) -> tuple[int, int | None]:
-    """Shared chunk geometry honoring the caller's ``batch_size`` memory cap:
-    several images per `lax.map` chunk when the per-image fan is small, an
-    inner fan-chunked forward when one sample's fan alone exceeds the cap.
-    Returns (images_per_chunk, fan_chunk)."""
-    images_per_chunk = max(1, batch_size // fan)
-    fan_chunk = batch_size if (images_per_chunk == 1 and fan > batch_size) else None
-    return images_per_chunk, fan_chunk
-
-
-def make_chunked_forward(model_fn, fan_chunk: int | None):
-    """Forward over a per-image fan, `lax.map`-chunked when the fan exceeds
-    the memory cap (`fan_chunk_geometry`)."""
-
-    def forward(inputs):
-        if fan_chunk is not None and fan_chunk < inputs.shape[0]:
-            return jax.lax.map(
-                lambda r: model_fn(r[None])[0], inputs, batch_size=fan_chunk
-            )
-        return model_fn(inputs)
-
-    return forward
-
-
-def _pad_to_multiple(tree, n: int):
-    """Cyclically pad every leaf's axis 0 to a multiple of ``n``; returns
-    (padded_tree, original_len). Per-image metrics ignore the pad rows."""
-    lead = jax.tree_util.tree_leaves(tree)[0].shape[0]
-    pad = (-lead) % n
-    if pad == 0:
-        return tree, lead
-    return (
-        jax.tree_util.tree_map(
-            lambda a: jnp.resize(a, (lead + pad,) + a.shape[1:]), tree
-        ),
-        lead,
-    )
-
-
-def make_sharded_runner(body, mesh, data_axis: str = "data"):
-    """jit(shard_map(body)) sharding axis 0 of every positional arg over
-    ``data_axis``, with cyclic padding to the axis size and slice-back of
-    every output leaf — the one-dispatch on-mesh evaluation shape shared by
-    the AUC and μ-fidelity runners (round-4 verdict #4)."""
-    from functools import partial
-
-    from jax.sharding import PartitionSpec as P
-
-    from wam_tpu.compat import shard_map
-
-    sharded = jax.jit(
-        partial(shard_map, mesh=mesh, in_specs=P(data_axis),
-                out_specs=P(data_axis))(body)
-    )
-
-    def run(*args):
-        args, lead = _pad_to_multiple(args, mesh.shape[data_axis])
-        out = sharded(*args)
-        return jax.tree_util.tree_map(lambda a: a[:lead], out)
-
-    return run
-
-
 def batched_auc_runner(
     inputs_fn,
     model_fn,
@@ -299,16 +246,8 @@ def batched_auc_runner(
         # wall, i.e. the two fetches were 80% of the call
         return jnp.concatenate([compute_auc(out)[:, None], out], axis=1)
 
-    if mesh is None:
-        from wam_tpu.pipeline.donation import resolve_donate
-
-        argnums = (0, 1) if resolve_donate(donate) else ()
-        if aot_key is not None:
-            from wam_tpu.pipeline.aot import cached_entry
-
-            return cached_entry(body, aot_key, donate_argnums=argnums)
-        return jax.jit(body, donate_argnums=argnums)
-    return make_sharded_runner(body, mesh, data_axis)
+    return fan_runner(body, mesh=mesh, data_axis=data_axis, donate=donate,
+                      donate_argnums=(0, 1), aot_key=aot_key)
 
 
 def run_cached_auc(
@@ -316,7 +255,7 @@ def run_cached_auc(
     key_extra,
     inputs_fn,
     model_fn,
-    batch_size: int,
+    batch_size,
     n_iter: int,
     x,
     expl,
@@ -329,20 +268,24 @@ def run_cached_auc(
 ):
     """Memoized `batched_auc_runner` invocation shared by the evaluators.
 
-    Chunk geometry honors the caller's ``batch_size`` memory cap in both
-    regimes: several images per chunk when the fan is small, an inner
-    fan-chunked forward when one sample's fan alone exceeds it. ``mesh``
-    shards the image batch (see `batched_auc_runner`). ``donate``/
-    ``aot_key`` are forwarded there; when donation is active the ``x`` /
-    ``expl`` arguments are routed through `donation_safe`, so caller-held
-    and instance-cached jax Arrays survive the donation (host arrays
-    upload fresh either way — no extra copy on the common path)."""
+    ``batch_size`` is either a resolved `FanPlan` (the evaluators'
+    `_fan_plan`, which consults the tuned fan_cap AND fan_chunk schedule)
+    or a plain int memory cap whose geometry falls back to the cap//fan
+    law. Either way the call ends in EXACTLY ONE result fetch
+    (`fan.run_fan`): the fused [score | curve] array — or the raw logits
+    tensor on the ``return_logits`` path — crosses the tunnel once.
+    ``mesh`` shards the image batch (see `batched_auc_runner`); ``donate``/
+    ``aot_key`` are forwarded there, with ``x``/``expl`` routed through
+    `donation_safe` so caller-held and instance-cached jax Arrays survive
+    the donation (host arrays upload fresh either way)."""
     import numpy as np
 
-    from wam_tpu.pipeline.donation import donation_safe, resolve_donate
-
-    images_per_chunk, fan_chunk = fan_chunk_geometry(batch_size, n_iter + 1)
-    key = (n_iter, return_logits, tuple(x.shape[1:]), key_extra)
+    if isinstance(batch_size, FanPlan):
+        plan = batch_size
+    else:
+        plan = FanPlan(batch_size, *fan_chunk_geometry(batch_size, n_iter + 1))
+    key = (n_iter, return_logits, tuple(x.shape[1:]), key_extra,
+           plan.images_per_chunk, plan.fan_chunk)
     runner = cache.get(key)
     if runner is None:
         if aot_key is not None:
@@ -354,19 +297,18 @@ def run_cached_auc(
 
             aot_key = f"{aot_key}|auc|{key!r}|synth-{resolved_synth2_impl()}"
         runner = batched_auc_runner(
-            inputs_fn, model_fn, images_per_chunk, return_logits, fan_chunk,
-            mesh, data_axis, donate, aot_key,
+            inputs_fn, model_fn, plan.images_per_chunk, return_logits,
+            plan.fan_chunk, mesh, data_axis, donate, aot_key,
         )
         cache[key] = runner
-    donating = mesh is None and resolve_donate(donate)
-    out = runner(donation_safe(x, donating), donation_safe(expl, donating),
-                 jnp.asarray(y))
-    if return_logits:
-        return list(np.asarray(out))
     # ONE device fetch for the whole call: round 4 batched the per-element
     # float(v)/np.asarray(p) fetches (16 sequential ~100 ms tunnel RTTs)
-    # into one per tensor; round 5 fuses the two result tensors into one
-    # [score | curve] array so the call pays a single RTT (insertion wall
-    # 267 → ~160 ms at 54 ms device, BASELINE.md round-5)
+    # into one per tensor; round 5 fused the two result tensors into one
+    # [score | curve] array; the fan engine routes it through the counted
+    # `device_fetch` so the single-RTT contract is enforced, not implied
+    out = run_fan(runner, (x, expl, jnp.asarray(y)), donate=donate,
+                  mesh=mesh, protect=(0, 1))
+    if return_logits:
+        return list(np.asarray(out))
     arr = np.asarray(out)
     return [float(v) for v in arr[:, 0]], list(arr[:, 1:])

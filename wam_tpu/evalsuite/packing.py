@@ -9,6 +9,14 @@ filters) — the array grows to fit, like pywt's padded layout.
 
 1D layout is the flattened concatenation [cA_J | cD_J | ... | cD_1] used by
 the reference's flattened multi-scale masks (`src/evaluators.py:56-143`).
+
+Fan-engine contract (evalsuite/fan.py): these pack/unpack calls execute
+INSIDE the jitted fan step — masked packed-array multiplies and the
+reconstructions they feed never leave the device, so a metric's per-chunk
+work stays device-resident and only the reduced result crosses the host
+boundary (one `device_fetch` per metric call). Keeping the index
+arithmetic static-shape (no traced values in offsets) is what makes that
+legal under jit.
 """
 
 from __future__ import annotations

@@ -13,7 +13,8 @@ import jax
 
 __all__ = ["trace", "StageTimer", "start_server", "profile_to", "device_sync",
            "bench_time", "bench_samples", "median_iqr", "device_time_samples",
-           "h2d_stats", "named_op_split", "synth_device_split"]
+           "h2d_stats", "named_op_split", "synth_device_split",
+           "metric_fetch_split"]
 
 
 def device_sync(out) -> None:
@@ -348,6 +349,37 @@ def synth_device_split(fn, *args, laps: int = 1, warmup: int = 1) -> dict | None
     if total > 0:
         for k, v in split.items():
             res[f"{k}_frac"] = v / total
+    return res
+
+
+def metric_fetch_split(fn, *args, k: int = 3, laps: int = 1,
+                       warmup: int = 1) -> dict:
+    """Wall vs device-span split of one METRIC call (a full evalsuite metric:
+    μ-fidelity, an AUC fan, input fidelity — python in, python out).
+
+    Under the fan engine's single-fetch contract
+    (`wam_tpu.evalsuite.fan.run_fan`) a metric call is one enqueued program
+    plus exactly one result fetch, so its wall time decomposes as
+    ``wall ≈ device_span + fetch residue`` where the residue is the host
+    round trip (~100 ms on the tunneled TPU) plus host glue. This measures
+    both planes of the same runner and reports the residue explicitly — the
+    number the fan engine exists to pin at ONE RTT per call.
+
+    Returns ``{"wall_s", "wall_q1_s", "wall_q3_s", "device_s", "residue_s",
+    "plane"}``; wall fields are `bench_samples` medians/quartiles. On
+    backends with no TPU device plane (CPU) or without the xplane protos,
+    ``device_s``/``residue_s`` are honest None and ``plane`` is "wall" —
+    callers must label such rows CPU/wall, never report them as device
+    numbers (the rounds 6-8 convention)."""
+    wall = bench_samples(fn, *args, k=k, laps=laps, warmup=warmup)
+    med, q1, q3, _ = median_iqr(wall)
+    res = {"wall_s": med, "wall_q1_s": q1, "wall_q3_s": q3,
+           "device_s": None, "residue_s": None, "plane": "wall"}
+    dev = device_time_samples(fn, *args, k=k, laps=laps, warmup=0)
+    if dev:
+        dmed = median_iqr(dev)[0]
+        res.update(device_s=dmed, residue_s=max(0.0, med - dmed),
+                   plane="device")
     return res
 
 

@@ -5,7 +5,7 @@ round 5's roofline showed the flagship still runs at 29.7% of its traffic
 floor — the remaining gap is schedule. This module turns the hand sweep into
 a harness: a workload preset (`wam_tpu.tune.workloads`) builds a jitted
 runner per `Candidate` (sample chunk, stream_noise, dwt impl, layout,
-eval fan cap), the measurement prefers `profiling.device_time_samples`
+eval fan cap / fan chunk), the measurement prefers `profiling.device_time_samples`
 medians (xplane module spans — the chip, not the tunnel; VERDICT.md round-5
 directive 4) and falls back to `bench_samples` wall medians where no TPU
 device plane exists (CPU CI, the `--dry-run` smoke), and the winner is
@@ -37,6 +37,7 @@ class Candidate:
     synth_impl: str | None = None  # 2D synthesis backend (set_synth2_impl)
     layout: str | None = None  # "nhwc" | "nchw" (2D engines)
     fan_cap: int | None = None  # evaluation fan chunk cap (eval workloads)
+    fan_chunk: int | None = None  # eval images-per-chunk override (fan engine)
 
     def label(self) -> str:
         parts = [f"chunk={self.sample_chunk if self.sample_chunk else 'full'}"]
@@ -50,13 +51,15 @@ class Candidate:
             parts.append(self.layout)
         if self.fan_cap is not None:
             parts.append(f"fan={self.fan_cap}")
+        if self.fan_chunk is not None:
+            parts.append(f"fchunk={self.fan_chunk}")
         return " ".join(parts)
 
     def entry(self) -> dict:
         """The knob fields of a schedule-cache entry."""
         out: dict = {"sample_chunk": self.sample_chunk}
         for field in ("stream_noise", "dwt_impl", "synth_impl", "layout",
-                      "fan_cap"):
+                      "fan_cap", "fan_chunk"):
             v = getattr(self, field)
             if v is not None:
                 out[field] = v

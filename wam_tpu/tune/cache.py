@@ -223,7 +223,12 @@ def resolve_fan_cap(batch_size, fan: int, *, workload: str = "eval2d",
                     shape=None, default: int = 128) -> int:
     """Evaluation fan-chunk cap: explicit ints pass through; "auto" consults
     the tuned ``fan_cap`` for (workload, fan) and falls back to ``default``
-    (the EvalConfig.batch_size the rounds 1-5 numbers were recorded at)."""
+    (the EvalConfig.batch_size the rounds 1-5 numbers were recorded at).
+
+    The same entry may carry a tuned ``fan_chunk`` (images-per-chunk
+    override, the autotuner's `Candidate.fan_chunk` axis); that companion
+    knob is resolved by `wam_tpu.evalsuite.fan.plan_fan`, which wraps this
+    cap lookup into a full `FanPlan`."""
     if batch_size != "auto":
         return int(batch_size)
     ent = lookup_schedule(workload, shape or (fan,), fan)

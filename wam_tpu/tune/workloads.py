@@ -15,9 +15,12 @@ Presets:
   128/256/512 rows + full vmap, stream_noise on/off, and an NCHW layout
   probe.
 - ``mu2d`` — the μ-fidelity inner runner at production geometry (grid 28,
-  sample 128) sweeping the evaluation fan cap; winner feeds
-  `resolve_fan_cap("auto")` (VERDICT.md round-5 directive 3 — the slowest
-  eval row).
+  sample 128) sweeping the evaluation fan cap AND the images-per-chunk
+  override (`Candidate.fan_chunk`); winner feeds
+  `evalsuite.fan.plan_fan("auto")` (VERDICT.md round-5 directive 3 — the
+  slowest eval row).
+- ``fan2d`` — the insertion-AUC fan at production geometry, same two axes,
+  persisted under the (n_iter+1)-row eval2d key every AUC metric resolves.
 """
 
 from __future__ import annotations
@@ -190,12 +193,68 @@ def _mu2d_workload(n_images: int = 4, image: int = 224, grid_size: int = 28,
                        batch_size=int(cand.fan_cap))
         rand_all, onehot_all = ev._mu_random_draws(
             n_images, grid_size, sample_size, subset_size)
-        runner = ev._make_mu_runner(grid_size, sample_size)
+        runner = ev._make_mu_runner(grid_size, sample_size,
+                                    plan=_explicit_plan(cand, sample_size))
         return runner, (x, wams, y, rand_all, onehot_all)
 
     cands = [Candidate(fan_cap=c) for c in (64, 128, 256, 512)]
+    # fan_chunk axis: images-per-chunk overrides at a fixed cap — the law
+    # says 256//128 = 2, the sweep asks whether 1 or 4 actually wins
+    cands += [Candidate(fan_cap=256, fan_chunk=1),
+              Candidate(fan_cap=256, fan_chunk=4)]
     return Workload(name="mu2d", workload="eval2d", shape=(sample_size,),
                     batch=sample_size, items=n_images, candidates=cands,
+                    build=build)
+
+
+def _explicit_plan(cand: Candidate, fan: int):
+    """Candidate knobs → explicit `FanPlan` (never "auto": the sweep must
+    not read the cache entry it is about to write)."""
+    from wam_tpu.evalsuite.fan import FanPlan, fan_chunk_geometry
+
+    cap = int(cand.fan_cap)
+    images_per_chunk, fan_chunk = fan_chunk_geometry(cap, fan)
+    if cand.fan_chunk:
+        images_per_chunk, fan_chunk = max(1, int(cand.fan_chunk)), None
+    return FanPlan(cap, images_per_chunk, fan_chunk)
+
+
+def _fan2d_workload(n_images: int = 8, image: int = 224,
+                    n_iter: int = 64) -> Workload:
+    """Insertion-AUC fan (Eval2DWAM) at production geometry, sweeping the
+    model-row cap AND the images-per-chunk override (`Candidate.fan_chunk`).
+    Persists under the same eval2d key `plan_fan` consults for the
+    (n_iter+1)-row AUC fans — the round-5 hand sweep found cap 256 worth
+    1.6× over the 128 law on insertion; this makes that sweep (and the
+    finer chunk question it couldn't ask) a harness."""
+    from wam_tpu.evalsuite.eval2d import Eval2DWAM
+    from wam_tpu.evalsuite.metrics import batched_auc_runner
+    from wam_tpu.models import bind_inference, resnet50
+
+    model = resnet50(num_classes=1000)
+    variables = model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, image, image, 3)))
+    model_fn = bind_inference(model, variables, nchw=True, fold_bn=True)
+    x = jax.random.normal(jax.random.PRNGKey(1),
+                          (n_images, 3, image, image), jnp.float32)
+    y = jnp.arange(n_images, dtype=jnp.int32) % 1000
+    wams = jax.random.uniform(jax.random.PRNGKey(2), (n_images, image, image))
+
+    def build(cand: Candidate):
+        ev = Eval2DWAM(model_fn, explainer=lambda xx, yy: wams,
+                       batch_size=int(cand.fan_cap))
+        plan = _explicit_plan(cand, n_iter + 1)
+        runner = batched_auc_runner(
+            lambda img, wam: ev._perturb_for_auc(img, wam, "insertion",
+                                                 n_iter),
+            model_fn, plan.images_per_chunk, fan_chunk=plan.fan_chunk)
+        return runner, (x, wams, jnp.asarray(y))
+
+    cands = [Candidate(fan_cap=c) for c in (128, 256, 512)]
+    cands += [Candidate(fan_cap=256, fan_chunk=1),
+              Candidate(fan_cap=512, fan_chunk=4)]
+    return Workload(name="fan2d", workload="eval2d", shape=(n_iter + 1,),
+                    batch=n_iter + 1, items=n_images, candidates=cands,
                     build=build)
 
 
@@ -203,6 +262,7 @@ WORKLOADS: dict[str, Callable[..., Workload]] = {
     "toy": _toy_workload,
     "flagship": _flagship_workload,
     "mu2d": _mu2d_workload,
+    "fan2d": _fan2d_workload,
 }
 
 

@@ -20,6 +20,15 @@ n = 2P·m + r and outputs as i = P·mo + s. Then
 transposed map the same way (taps j ∈ {0..}, input padded right). Both are
 EXACT re-expressions of the conv path (no approximation; parity tested in
 tests/test_dwt.py against the reference indexing implementation).
+
+Layouts: the original "nch" form feeds the conv (B, 2P, chunks), which
+costs a real transpose copy on each side of the phase-split reshape. The
+"nhc" layout keeps chunks outer — the analysis phase split
+``(B, total) → (B, chunks, 2P)`` and the synthesis output flatten
+``(B, Mt, 2P) → (B, Mt·2P)`` become FREE reshapes (trailing axes merge in
+row-major order) and only one transpose per direction remains. Same kernel
+entries, transposed to HIO; bit-identical results up to conv layout
+lowering (parity tested at f32).
 """
 
 from __future__ import annotations
@@ -78,15 +87,18 @@ def _synthesis_kernel_np(rec_lo: tuple, rec_hi: tuple, P: int) -> np.ndarray:
 
 
 _DN = lax.conv_dimension_numbers((1, 1, 1), (1, 1, 1), ("NCH", "OIH", "NCH"))
+_DN_NHC = lax.conv_dimension_numbers((1, 1, 1), (1, 1, 1), ("NHC", "HIO", "NHC"))
 
 
 def fold_analysis1d(xp: jax.Array, wav: Wavelet, n_out: int,
-                    P: int = FOLD_P) -> jax.Array:
+                    P: int = FOLD_P, layout: str = "nch") -> jax.Array:
     """Folded equivalent of the 1D analysis conv.
 
     ``xp``: the ALREADY pywt-padded signal (`pad(x, L-1)[..., 1:]`),
     shape (..., Np). Returns (..., 2, n_out) identical to
-    `transform._analysis`'s channel layout.
+    `transform._analysis`'s channel layout. ``layout`` picks the conv data
+    layout: "nch" (original) or "nhc" (free phase-split reshape — the input
+    transpose disappears; see module docstring).
     """
     L = wav.filt_len
     batch_shape = xp.shape[:-1]
@@ -97,26 +109,36 @@ def fold_analysis1d(xp: jax.Array, wav: Wavelet, n_out: int,
     M = -(-n_out // P)
     total = (M + J - 1) * 2 * P
     xb = jnp.pad(xb, ((0, 0), (0, max(0, total - Np))))[:, :total]
-    ph = xb.reshape(-1, M + J - 1, 2 * P).swapaxes(1, 2)  # (B, 2P, chunks)
 
-    W = jnp.asarray(
-        _analysis_kernel_np(tuple(wav.dec_lo), tuple(wav.dec_hi), P),
-        dtype=xp.dtype,
-    )
-    out = lax.conv_general_dilated(
-        ph, W, window_strides=(1,), padding=[(0, 0)],
-        dimension_numbers=_DN, precision=lax.Precision.HIGHEST,
-    )  # (B, 2P, M)
-    out = out.reshape(-1, 2, P, M).swapaxes(2, 3).reshape(-1, 2, M * P)
+    Wk = _analysis_kernel_np(tuple(wav.dec_lo), tuple(wav.dec_hi), P)
+    if layout == "nhc":
+        # phase split (B, chunks, 2P) is a FREE reshape in this layout
+        ph = xb.reshape(-1, M + J - 1, 2 * P)
+        out = lax.conv_general_dilated(
+            ph, jnp.asarray(Wk.transpose(2, 1, 0), dtype=xp.dtype),
+            window_strides=(1,), padding=[(0, 0)],
+            dimension_numbers=_DN_NHC, precision=lax.Precision.HIGHEST,
+        )  # (B, M, 2P)
+        out = out.reshape(-1, M, 2, P).swapaxes(1, 2).reshape(-1, 2, M * P)
+    else:
+        ph = xb.reshape(-1, M + J - 1, 2 * P).swapaxes(1, 2)  # (B, 2P, chunks)
+        out = lax.conv_general_dilated(
+            ph, jnp.asarray(Wk, dtype=xp.dtype),
+            window_strides=(1,), padding=[(0, 0)],
+            dimension_numbers=_DN, precision=lax.Precision.HIGHEST,
+        )  # (B, 2P, M)
+        out = out.reshape(-1, 2, P, M).swapaxes(2, 3).reshape(-1, 2, M * P)
     return out[:, :, :n_out].reshape(batch_shape + (2, n_out))
 
 
-def fold_synthesis1d(sub: jax.Array, wav: Wavelet, P: int = FOLD_P) -> jax.Array:
+def fold_synthesis1d(sub: jax.Array, wav: Wavelet, P: int = FOLD_P,
+                     layout: str = "nch") -> jax.Array:
     """Folded equivalent of the 1D synthesis conv.
 
     ``sub``: (..., 2, n) [cA; cD]. Returns the FULL reconstruction
     (..., 2n − L + 2) — the caller crops to its target length exactly like
-    `transform._synthesis`.
+    `transform._synthesis`. ``layout`` as in `fold_analysis1d`; under "nhc"
+    the output flatten (B, Mt, 2P) → (B, Mt·2P) is a free reshape.
     """
     L = wav.filt_len
     batch_shape = sub.shape[:-2]
@@ -131,15 +153,22 @@ def fold_synthesis1d(sub: jax.Array, wav: Wavelet, P: int = FOLD_P) -> jax.Array
     # input chunks over i: (f, si) channels, chunk index mi
     pad_i = Mi * P - n
     sbp = jnp.pad(sb, ((0, 0), (0, 0), (0, max(0, pad_i))))[:, :, : Mi * P]
-    ph = sbp.reshape(-1, 2, Mi, P).swapaxes(2, 3).reshape(-1, 2 * P, Mi)
 
-    W = jnp.asarray(
-        _synthesis_kernel_np(tuple(wav.rec_lo), tuple(wav.rec_hi), P),
-        dtype=sub.dtype,
-    )
-    out = lax.conv_general_dilated(
-        ph, W, window_strides=(1,), padding=[(0, 0)],
-        dimension_numbers=_DN, precision=lax.Precision.HIGHEST,
-    )  # (B, 2P, Mt)
-    y = out.swapaxes(1, 2).reshape(-1, Mt * 2 * P)[:, :full]
+    Wk = _synthesis_kernel_np(tuple(wav.rec_lo), tuple(wav.rec_hi), P)
+    if layout == "nhc":
+        ph = sbp.reshape(-1, 2, Mi, P).swapaxes(1, 2).reshape(-1, Mi, 2 * P)
+        out = lax.conv_general_dilated(
+            ph, jnp.asarray(Wk.transpose(2, 1, 0), dtype=sub.dtype),
+            window_strides=(1,), padding=[(0, 0)],
+            dimension_numbers=_DN_NHC, precision=lax.Precision.HIGHEST,
+        )  # (B, Mt, 2P) — flattens to out[2P·mt + rt] with no transpose
+        y = out.reshape(-1, Mt * 2 * P)[:, :full]
+    else:
+        ph = sbp.reshape(-1, 2, Mi, P).swapaxes(2, 3).reshape(-1, 2 * P, Mi)
+        out = lax.conv_general_dilated(
+            ph, jnp.asarray(Wk, dtype=sub.dtype),
+            window_strides=(1,), padding=[(0, 0)],
+            dimension_numbers=_DN, precision=lax.Precision.HIGHEST,
+        )  # (B, 2P, Mt)
+        y = out.swapaxes(1, 2).reshape(-1, Mt * 2 * P)[:, :full]
     return y.reshape(batch_shape + (full,))

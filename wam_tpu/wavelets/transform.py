@@ -87,10 +87,13 @@ set_dwt2_impl(os.environ.get("WAM_TPU_DWT2_IMPL", "auto"))
 
 # 1D transform backend: "conv" = the plain fused conv; "folded" = the
 # polyphase channel-fold (wavelets/folded1d.py — same linear map expressed
-# as a 128-channel conv, full sublane occupancy on long signals); "auto"
-# (default) = folded on TPU for signals past the fold break-even, conv
-# elsewhere. Exact re-expression up to float summation order.
-_DWT1_IMPLS = ("auto", "conv", "folded")
+# as a 128-channel conv, full sublane occupancy on long signals);
+# "folded_nhc" = the same fold with chunks-outer conv layout, which turns
+# the phase-split reshape on one side of each conv into a free reshape
+# (one transpose copy saved per direction); "auto" (default) = folded on
+# TPU for signals past the fold break-even, conv elsewhere. Exact
+# re-expression up to float summation order.
+_DWT1_IMPLS = ("auto", "conv", "folded", "folded_nhc")
 _FOLD1D_MIN_LEN = 4096
 
 
@@ -108,11 +111,17 @@ set_dwt1_impl(os.environ.get("WAM_TPU_DWT1_IMPL", "auto"))
 
 
 def _use_folded1d(n: int) -> bool:
-    if _dwt1_impl == "folded":
+    if _dwt1_impl in ("folded", "folded_nhc"):
         return True
     if _dwt1_impl == "conv":
         return False
     return jax.default_backend() == "tpu" and n >= _FOLD1D_MIN_LEN
+
+
+def _fold1d_layout() -> str:
+    """Conv data layout for the folded 1D kernels ("nch" unless the
+    "folded_nhc" impl was selected)."""
+    return "nhc" if _dwt1_impl == "folded_nhc" else "nch"
 
 
 def get_dwt2_impl() -> str:
@@ -357,7 +366,7 @@ def dwt(x: jax.Array, wavelet, mode: str = "symmetric"):
             L = wav.filt_len
             xp = _pad_axes(x, L - 1, (-1,), mode)[..., 1:]
             n_out = (n + L - 1) // 2
-            out = fold_analysis1d(xp, wav, n_out)
+            out = fold_analysis1d(xp, wav, n_out, layout=_fold1d_layout())
         else:
             out = _analysis(x, wav, mode, 1)
     return out[..., 0, :], out[..., 1, :]
@@ -384,7 +393,8 @@ def idwt(cA: jax.Array, cD: jax.Array, wavelet, out_len: int | None = None):
         if _use_folded1d(full):
             from wam_tpu.wavelets.folded1d import fold_synthesis1d
 
-            return fold_synthesis1d(sub, wav)[..., :target]
+            return fold_synthesis1d(
+                sub, wav, layout=_fold1d_layout())[..., :target]
         return _synthesis(sub, wav, 1, (target,))
 
 
