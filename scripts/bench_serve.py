@@ -1,17 +1,37 @@
 """Closed-loop load generator for the `wam_tpu.serve` runtime.
 
-N client threads drive an `AttributionServer` over a mixed-shape request
-stream (>= 3 item shapes by default, exercising bucket routing and spatial
-padding), each submitting its next request the moment the previous result
-lands — closed loop, so offered load tracks served throughput and the
-queue depth measures coalescing, not generator lag. Backpressure
-(`QueueFullError`) is honored by sleeping the server's ``retry_after_s``.
+N client threads drive an `AttributionServer` — or, with ``--fleet N``, a
+multi-chip `FleetServer` — over a mixed-shape request stream (>= 3 item
+shapes by default, exercising bucket routing and spatial padding), each
+submitting its next request the moment the previous result lands — closed
+loop, so offered load tracks served throughput and the queue depth
+measures coalescing, not generator lag. Backpressure (`QueueFullError`) is
+honored by sleeping the server's ``retry_after_s``.
 
 Emits the serve JSONL ledger (one ``serve_batch`` row per dispatched batch
-+ one ``serve_summary`` row: fill ratio, pad waste, p50/p99 latency,
-attributions/sec, compile count) and prints the summary. Runs end-to-end
-on CPU with the toy model — the same path tests/test_serve.py smokes — and
-on TPU with `--device tpu` (donated input buffers, compilation cache).
++ per-replica ``serve_summary`` rows + a ``fleet_summary`` row when
+fleeted) and prints the summary.
+
+Fleet modes:
+- ``--fleet N`` serves with N replica workers (one per visible device;
+  on CPU the script forces an N-device host platform BEFORE jax imports,
+  so ``--device cpu --fleet 8`` exercises the real multi-device routing
+  and oversize pjit paths on one machine).
+- ``--fleet-sweep 1,2,4,8`` runs the whole bench once per fleet size
+  (clients and requests scale with N so each point is equally loaded) and
+  prints the scaling curve; ``--emit PATH`` writes it as JSON
+  (the MULTICHIP evidence artifact).
+- ``--fake-entry MS`` swaps the model for a GIL-releasing fixed-cost fake
+  (one ``time.sleep`` per batch). On a single machine every "chip" of a
+  CPU fleet shares the same cores, so a real model measures core
+  contention, not fleet plumbing; the fake isolates routing/admission/
+  harvest overhead and gives an honest scaling curve.
+- ``--toy`` shrinks the workload (one small bucket, few requests) — the
+  verify-skill smoke.
+
+Runs end-to-end on CPU with the toy model — the same path
+tests/test_serve.py and tests/test_fleet.py smoke — and on TPU with
+``--device tpu`` (donated input buffers, compilation cache).
 """
 
 import argparse
@@ -20,69 +40,129 @@ import os
 import random
 import sys
 import threading
+import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
-def main():
-    from wam_tpu.config import ServeConfig, add_config_args, config_from_args
+def _force_host_devices(n: int) -> None:
+    """Expose n virtual CPU devices. Must run before the first jax import."""
+    if "jax" in sys.modules:
+        raise RuntimeError("XLA_FLAGS must be set before jax is imported")
+    flags = os.environ.get("XLA_FLAGS", "")
+    os.environ["XLA_FLAGS"] = f"{flags} --xla_force_host_platform_device_count={n}".strip()
 
-    parser = argparse.ArgumentParser(description=__doc__)
-    add_config_args(parser, ServeConfig)
-    parser.add_argument("--requests", type=int, default=96,
-                        help="total requests across all clients")
-    parser.add_argument("--clients", type=int, default=4,
-                        help="closed-loop client threads")
-    parser.add_argument("--n-samples", type=int, default=4,
-                        help="SmoothGrad samples per attribution")
-    parser.add_argument("--seed", type=int, default=0)
-    args = parser.parse_args()
-    cfg = config_from_args(args, ServeConfig)
 
-    from wam_tpu.config import select_backend
+class _FakeEntry:
+    """Fixed-service-time serving entry: counts one compile per new input
+    shape (mirroring the jit cache-miss hook) and sleeps ``ms`` per batch
+    with the GIL released, so N replica workers genuinely overlap."""
 
-    select_backend(cfg.device)
+    def __init__(self, metrics, ms: float):
+        self._metrics = metrics
+        self._seen = set()
+        self._lock = threading.Lock()
+        self._s = ms / 1e3
 
+    def __call__(self, xs, ys):
+        import numpy as np
+
+        shape = tuple(int(d) for d in xs.shape)
+        with self._lock:
+            if shape not in self._seen:
+                self._seen.add(shape)
+                self._metrics.note_compile()
+        time.sleep(self._s)
+        return np.zeros(shape, np.float32)
+
+
+def run_bench(cfg, args, n_fleet: int):
+    """One bench point: build the server (fleet when n_fleet > 1), drive it
+    with closed-loop clients, return (summary, fleet_summary|None)."""
     import jax
     import numpy as np
 
-    from wam_tpu.models.toy import toy_conv_model
-    from wam_tpu.serve import AttributionServer, QueueFullError, ServeMetrics
-    from wam_tpu.wam2d import WaveletAttribution2D
+    from wam_tpu.serve import (
+        AttributionServer,
+        FleetMetrics,
+        FleetServer,
+        QueueFullError,
+        ServeMetrics,
+    )
+    from wam_tpu.tune import resolve_bucket_cap
 
-    bucket_shapes = cfg.bucket_shapes() or [(1, 32, 32), (1, 48, 48), (1, 64, 64)]
+    if args.toy:
+        bucket_shapes = [(1, 16, 16)]
+        n_requests, n_clients, n_samples = 16, 2, 2
+    else:
+        bucket_shapes = cfg.bucket_shapes() or [(1, 32, 32), (1, 48, 48), (1, 64, 64)]
+        n_requests, n_clients, n_samples = args.requests, args.clients, args.n_samples
+    # closed loop: scale offered load with the fleet so every sweep point
+    # saturates equally instead of the 8-chip point idling on a 1-chip load
+    n_requests *= n_fleet
+    n_clients *= n_fleet
     # request mix: every exact bucket shape plus an undersized shape per
     # bucket, so the stream exercises both exact routing and spatial padding
     request_shapes = list(bucket_shapes) + [
         (s[0],) + tuple(max(1, d - 4) for d in s[1:]) for s in bucket_shapes
     ]
-
-    toy = toy_conv_model(jax.random.PRNGKey(0), ndim=2)
-    wam = WaveletAttribution2D(
-        lambda x: toy(x.mean(axis=1)),  # engine feeds NCHW; toy takes (B, H, W)
-        J=2,
-        n_samples=args.n_samples,
-        sample_batch_size=None,
+    max_batch = resolve_bucket_cap(
+        cfg.max_batch, bucket_shapes[0], replicas=n_fleet
     )
-    metrics = ServeMetrics()
-    entry = wam.serve_entry(on_trace=metrics.note_compile)
+
+    if args.fake_entry is not None:
+        entry_factory = lambda rid, m: _FakeEntry(m, args.fake_entry)
+    else:
+        from wam_tpu.models.toy import toy_conv_model
+        from wam_tpu.wam2d import WaveletAttribution2D
+
+        toy = toy_conv_model(jax.random.PRNGKey(0), ndim=2)
+        wam = WaveletAttribution2D(
+            lambda x: toy(x.mean(axis=1)),  # engine feeds NCHW; toy takes (B, H, W)
+            J=2,
+            n_samples=n_samples,
+            sample_batch_size=None,
+        )
+        entry_factory = lambda rid, m: wam.serve_entry(on_trace=m.note_compile)
+
     metrics_path = cfg.metrics_path or "results/bench_serve.jsonl"
+    if n_fleet == 1:
+        # single-chip serving stays the plain server — the fleet layer must
+        # cost nothing when you don't ask for it
+        metrics = ServeMetrics()
+        server = AttributionServer(
+            entry_factory(None, metrics),
+            bucket_shapes,
+            max_batch=max_batch,
+            max_wait_ms=cfg.max_wait_ms,
+            queue_depth=cfg.queue_depth,
+            deadline_ms=cfg.deadline_ms,
+            warmup=cfg.warmup,
+            compilation_cache=cfg.compilation_cache,
+            metrics=metrics,
+            metrics_path=metrics_path,
+            pipelined=cfg.pipelined,
+        )
+        fleet_metrics = None
+    else:
+        fleet_metrics = FleetMetrics()
+        server = FleetServer(
+            entry_factory,
+            bucket_shapes,
+            replicas=n_fleet,
+            max_batch=max_batch,
+            max_wait_ms=cfg.max_wait_ms,
+            queue_depth=cfg.queue_depth,
+            deadline_ms=cfg.deadline_ms,
+            warmup=cfg.warmup,
+            compilation_cache=cfg.compilation_cache,
+            metrics=fleet_metrics,
+            metrics_path=metrics_path,
+            oversize=cfg.oversize,
+            pipelined=cfg.pipelined,
+        )
 
-    server = AttributionServer(
-        entry,
-        bucket_shapes,
-        max_batch=cfg.max_batch,
-        max_wait_ms=cfg.max_wait_ms,
-        queue_depth=cfg.queue_depth,
-        deadline_ms=cfg.deadline_ms,
-        warmup=cfg.warmup,
-        compilation_cache=cfg.compilation_cache,
-        metrics=metrics,
-        metrics_path=metrics_path,
-        pipelined=cfg.pipelined,
-    )
-
-    budget = threading.Semaphore(args.requests)
+    budget = threading.Semaphore(n_requests)
     errors = []
 
     def client(cid: int):
@@ -104,22 +184,133 @@ def main():
                     errors.append(repr(e))
                     break
 
-    threads = [threading.Thread(target=client, args=(i,)) for i in range(args.clients)]
+    t_load0 = time.perf_counter()
+    threads = [threading.Thread(target=client, args=(i,)) for i in range(n_clients)]
     for t in threads:
         t.start()
     for t in threads:
         t.join()
+    load_s = time.perf_counter() - t_load0
     server.close()  # drains + emits the ledger
 
-    summary = metrics.summary()
-    print(json.dumps({k: summary[k] for k in (
-        "completed", "rejected", "expired", "batches", "compile_count",
-        "fill_ratio_mean", "pad_waste_mean",
-        "latency_p50_ms", "latency_p99_ms", "attributions_per_s",
-    )}, indent=2))
-    print(f"ledger: {metrics_path}")
-    if errors:
-        print(f"{len(errors)} request errors, first: {errors[0]}", file=sys.stderr)
+    if fleet_metrics is not None:
+        fs = fleet_metrics.fleet_summary()
+        # served-window throughput: the sweep curve compares load windows,
+        # not process lifetimes (warmup/compile time varies per point)
+        fs["load_window_s"] = load_s
+        fs["attributions_per_s_load"] = fs["completed"] / load_s if load_s > 0 else 0.0
+        return fs, errors
+    summary = metrics.snapshot()
+    summary["load_window_s"] = load_s
+    summary["attributions_per_s_load"] = (
+        summary["completed"] / load_s if load_s > 0 else 0.0
+    )
+    return summary, errors
+
+
+def _pre_scan_fleet(argv):
+    """Peek at --fleet/--fleet-sweep/--device BEFORE any wam_tpu import
+    (importing the package imports jax, after which XLA_FLAGS is inert)."""
+    pre = argparse.ArgumentParser(add_help=False)
+    pre.add_argument("--fleet", type=int, default=1)
+    pre.add_argument("--fleet-sweep", type=str, default="")
+    pre.add_argument("--device", type=str, default="auto")
+    known, _ = pre.parse_known_args(argv)
+    sweep = (
+        [int(s) for s in known.fleet_sweep.split(",") if s.strip()]
+        if known.fleet_sweep
+        else [max(1, known.fleet)]
+    )
+    return sweep, known.device
+
+
+def main():
+    sweep, device = _pre_scan_fleet(sys.argv[1:])
+    cpu_fleet = max(sweep) > 1 and device in ("cpu", "auto")
+    if cpu_fleet:
+        # virtual multi-device CPU platform; must precede any jax import
+        _force_host_devices(max(sweep))
+
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--requests", type=int, default=96,
+                        help="total requests across all clients (×fleet size)")
+    parser.add_argument("--clients", type=int, default=4,
+                        help="closed-loop client threads (×fleet size)")
+    parser.add_argument("--n-samples", type=int, default=4,
+                        help="SmoothGrad samples per attribution")
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--fleet-sweep", type=str, default="",
+                        help="comma list of fleet sizes, e.g. 1,2,4,8")
+    parser.add_argument("--fake-entry", type=float, default=None, metavar="MS",
+                        help="fixed-cost fake entry (ms/batch) instead of the model")
+    parser.add_argument("--toy", action="store_true",
+                        help="tiny smoke workload (one bucket, 16 requests)")
+    parser.add_argument("--emit", type=str, default="",
+                        help="write the sweep/summary JSON here")
+    from wam_tpu.config import ServeConfig, add_config_args, config_from_args
+
+    add_config_args(parser, ServeConfig)
+    args = parser.parse_args()
+    cfg = config_from_args(args, ServeConfig)
+
+    from wam_tpu.config import select_backend
+
+    select_backend("cpu" if cfg.device == "auto" and cpu_fleet else cfg.device)
+    if cpu_fleet:
+        # env var alone is not enough when an accelerator plugin is
+        # installed: the plugin wins platform selection and the forced
+        # host device count never takes effect
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
+    curve = []
+    any_errors = []
+    for n in sweep:
+        summary, errors = run_bench(cfg, args, n)
+        any_errors.extend(errors)
+        point = {
+            "fleet": n,
+            "completed": summary["completed"],
+            "attributions_per_s": summary["attributions_per_s_load"],
+            "latency_p50_ms": summary["latency_p50_ms"],
+            "latency_p99_ms": summary["latency_p99_ms"],
+            "compile_count": summary["compile_count"],
+        }
+        if "per_replica" in summary:
+            point["utilization"] = {
+                str(r["replica_id"]): round(r["utilization"], 4)
+                for r in summary["per_replica"]
+            }
+            point["deaths"] = len(summary["deaths"])
+        curve.append(point)
+        print(json.dumps(point, indent=2))
+
+    if len(curve) > 1:
+        base = curve[0]["attributions_per_s"] or 1.0
+        for p in curve:
+            p["speedup_vs_1"] = round(p["attributions_per_s"] / base, 3)
+        print("scaling:", " ".join(
+            f"{p['fleet']}x={p['speedup_vs_1']:.2f}" for p in curve
+        ))
+    if args.emit:
+        payload = {
+            "bench": "bench_serve_fleet",
+            "device": cfg.device,
+            "fake_entry_ms": args.fake_entry,
+            "max_batch": cfg.max_batch,
+            "oversize": cfg.oversize,
+            "requests_per_fleet_unit": args.requests,
+            "clients_per_fleet_unit": args.clients,
+            "curve": curve,
+        }
+        os.makedirs(os.path.dirname(args.emit) or ".", exist_ok=True)
+        with open(args.emit, "w") as f:
+            json.dump(payload, f, indent=2)
+        print(f"emitted: {args.emit}")
+    if any_errors:
+        print(f"{len(any_errors)} request errors, first: {any_errors[0]}",
+              file=sys.stderr)
         return 1
     return 0
 

@@ -25,12 +25,17 @@ Two-tier AST scan, no imports of the scanned code:
      contract (`wam_tpu.evalsuite.fan`: fetches happen in `run_fan`,
      after the jitted body returns, never inside it).
 
-Scope: wam_tpu/{core,evalsuite,serve,pipeline,wavelets}. The wavelet core
-entered scope with the fused synthesis path: its matrix builders are
-host-side numpy BY DESIGN (lru_cached, static under jit), so the scan's
-traced-function detection — not a directory exclusion — is what keeps
-them legal. Zero findings is the contract — the verify skill runs this;
-exit 1 on any finding.
+Scope: wam_tpu/{core,evalsuite,serve,pipeline,wavelets} plus the fleet's
+mesh plumbing (wam_tpu/parallel/{mesh,multihost}.py — the files the serve
+fleet's oversize pjit path routes through). The rest of wam_tpu/parallel
+stays out: halo_modes.py computes static shape products with
+`int(np.prod(...))` inside shard_map bodies (legal — shapes are concrete
+under trace) that this scan cannot distinguish from real syncs. The
+wavelet core entered scope with the fused synthesis path: its matrix
+builders are host-side numpy BY DESIGN (lru_cached, static under jit), so
+the scan's traced-function detection — not a directory exclusion — is
+what keeps them legal. Zero findings is the contract — the verify skill
+runs this; exit 1 on any finding.
 
 Usage: python scripts/check_host_syncs.py [paths...]
 """
@@ -42,7 +47,8 @@ import os
 import sys
 
 DEFAULT_DIRS = ("wam_tpu/core", "wam_tpu/evalsuite", "wam_tpu/serve",
-                "wam_tpu/pipeline", "wam_tpu/wavelets")
+                "wam_tpu/pipeline", "wam_tpu/wavelets",
+                "wam_tpu/parallel/mesh.py", "wam_tpu/parallel/multihost.py")
 
 # call targets whose function-valued arguments get traced
 TRACING_CALLS = {

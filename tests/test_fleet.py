@@ -1,0 +1,365 @@
+"""Multi-chip fleet serving (`wam_tpu/serve/fleet.py`): load-aware routing,
+shared admission backpressure, oversize data-parallel dispatch exactness,
+replica-death failover, the per-replica compile invariant, and the v2
+fleet ledger schema.
+
+Same discipline as tests/test_serve.py: the operational tests drive worker
+loops with GATED fake entries (threading.Event handshakes, no sleeps) so
+the queue/routing states they assert are deterministic. Runs on the
+virtual 8-device CPU mesh the conftest forces."""
+
+import json
+import threading
+
+import jax
+import numpy as np
+import pytest
+
+from conftest import need_devices
+from wam_tpu.serve import (
+    FleetMetrics,
+    FleetServer,
+    NoBucketError,
+    QueueFullError,
+    ServeMetrics,
+    bucket_key,
+    fleet_aot_key,
+)
+
+
+class _GateEntry:
+    """Fake entry that parks its replica's worker inside the dispatch until
+    released — deterministic in-flight state without sleeps."""
+
+    def __init__(self):
+        self.entered = threading.Event()
+        self.release = threading.Event()
+        self.calls = 0
+
+    def __call__(self, xs, ys):
+        self.calls += 1
+        self.entered.set()
+        assert self.release.wait(timeout=10), "test gate never released"
+        return np.asarray(xs) * 2.0
+
+
+def _gated_fleet(n, **kw):
+    gates = {rid: _GateEntry() for rid in range(n)}
+    fleet = FleetServer(
+        lambda rid, m: gates.get(rid, lambda xs, ys: np.asarray(xs) * 2.0),
+        [(4,)],
+        replicas=n,
+        max_batch=1,
+        max_wait_ms=0.0,
+        warmup=False,
+        oversize="fanout",
+        **kw,
+    )
+    return fleet, gates
+
+
+# -- routing ------------------------------------------------------------------
+
+
+def test_routing_picks_idle_replica():
+    """With replica A parked mid-dispatch (one in-flight batch), the next
+    submit must route to idle replica B: A's projected drain includes the
+    in-flight batch, so its score is strictly higher."""
+    need_devices(2)
+    fleet, gates = _gated_fleet(2)
+    x = np.zeros((4,), np.float32)
+    try:
+        f0 = fleet.submit(x, 0)  # both idle -> tie-break to replica 0
+        assert gates[0].entered.wait(timeout=10)
+        f1 = fleet.submit(x, 0)  # 0 busy -> must land on 1
+        assert gates[1].entered.wait(timeout=10)
+        assert gates[0].calls == 1 and gates[1].calls == 1
+        for g in gates.values():
+            g.release.set()
+        np.testing.assert_array_equal(f0.result(timeout=10), x * 2.0)
+        np.testing.assert_array_equal(f1.result(timeout=10), x * 2.0)
+    finally:
+        for g in gates.values():
+            g.release.set()
+        fleet.close()
+
+
+def test_shared_admission_rejects_only_when_all_full():
+    """The fleet turns work away only when EVERY live replica's bounded
+    queue rejected; the QueueFullError carries a positive retry estimate."""
+    need_devices(2)
+    fleet, gates = _gated_fleet(2, queue_depth=1)
+    x = np.zeros((4,), np.float32)
+    futs = []
+    try:
+        futs.append(fleet.submit(x, 0))  # in flight on 0
+        assert gates[0].entered.wait(timeout=10)
+        futs.append(fleet.submit(x, 0))  # in flight on 1
+        assert gates[1].entered.wait(timeout=10)
+        futs.append(fleet.submit(x, 0))  # queued (depth 1) on one replica
+        futs.append(fleet.submit(x, 0))  # queued on the other
+        with pytest.raises(QueueFullError) as ei:
+            fleet.submit(x, 0)  # every queue full -> fleet-level reject
+        assert ei.value.retry_after_s > 0
+        for g in gates.values():
+            g.release.set()
+        for f in futs:
+            np.testing.assert_array_equal(f.result(timeout=10), x * 2.0)
+    finally:
+        for g in gates.values():
+            g.release.set()
+        fleet.close()
+
+
+def test_fleet_submit_validation():
+    need_devices(2)
+    fleet, gates = _gated_fleet(2)
+    try:
+        with pytest.raises(ValueError, match="label"):
+            fleet.submit(np.zeros((4,), np.float32))
+        with pytest.raises(NoBucketError):
+            fleet.submit(np.zeros((5,), np.float32), 0)  # before any queueing
+    finally:
+        for g in gates.values():
+            g.release.set()
+        fleet.close()
+
+
+# -- oversize data-parallel dispatch ------------------------------------------
+
+
+def test_oversize_pjit_bit_exact():
+    """A 16-row batch on a 4-replica fleet (bucket cap 2) dispatches
+    data-parallel over the fleet mesh and must come back BIT-identical to
+    the same jitted entry run unsharded on the same rows."""
+    need_devices(4)
+
+    def impl(xs, ys):
+        return xs * 2.0 + ys[:, None]
+
+    fleet = FleetServer(
+        lambda rid, m: jax.jit(impl),
+        [(4,)],
+        replicas=4,
+        max_batch=2,
+        warmup=False,
+        oversize="pjit",
+    )
+    rng = np.random.default_rng(0)
+    xs = rng.standard_normal((16, 4)).astype(np.float32)
+    ys = np.arange(16, dtype=np.int32)
+    try:
+        got = fleet.attribute_batch(xs, ys)
+    finally:
+        fleet.close()
+    ref = np.asarray(jax.jit(impl)(xs, ys))
+    np.testing.assert_array_equal(got, ref)  # bit-exact, not allclose
+    assert fleet.metrics.oversize.completed == 16
+    assert fleet.metrics.oversize.batch_rows  # the oversize ledger saw it
+
+
+def test_oversize_partial_chunk_and_fanout_small_batch():
+    """Oversize rows that don't fill the fleet-wide batch are replicate-
+    padded (and sliced off); a batch within one chip's cap takes the plain
+    routed per-item path, not the pjit one."""
+    need_devices(2)
+
+    def impl(xs, ys):
+        return xs * 3.0
+
+    fleet = FleetServer(
+        lambda rid, m: jax.jit(impl),
+        [(4,)],
+        replicas=2,
+        max_batch=2,
+        max_wait_ms=0.0,
+        warmup=False,
+        oversize="pjit",
+    )
+    rng = np.random.default_rng(1)
+    try:
+        # 7 rows, rows_per = 4: one full chunk + a 3-row replicate-padded one
+        xs = rng.standard_normal((7, 4)).astype(np.float32)
+        ys = np.zeros((7,), np.int32)
+        np.testing.assert_array_equal(fleet.attribute_batch(xs, ys), xs * 3.0)
+        assert fleet.metrics.oversize.completed == 7
+        # 2 rows fit one chip: fan-out path, oversize ledger untouched
+        small = rng.standard_normal((2, 4)).astype(np.float32)
+        np.testing.assert_array_equal(
+            fleet.attribute_batch(small, np.zeros((2,), np.int32)), small * 3.0
+        )
+        assert fleet.metrics.oversize.completed == 7
+    finally:
+        fleet.close()
+
+
+# -- replica death ------------------------------------------------------------
+
+
+def test_replica_death_routes_to_survivors():
+    """A replica whose entry raises a non-ServeError is marked dead and its
+    requests (the failed one and everything queued behind it) re-route to
+    the survivors; the death lands in the fleet ledger."""
+    need_devices(2)
+
+    def make_entry(rid, m):
+        if rid == 0:
+            def dying(xs, ys):
+                raise RuntimeError("chip 0 gone")
+
+            return dying
+        return lambda xs, ys: np.asarray(xs) * 2.0
+
+    fleet = FleetServer(
+        make_entry,
+        [(4,)],
+        replicas=2,
+        max_batch=1,
+        max_wait_ms=0.0,
+        warmup=False,
+        oversize="fanout",
+    )
+    x = np.ones((4,), np.float32)
+    try:
+        # both idle -> tie-break routes to replica 0, whose entry dies
+        futs = [fleet.submit(x, 0) for _ in range(4)]
+        for f in futs:
+            np.testing.assert_array_equal(f.result(timeout=10), x * 2.0)
+        assert [r.rid for r in fleet._replicas if not r.alive] == [0]
+        deaths = fleet.metrics.fleet_summary()["deaths"]
+        assert [d["replica_id"] for d in deaths] == [0]
+        # post-death traffic goes straight to the survivor
+        np.testing.assert_array_equal(fleet.attribute(x, 1), x * 2.0)
+        # ... and oversize batches degrade to routed fan-out, still correct
+        xs = np.stack([x] * 3)
+        np.testing.assert_array_equal(
+            fleet.attribute_batch(xs, np.zeros((3,), np.int32)), xs * 2.0
+        )
+    finally:
+        fleet.close()
+
+
+# -- compile invariant --------------------------------------------------------
+
+
+def test_fleet_compiles_once_per_bucket_per_replica():
+    """Each replica owns its own jitted entry: warmup compiles every bucket
+    on every replica exactly once, and the mixed-shape hot path adds zero
+    compiles (fleet_summary.compile_count == buckets × replicas)."""
+    need_devices(2)
+    from wam_tpu.models.toy import toy_conv_model
+    from wam_tpu.wam2d import BaseWAM2D
+
+    toy = toy_conv_model(jax.random.PRNGKey(0), ndim=2)
+    wam = BaseWAM2D(lambda x: toy(x.mean(axis=1)), J=2)
+    shapes = [(1, 8, 8), (1, 16, 16)]
+    fleet = FleetServer(
+        lambda rid, m: wam.serve_entry(on_trace=m.note_compile),
+        shapes,
+        replicas=2,
+        max_batch=2,
+        warmup=True,
+        oversize="fanout",
+    )
+    try:
+        for rep in fleet._replicas:
+            assert rep.metrics.compile_count == len(shapes)
+            assert set(rep.metrics.warmup_s) == {bucket_key(s) for s in shapes}
+        stream = [(1, 8, 8), (1, 16, 16), (1, 6, 6), (1, 12, 12), (1, 8, 8)]
+        for i, shape in enumerate(stream):
+            x = np.asarray(jax.random.normal(jax.random.PRNGKey(i), shape))
+            out = fleet.attribute(x, i % 4)
+            assert out.shape[-1] == out.shape[-2]  # a mosaic came back
+        summary = fleet.metrics.fleet_summary()
+        assert summary["compile_count"] == len(shapes) * 2  # zero hot-path
+        assert summary["completed"] == len(stream)
+    finally:
+        fleet.close()
+
+
+# -- ledger schema ------------------------------------------------------------
+
+
+def test_fleet_ledger_schema(tmp_path):
+    need_devices(2)
+    path = str(tmp_path / "fleet.jsonl")
+
+    def impl(xs, ys):
+        return np.asarray(xs) * 1.0
+
+    fleet = FleetServer(
+        lambda rid, m: (jax.jit(lambda xs, ys: xs * 1.0) if rid == "fleet" else impl),
+        [(4,)],
+        replicas=2,
+        max_batch=2,
+        max_wait_ms=0.0,
+        warmup=True,
+        metrics_path=path,
+        oversize="pjit",
+    )
+    for i in range(6):
+        fleet.attribute(np.zeros((4,), np.float32), i % 4)
+    fleet.attribute_batch(
+        np.zeros((8, 4), np.float32), np.zeros((8,), np.int32)
+    )  # oversize -> the "fleet" ledger
+    fleet.close()  # drains + emits the merged ledger
+
+    rows = [json.loads(line) for line in open(path)]
+    batches = [r for r in rows if r["metric"] == "serve_batch"]
+    summaries = [r for r in rows if r["metric"] == "serve_summary"]
+    fleet_rows = [r for r in rows if r["metric"] == "fleet_summary"]
+    assert len(fleet_rows) == 1
+    assert all("replica_id" in r for r in batches)  # v2: identity on rows
+    assert {r["replica_id"] for r in summaries} >= {0, 1, "fleet"}
+    for s in summaries:
+        assert s["schema_version"] == 2
+        assert isinstance(s["ema_service_s"], dict)
+        # v1 keys preserved verbatim for old JSONL consumers
+        for key in ("completed", "batches", "latency_p50_ms", "attributions_per_s"):
+            assert key in s
+    per_replica = {str(r["replica_id"]) for r in fleet_rows[0]["per_replica"]}
+    assert per_replica == {"0", "1"}
+    assert fleet_rows[0]["oversize_completed"] == 8
+    assert fleet_rows[0]["completed"] == 6 + 8
+    assert all("utilization" in r for r in fleet_rows[0]["per_replica"])
+    warm = [s for s in summaries if s["replica_id"] in (0, 1)]
+    assert all(s["warmup_s"].get("4", 0.0) > 0.0 for s in warm)
+
+
+# -- helpers ------------------------------------------------------------------
+
+
+def test_fleet_aot_key_tagging():
+    assert fleet_aot_key("m|3x224x224", 4) == "m|3x224x224|fleet4"
+    assert fleet_aot_key("m|3x224x224", 1) == "m|3x224x224"  # single-chip: stable
+    assert fleet_aot_key("m|3x224x224", None) == "m|3x224x224"
+    assert fleet_aot_key(None, 8) is None
+
+
+def test_per_bucket_ema_seed_and_update():
+    """Satellite 1: the retry-after / routing EMA is per bucket — an unseen
+    bucket reads the seed, an observed one its own blended history, and the
+    snapshot exports the whole map."""
+    from wam_tpu.serve.metrics import EMA_SEED_S
+
+    m = ServeMetrics()
+    assert m.ema_service_s((4,)) == EMA_SEED_S
+    kw = dict(n_real=1, max_batch=1, pad_waste=0.0, queue_depth=0,
+              queue_waits_s=[0.0], latencies_s=[0.2])
+    m.note_batch(bucket_shape=(4,), service_s=0.2, **kw)
+    assert m.ema_service_s((4,)) == pytest.approx(0.2)  # first obs seeds
+    m.note_batch(bucket_shape=(4,), service_s=0.4, **kw)
+    assert m.ema_service_s((4,)) == pytest.approx(0.8 * 0.2 + 0.2 * 0.4)
+    assert m.ema_service_s((8,)) == EMA_SEED_S  # other buckets untouched
+    snap = m.snapshot()
+    assert snap["ema_service_s"] == {"4": pytest.approx(0.24)}
+    assert snap["replica_id"] is None and snap["schema_version"] == 2
+
+
+def test_fleet_metrics_replica_get_or_create():
+    fm = FleetMetrics()
+    a = fm.replica(0)
+    assert fm.replica(0) is a and a.replica_id == 0
+    fm.note_replica_death(0, "test")
+    s = fm.fleet_summary()
+    assert s["replicas"] == 1 and len(s["deaths"]) == 1

@@ -295,6 +295,53 @@ def test_healthy_accelerator_reraises(monkeypatch):
         server.close()
 
 
+def test_projected_drain_is_per_bucket():
+    """The retry-after / routing signal sums (queued + in-flight batches) ×
+    EMA per bucket — work in one bucket never inflates another's estimate
+    (the v1 global-EMA bug this round fixed)."""
+    from wam_tpu.serve.metrics import EMA_SEED_S
+
+    entry = _GateEntry()
+    server = AttributionServer(
+        entry, [(4,), (8,)], max_batch=1, max_wait_ms=0.0, queue_depth=8,
+        warmup=False,
+    )
+    x4 = np.zeros((4,), np.float32)
+    try:
+        assert server.projected_drain_s() == 0.0  # idle
+        first = server.submit(x4, 0)
+        assert entry.entered.wait(timeout=10)
+        # one in-flight batch, bucket (4,) only: exactly its seeded EMA —
+        # the untouched (8,) bucket contributes nothing
+        assert server.projected_drain_s() == pytest.approx(EMA_SEED_S)
+        server.submit(x4, 0)  # one queued batch more of the same bucket
+        assert server.projected_drain_s() == pytest.approx(2 * EMA_SEED_S)
+        entry.release.set()
+        first.result(timeout=10)
+    finally:
+        entry.release.set()
+        server.close()
+
+
+def test_warmup_ledger_and_per_bucket_ema():
+    """Parallel warmup records per-bucket warmup seconds; the snapshot's
+    EMA map carries exactly the buckets that served traffic."""
+    metrics = ServeMetrics()
+    server = AttributionServer(
+        lambda xs, ys: np.asarray(xs), [(4,), (8,)], max_batch=2,
+        warmup=True, metrics=metrics,
+    )
+    try:
+        server.attribute(np.zeros((4,), np.float32), 0)
+    finally:
+        server.close()
+    snap = metrics.snapshot()
+    assert set(snap["warmup_s"]) == {"4", "8"}
+    assert all(v > 0.0 for v in snap["warmup_s"].values())
+    assert set(snap["ema_service_s"]) == {"4"}  # warmup doesn't fake an EMA
+    assert snap["schema_version"] == 2 and snap["replica_id"] is None
+
+
 # -- metrics ledger -----------------------------------------------------------
 
 

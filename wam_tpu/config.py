@@ -173,13 +173,18 @@ class WAM3DConfig:
 
 @dataclass
 class ServeConfig:
-    """Knobs of `wam_tpu.serve.AttributionServer` (and the
-    scripts/bench_serve.py load generator). ``buckets`` is the admitted
-    item-shape set as a CLI-friendly string: comma-separated, dims joined
-    by 'x' — e.g. "3x224x224,3x256x256" for images, "32768,65536" for
-    waveforms; "" lets the caller pick programmatically."""
+    """Knobs of `wam_tpu.serve.AttributionServer` / `serve.FleetServer`
+    (and the scripts/bench_serve.py load generator). ``buckets`` is the
+    admitted item-shape set as a CLI-friendly string: comma-separated, dims
+    joined by 'x' — e.g. "3x224x224,3x256x256" for images, "32768,65536"
+    for waveforms; "" lets the caller pick programmatically.
+    ``fleet`` > 1 serves with one replica worker per chip; ``oversize``
+    picks what happens to a whole batch larger than one chip's bucket cap
+    ("pjit" = data-parallel over the fleet mesh, "fanout" = per-item
+    routing). ``max_batch`` accepts "auto" — the tuned per-bucket cap from
+    the schedule cache (`tune.resolve_bucket_cap`), falling back to 8."""
 
-    max_batch: int = 8
+    max_batch: int | str = 8  # rows per dispatched batch, or "auto" (tuned)
     max_wait_ms: float = 5.0
     queue_depth: int = 64
     deadline_ms: float = 0.0  # 0 = no per-request deadline
@@ -189,6 +194,8 @@ class ServeConfig:
     compilation_cache: bool = True
     metrics_path: str = ""
     device: str = "auto"
+    fleet: int = 1  # replica workers (one per chip); 1 = single-chip server
+    oversize: str = "pjit"  # "pjit" | "fanout" (serve/fleet oversize path)
 
     def bucket_shapes(self) -> list[tuple[int, ...]]:
         if not self.buckets:

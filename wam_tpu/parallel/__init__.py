@@ -20,7 +20,7 @@ from wam_tpu.parallel.halo_modes import (
     sharded_waverec3_mode,
     sharded_waverec_mode,
 )
-from wam_tpu.parallel.mesh import P, data_sample_mesh, make_mesh
+from wam_tpu.parallel.mesh import P, data_sample_mesh, make_mesh, replica_mesh
 from wam_tpu.parallel.seq_estimators import SeqShardedWam, seq_sharded_wam
 from wam_tpu.parallel.multihost import hybrid_mesh, init_distributed, process_local_batch
 from wam_tpu.parallel.sharded import sharded_integrated_path, sharded_smoothgrad, sharded_smoothgrad_spmd
@@ -28,6 +28,7 @@ from wam_tpu.parallel.sharded import sharded_integrated_path, sharded_smoothgrad
 __all__ = [
     "make_mesh",
     "data_sample_mesh",
+    "replica_mesh",
     "P",
     "sharded_smoothgrad",
     "sharded_smoothgrad_spmd",

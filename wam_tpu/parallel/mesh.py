@@ -16,7 +16,7 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
-__all__ = ["make_mesh", "data_sample_mesh", "P", "NamedSharding", "Mesh"]
+__all__ = ["make_mesh", "data_sample_mesh", "replica_mesh", "P", "NamedSharding", "Mesh"]
 
 P = PartitionSpec
 
@@ -40,6 +40,19 @@ def make_mesh(axis_sizes: dict[str, int], devices=None) -> Mesh:
         raise ValueError(f"Mesh {sizes} does not match {len(devices)} devices")
     arr = np.asarray(devices).reshape(tuple(sizes.values()))
     return Mesh(arr, tuple(sizes))
+
+
+def replica_mesh(n_replicas: int, devices=None) -> Mesh:
+    """1D ``('data',)`` mesh over the first ``n_replicas`` chips — the serve
+    fleet's oversize-dispatch mesh (`wam_tpu.serve.fleet`): batch rows shard
+    across replicas while model/coefficient axes stay whole per chip, so a
+    pjit'd ``serve_entry`` over this mesh is plain data parallelism with no
+    intra-op collectives."""
+    devices = jax.devices() if devices is None else list(devices)
+    n = int(n_replicas)
+    if not 1 <= n <= len(devices):
+        raise ValueError(f"replica_mesh({n}) with {len(devices)} devices")
+    return make_mesh({"data": n}, devices[:n])
 
 
 def data_sample_mesh(n_devices: int | None = None, devices=None) -> Mesh:

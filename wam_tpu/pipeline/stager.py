@@ -31,8 +31,11 @@ _DONE = object()
 
 def put_committed(tree, sharding=None):
     """`jax.device_put` a batch pytree, committed to ``sharding`` when one
-    is given (a `Sharding` or a matching pytree of them). Dispatch is
-    asynchronous — the returned arrays are futures over the transfer."""
+    is given (a `Sharding` or `Device`, or a matching pytree of them — a
+    single Device broadcasts over the tree, which is how each fleet replica
+    pins its staged batches and warmup zeros to its own chip,
+    `serve/runtime.py` "Device pinning"). Dispatch is asynchronous — the
+    returned arrays are futures over the transfer."""
     if sharding is None:
         return jax.device_put(tree)
     return jax.device_put(tree, sharding)

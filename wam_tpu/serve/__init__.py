@@ -4,17 +4,24 @@ The production layer the ROADMAP north star asks for: a stream of
 independent attribution requests (mixed shapes, mixed arrival times) in, a
 small fixed set of warm compiled graphs and a single device-owning worker
 loop out. See `serve.runtime` for the operational semantics, `serve.buckets`
-for the shape-admission policy, `serve.metrics` for the ledger schema, and
+for the shape-admission policy, `serve.metrics` for the ledger schema
+(v2: per-bucket EMA service time, replica identity, fleet summaries), and
 `scripts/bench_serve.py` for the closed-loop load generator.
+
+Multi-chip: `serve.fleet.FleetServer` runs one `AttributionServer` replica
+per chip behind shared admission + load-aware bucket routing, and
+dispatches oversize batches data-parallel over the fleet mesh
+(`parallel.replica_mesh`). `scripts/bench_serve.py --fleet N` drives it.
 
 Engines plug in via their ``serve_entry()`` methods (wam1d/wam2d/wam3d) —
 thread-safe batched callables jitted with donated input buffers on TPU
 (`serve.entry.jit_entry`).
 """
 
-from wam_tpu.serve.buckets import Bucket, BucketTable, NoBucketError, pad_item
-from wam_tpu.serve.entry import jit_entry
-from wam_tpu.serve.metrics import ServeMetrics, percentile_ms
+from wam_tpu.serve.buckets import Bucket, BucketTable, NoBucketError, bucket_key, pad_item
+from wam_tpu.serve.entry import fleet_aot_key, jit_entry
+from wam_tpu.serve.fleet import OVERSIZE_ENTRY_ID, FleetServer, NoLiveReplicaError
+from wam_tpu.serve.metrics import SCHEMA_VERSION, FleetMetrics, ServeMetrics, percentile_ms
 from wam_tpu.serve.runtime import (
     AttributionServer,
     DeadlineExceededError,
@@ -25,15 +32,22 @@ from wam_tpu.serve.runtime import (
 
 __all__ = [
     "AttributionServer",
+    "FleetServer",
     "Bucket",
     "BucketTable",
     "NoBucketError",
+    "NoLiveReplicaError",
     "ServeError",
     "QueueFullError",
     "DeadlineExceededError",
     "ServerClosedError",
     "ServeMetrics",
+    "FleetMetrics",
+    "SCHEMA_VERSION",
+    "OVERSIZE_ENTRY_ID",
     "percentile_ms",
     "jit_entry",
+    "fleet_aot_key",
+    "bucket_key",
     "pad_item",
 ]

@@ -33,7 +33,14 @@ from dataclasses import dataclass
 
 import numpy as np
 
-__all__ = ["Bucket", "BucketTable", "NoBucketError", "pad_item"]
+__all__ = ["Bucket", "BucketTable", "NoBucketError", "bucket_key", "pad_item"]
+
+
+def bucket_key(shape) -> str:
+    """Stable string form of a bucket shape — "1x224x224" — the same
+    grammar `config.ServeConfig.buckets` parses. Used as the JSON-safe key
+    of the per-bucket ledger maps (`serve.metrics` EMA / warmup seconds)."""
+    return "x".join(str(int(s)) for s in shape)
 
 
 class NoBucketError(ValueError):
@@ -55,6 +62,10 @@ class Bucket:
     def of(cls, shape) -> "Bucket":
         shape = tuple(int(s) for s in shape)
         return cls(int(np.prod(shape)) if shape else 1, shape)
+
+    @property
+    def key(self) -> str:
+        return bucket_key(self.shape)
 
     def fits(self, item_shape: tuple[int, ...]) -> bool:
         return len(item_shape) == len(self.shape) and all(

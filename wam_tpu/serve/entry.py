@@ -30,7 +30,18 @@ import jax
 
 from wam_tpu.pipeline.donation import resolve_donate
 
-__all__ = ["jit_entry"]
+__all__ = ["jit_entry", "fleet_aot_key"]
+
+
+def fleet_aot_key(aot_key: str | None, n_replicas: int | None) -> str | None:
+    """Replica-count tag for fleet AOT keys. The fleet's oversize entry is
+    dispatched data-parallel over an N-chip mesh, and an exported executable
+    bakes that mesh size in — so an export built for a 4-chip fleet must be
+    a cache MISS on an 8-chip one. Single-chip keys (``n_replicas`` in
+    {None, 1}) pass through unchanged, keeping existing AOT caches warm."""
+    if aot_key is None or n_replicas in (None, 1):
+        return aot_key
+    return f"{aot_key}|fleet{int(n_replicas)}"
 
 
 def jit_entry(

@@ -1,0 +1,454 @@
+"""Multi-chip attribution fleet (ROADMAP item 1 — the scale-out layer).
+
+`AttributionServer` deliberately owns exactly one device: one worker
+thread, one chip, one bounded queue. `FleetServer` goes wider without
+touching that invariant — it spins up one `AttributionServer` REPLICA per
+chip (each pinned to its device via the runtime's ``device=`` commit and
+carrying its own `ServeMetrics` ledger), and puts a shared admission +
+routing layer in front:
+
+- **Load-aware routing**: every admitted item is routed to the live
+  replica with the lowest projected drain time —
+  ``server.projected_drain_s()`` (per-bucket (queued + in-flight batches) ×
+  per-bucket EMA service time) plus the item's own bucket EMA on that
+  replica, so a replica that is merely *bad at this bucket* loses to an
+  idle one even when both have empty queues. Ties resolve to the lowest
+  replica id (deterministic for tests).
+- **Shared admission**: the fleet rejects (`QueueFullError`) only when
+  EVERY live replica's bounded queue rejected, carrying the smallest
+  ``retry_after_s`` any replica offered. One hot replica never turns away
+  work the rest of the fleet could absorb.
+- **Oversize dispatch**: a whole batch larger than one chip's bucket cap
+  (``max_batch``) would historically be the caller's problem; here
+  `attribute_batch` dispatches it DATA-PARALLEL over the fleet mesh
+  (`parallel.replica_mesh`) instead — rows are bucket-padded, replicate-
+  padded up to the fleet-wide batch shape (``n_replicas × max_batch``,
+  so the oversize graph compiles once per bucket), committed with a
+  ``('data',)``-sharded `NamedSharding`, and pushed through a dedicated
+  pjit'd entry built by the same ``entry_factory`` (id
+  ``OVERSIZE_ENTRY_ID``). Per-row computations shard row-wise, so the
+  oversize result is bit-identical to the single-chip entry on the same
+  padded batch (tests/test_fleet.py pins this). AOT keys for this entry
+  must be replica-count tagged (`serve.entry.fleet_aot_key`).
+- **Replica death**: a request whose entry raised (anything that is not a
+  per-request `ServeError`) marks its replica dead fleet-wide and is
+  re-routed to the survivors; items queued behind the failure drain with
+  the same per-request re-route as their batches fail. A request that
+  fails on every live replica propagates the last error
+  (`NoLiveReplicaError` when none is left). Note the documented trade: a
+  deterministic per-request bug (poison pill) is indistinguishable from a
+  chip loss at this layer and can take one replica down per retry — the
+  single-chip server's probe-before-degrade semantics still apply INSIDE
+  each replica when it has a ``fallback_factory``; the fleet layer only
+  reroutes. While any replica is dead, oversize batches fall back to
+  routed per-item submits (the fleet mesh spans every chip, dead or not).
+
+``entry_factory(replica_id, metrics) -> entry`` builds one serving entry
+per replica (0..N-1) plus one for the oversize path
+(``OVERSIZE_ENTRY_ID``). Each replica needs its OWN jitted entry object so
+its ``on_trace`` hook counts that replica's compiles — the ledger
+invariant is ``compile_count == n_buckets`` per replica, one more set on
+the oversize entry when it is used. A typical factory::
+
+    entry_factory = lambda rid, m: wam.serve_entry(on_trace=m.note_compile)
+
+Warmup runs CONCURRENTLY across replicas (and, inside each replica,
+across buckets — `AttributionServer.start`), so an N-chip fleet cold-
+starts in ~max(bucket compile) rather than N × Σ(compile).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from concurrent.futures import Future, ThreadPoolExecutor
+from dataclasses import dataclass, field
+
+import jax
+import numpy as np
+
+from wam_tpu.pipeline.stager import put_committed
+from wam_tpu.serve.buckets import Bucket, BucketTable, pad_item
+from wam_tpu.serve.metrics import FleetMetrics, ServeMetrics
+from wam_tpu.serve.runtime import (
+    AttributionServer,
+    DeadlineExceededError,
+    QueueFullError,
+    ServeError,
+    ServerClosedError,
+)
+
+__all__ = ["FleetServer", "NoLiveReplicaError", "OVERSIZE_ENTRY_ID"]
+
+# entry_factory's replica_id for the fleet-wide oversize pjit entry
+OVERSIZE_ENTRY_ID = "fleet"
+
+
+class NoLiveReplicaError(ServeError):
+    """Every replica is dead (or rejected this request after deaths) — the
+    fleet cannot serve it."""
+
+
+@dataclass
+class _Replica:
+    rid: int
+    device: object
+    server: AttributionServer
+    metrics: ServeMetrics
+    alive: bool = True
+
+
+@dataclass
+class _FleetRequest:
+    """One admitted item's routing state: the grown ``tried`` set is what
+    makes re-dispatch after a replica death converge."""
+
+    x: np.ndarray
+    y: int | None
+    bucket: Bucket
+    deadline_at: float | None  # perf_counter timestamp, None = no deadline
+    future: Future
+    tried: set = field(default_factory=set)
+
+
+class FleetServer:
+    """One serve worker per chip behind shared admission + load-aware
+    routing (module docstring). The client surface mirrors
+    `AttributionServer` (`submit`/`attribute`/`close`/context manager) plus
+    `attribute_batch` for whole batches incl. the oversize pjit path.
+
+    Parameters mirror `AttributionServer` where shared; fleet-specific:
+
+    replicas : worker count (one per chip). None = every visible device.
+    devices : explicit device list (default `jax.devices()`); the first
+        ``replicas`` entries become the fleet.
+    oversize : "pjit" dispatches oversize batches data-parallel over the
+        fleet mesh; "fanout" always splits them into routed per-item
+        submits (no fleet-wide graph, no extra compile).
+    queue_depth : per-replica bound — total fleet admission capacity is
+        ``replicas × queue_depth``.
+    metrics : a shared `FleetMetrics` (fresh when None); per-replica
+        `ServeMetrics` are created through it so the fleet summary sees
+        every ledger.
+    """
+
+    def __init__(
+        self,
+        entry_factory,
+        buckets,
+        *,
+        replicas: int | None = None,
+        devices=None,
+        max_batch: int = 8,
+        max_wait_ms: float = 5.0,
+        queue_depth: int = 64,
+        deadline_ms: float = 0.0,
+        labeled: bool = True,
+        warmup: bool = True,
+        compilation_cache: bool = False,
+        metrics: FleetMetrics | None = None,
+        metrics_path: str | None = None,
+        oversize: str = "pjit",
+        dtype=np.float32,
+        pipelined: bool = True,
+        auto_start: bool = True,
+    ):
+        if not callable(entry_factory):
+            raise TypeError("entry_factory must be callable(replica_id, metrics)")
+        if oversize not in ("pjit", "fanout"):
+            raise ValueError(f"oversize must be 'pjit' or 'fanout', got {oversize!r}")
+        devices = list(jax.devices()) if devices is None else list(devices)
+        n = len(devices) if replicas is None else int(replicas)
+        if not 1 <= n <= len(devices):
+            raise ValueError(f"replicas={n} with {len(devices)} visible devices")
+        self.devices = devices[:n]
+        self.n_replicas = n
+        self.table = buckets if isinstance(buckets, BucketTable) else BucketTable(buckets)
+        self.max_batch = max_batch
+        self.default_deadline_s = deadline_ms / 1e3 if deadline_ms else None
+        self.labeled = labeled
+        self.metrics = metrics if metrics is not None else FleetMetrics()
+        self.metrics_path = metrics_path
+        self.oversize = oversize
+        self.dtype = dtype
+        self._lock = threading.Lock()
+        self._closed = False
+        self._started = False
+
+        self._replicas: list[_Replica] = []
+        for rid, dev in enumerate(self.devices):
+            m = self.metrics.replica(rid)
+            server = AttributionServer(
+                entry_factory(rid, m),
+                self.table,
+                max_batch=max_batch,
+                max_wait_ms=max_wait_ms,
+                queue_depth=queue_depth,
+                deadline_ms=0.0,  # the fleet applies its default at admission
+                labeled=labeled,
+                warmup=warmup,
+                compilation_cache=compilation_cache,
+                metrics=m,
+                metrics_path=None,  # the fleet emits one merged ledger
+                dtype=dtype,
+                pipelined=pipelined,
+                device=dev,
+                replica_id=rid,
+                auto_start=False,
+            )
+            self._replicas.append(_Replica(rid, dev, server, m))
+
+        self._os_entry = None
+        self._mesh = None
+        self._os_lock = threading.Lock()
+        if oversize == "pjit" and n > 1:
+            from wam_tpu.parallel.mesh import replica_mesh
+
+            self._mesh = replica_mesh(n, self.devices)
+            self._os_entry = entry_factory(OVERSIZE_ENTRY_ID, self.metrics.oversize)
+        if auto_start:
+            self.start()
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self) -> "FleetServer":
+        """Start (and warm) every replica concurrently. Idempotent."""
+        if self._started:
+            return self
+        live = [r for r in self._replicas if r.alive]
+        if len(live) == 1:
+            live[0].server.start()
+        else:
+            with ThreadPoolExecutor(
+                max_workers=len(live), thread_name_prefix="wam-fleet-start"
+            ) as pool:
+                list(pool.map(lambda r: r.server.start(), live))
+        self._started = True
+        return self
+
+    def close(self, emit_metrics: bool = True) -> None:
+        """Stop intake, drain every replica, and (when ``metrics_path`` is
+        set) flush the merged fleet ledger."""
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+        for r in self._replicas:
+            r.server.close(emit_metrics=False)
+        if emit_metrics and self.metrics_path:
+            from wam_tpu.results import JsonlWriter
+
+            self.metrics.emit(
+                JsonlWriter(self.metrics_path),
+                config=self.describe(),
+                replica_configs={r.rid: r.server.describe() for r in self._replicas},
+            )
+        self._started = False
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def describe(self) -> dict:
+        return {
+            "replicas": self.n_replicas,
+            "devices": [str(d) for d in self.devices],
+            "dead": [r.rid for r in self._replicas if not r.alive],
+            "buckets": [list(b.shape) for b in self.table],
+            "max_batch": self.max_batch,
+            "labeled": self.labeled,
+            "oversize": self.oversize,
+        }
+
+    # -- client side --------------------------------------------------------
+
+    def submit(self, x, y=None, deadline_ms: float | None = None) -> Future:
+        """Admit one item and route it to the least-loaded live replica.
+        Returns a fleet-level future — it survives a replica death by
+        re-routing to survivors. Raises `QueueFullError` only when every
+        live replica rejected."""
+        if self.labeled and y is None:
+            raise ValueError("labeled fleet: submit(x, y) needs a class label")
+        if not self.labeled and y is not None:
+            raise ValueError("unlabeled fleet: submit() must not carry a label")
+        x = np.asarray(x, self.dtype)
+        bucket = self.table.select(x.shape)  # NoBucketError before any queueing
+        now = time.perf_counter()
+        if deadline_ms is None:
+            deadline_at = (now + self.default_deadline_s) if self.default_deadline_s else None
+        else:
+            deadline_at = now + deadline_ms / 1e3
+        req = _FleetRequest(x, y, bucket, deadline_at, Future())
+        self._route(req, raise_errors=True)
+        return req.future
+
+    def attribute(self, x, y=None, deadline_ms: float | None = None):
+        """Blocking convenience wrapper: submit + wait."""
+        return self.submit(x, y, deadline_ms=deadline_ms).result()
+
+    def attribute_batch(self, xs, ys=None, deadline_ms: float | None = None):
+        """Attribute a whole batch. ``len(xs) <= max_batch`` fans out as
+        routed per-item submits (the workers coalesce them back into full
+        device batches); anything larger takes the oversize data-parallel
+        path over the fleet mesh (module docstring) instead of being the
+        caller's chunking problem. Blocking; returns the stacked result."""
+        xs = np.asarray(xs, self.dtype)
+        if xs.ndim < 2:
+            raise ValueError("attribute_batch needs a leading batch axis")
+        if self.labeled:
+            ys = np.asarray(ys, np.int32).reshape(-1)
+            if len(ys) != len(xs):
+                raise ValueError(f"{len(xs)} items but {len(ys)} labels")
+        elif ys is not None:
+            raise ValueError("unlabeled fleet: attribute_batch() must not carry labels")
+        bucket = self.table.select(xs.shape[1:])
+        with self._lock:
+            fleet_whole = self._os_entry is not None and all(
+                r.alive for r in self._replicas
+            )
+        if len(xs) <= self.max_batch or not fleet_whole:
+            futs = [
+                self.submit(x, int(ys[i]) if self.labeled else None, deadline_ms)
+                for i, x in enumerate(xs)
+            ]
+            rows = [f.result() for f in futs]
+            return jax.tree_util.tree_map(lambda *r: np.stack(r), *rows)
+        return self._dispatch_oversize(xs, ys, bucket)
+
+    # -- routing ------------------------------------------------------------
+
+    def _score(self, replica: _Replica, bucket: Bucket) -> float:
+        """Projected completion estimate for a new item on this replica:
+        its whole-queue drain plus one batch of the item's own bucket at
+        the replica's OWN per-bucket EMA (an idle-but-slow replica loses
+        to an idle-and-fast one)."""
+        return replica.server.projected_drain_s() + replica.metrics.ema_service_s(
+            bucket.shape
+        )
+
+    def _route(self, req: _FleetRequest, raise_errors: bool) -> None:
+        """Submit ``req`` to the best untried live replica; on total
+        rejection raise/fail with the backpressure (or liveness) error.
+        ``raise_errors`` distinguishes the synchronous admission path
+        (client expects `QueueFullError` from `submit`) from async
+        re-dispatch inside a future callback (errors land on the fleet
+        future)."""
+
+        def _fail(exc: Exception) -> None:
+            if raise_errors:
+                raise exc
+            req.future.set_exception(exc)
+
+        with self._lock:
+            if self._closed or not self._started:
+                return _fail(ServerClosedError("fleet is not accepting requests"))
+            cands = [r for r in self._replicas if r.alive and r.rid not in req.tried]
+        if not cands:
+            return _fail(NoLiveReplicaError("no live replica left for this request"))
+        if req.deadline_at is not None:
+            remaining_ms = (req.deadline_at - time.perf_counter()) * 1e3
+            if remaining_ms <= 0.0:
+                return _fail(DeadlineExceededError("deadline lapsed during re-route"))
+        else:
+            remaining_ms = None
+        cands.sort(key=lambda r: self._score(r, req.bucket))  # stable: rid ties
+        retry_after = None
+        for r in cands:
+            try:
+                inner = r.server.submit(req.x, req.y, deadline_ms=remaining_ms)
+            except QueueFullError as e:
+                retry_after = (
+                    e.retry_after_s
+                    if retry_after is None
+                    else min(retry_after, e.retry_after_s)
+                )
+                continue
+            except ServerClosedError:
+                continue
+            inner.add_done_callback(lambda f, r=r: self._harvest(f, r, req))
+            return
+        if retry_after is not None:
+            return _fail(QueueFullError(retry_after))
+        return _fail(NoLiveReplicaError("every live replica refused this request"))
+
+    def _harvest(self, inner: Future, replica: _Replica, req: _FleetRequest) -> None:
+        """Future callback (runs on the replica's worker thread): forward
+        success and per-request errors; treat anything else as a chip loss
+        — mark the replica dead and re-route to survivors."""
+        exc = inner.exception()
+        if exc is None:
+            req.future.set_result(inner.result())
+            return
+        if isinstance(exc, ServeError):
+            # deadline / backpressure / closed: per-request semantics, not
+            # a device loss — the client decides what to do
+            req.future.set_exception(exc)
+            return
+        with self._lock:
+            was_alive = replica.alive
+            replica.alive = False
+        if was_alive:
+            self.metrics.note_replica_death(replica.rid, repr(exc))
+        req.tried.add(replica.rid)
+        try:
+            self._route(req, raise_errors=False)
+        except Exception as e:  # defensive: a callback must never raise
+            req.future.set_exception(e)
+
+    # -- oversize data-parallel path ----------------------------------------
+
+    def _dispatch_oversize(self, xs: np.ndarray, ys, bucket: Bucket):
+        """Data-parallel dispatch over the fleet mesh: chunk to the fleet-
+        wide batch shape (``n_replicas × max_batch`` rows — ONE compiled
+        oversize graph per bucket), shard rows over the ``'data'`` axis,
+        and run the pjit'd oversize entry. Serialized (`_os_lock`): each
+        dispatch owns every chip, so overlapping two would just interleave
+        on the same hardware."""
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        rows_per = self.n_replicas * self.max_batch
+        xspec = NamedSharding(self._mesh, PartitionSpec("data", *([None] * len(bucket.shape))))
+        yspec = NamedSharding(self._mesh, PartitionSpec("data"))
+        metrics = self.metrics.oversize
+        metrics.note_submit(len(xs))
+        outs = []
+        with self._os_lock:
+            for lo in range(0, len(xs), rows_per):
+                chunk = xs[lo : lo + rows_per]
+                k = len(chunk)
+                t0 = time.perf_counter()
+                with metrics.stages.stage("assemble"):
+                    padded = np.stack([pad_item(r, bucket) for r in chunk])
+                    if k < rows_per:
+                        # replicate-pad rows, same exactness argument as the
+                        # single-chip batch pad (serve.buckets)
+                        reps = np.repeat(padded[:1], rows_per - k, axis=0)
+                        padded = np.concatenate([padded, reps])
+                    if self.labeled:
+                        yc = ys[lo : lo + rows_per]
+                        if k < rows_per:
+                            yc = np.concatenate([yc, np.repeat(yc[:1], rows_per - k)])
+                        sx, sy = put_committed((padded, yc), (xspec, yspec))
+                    else:
+                        sx, sy = put_committed(padded, xspec), None
+                with metrics.stages.stage("dispatch"):
+                    out = self._os_entry(sx, sy)
+                with metrics.stages.stage("harvest"):
+                    out = jax.device_get(out)
+                service_s = time.perf_counter() - t0
+                metrics.note_batch(
+                    bucket_shape=bucket.shape,
+                    n_real=k,
+                    max_batch=rows_per,
+                    pad_waste=float(np.mean([bucket.pad_waste(r.shape) for r in chunk])),
+                    queue_depth=0,
+                    service_s=service_s,
+                    queue_waits_s=[0.0] * k,
+                    latencies_s=[service_s] * k,
+                )
+                outs.append(
+                    jax.tree_util.tree_map(lambda a: np.asarray(a)[:k], out)
+                )
+        return jax.tree_util.tree_map(lambda *p: np.concatenate(p), *outs)

@@ -8,6 +8,27 @@ row per drain window (p50/p99 latency, attributions/sec, reject/expiry
 counts, jit cache misses). Stage wall-clock inside the worker loop reuses
 `profiling.StageTimer` (assemble / dispatch / fetch), so serve ledgers
 decompose the same way bench ledgers do.
+
+Ledger schema versions
+----------------------
+- **v1** (rounds 1-9): one global EMA service time (runtime-private), no
+  replica identity; ``serve_summary`` carried the aggregate counters and
+  percentiles only.
+- **v2** (the fleet round, `SCHEMA_VERSION = 2`): every summary row gains
+  ``schema_version``, ``replica_id`` (None on a single-chip server — the
+  fleet assigns 0..N-1, "fleet" for the oversize pjit entry),
+  ``ema_service_s`` (the per-BUCKET EMA service-time map that feeds both
+  `QueueFullError.retry_after_s` and the fleet's load-aware routing),
+  ``warmup_s`` (per-bucket warmup seconds recorded by the parallel bucket
+  warmup), ``busy_s`` and ``utilization`` (dispatch-to-harvest busy time
+  over the window; pipelined overlap can push utilization slightly above
+  the true device duty cycle — it is a routing/idleness signal, not an
+  xplane measurement). ``serve_batch`` rows additionally carry
+  ``replica_id`` when one is set. All v1 keys are preserved verbatim, so
+  single-chip JSONL consumers keep working unchanged. `FleetMetrics`
+  aggregates N replica ledgers into one ``fleet_summary`` row (aggregate
+  attributions/sec, pooled latency percentiles, per-replica utilization,
+  replica deaths, oversize dispatch counters).
 """
 
 from __future__ import annotations
@@ -19,8 +40,15 @@ import numpy as np
 
 from wam_tpu.profiling import StageTimer
 from wam_tpu.results import JsonlWriter
+from wam_tpu.serve.buckets import bucket_key
 
-__all__ = ["ServeMetrics", "percentile_ms"]
+__all__ = ["ServeMetrics", "FleetMetrics", "percentile_ms", "SCHEMA_VERSION"]
+
+SCHEMA_VERSION = 2
+
+# Per-bucket EMA service-time seed until the first batch of that bucket
+# lands: the retry-after / routing estimate for a never-served bucket.
+EMA_SEED_S = 0.05
 
 
 def percentile_ms(latencies_s, q: float) -> float:
@@ -34,10 +62,12 @@ def percentile_ms(latencies_s, q: float) -> float:
 class ServeMetrics:
     """Accumulator shared by the dispatcher (submit side) and the worker
     loop (drain side); every mutator takes the lock, so client threads and
-    the device-owner thread can hit it concurrently."""
+    the device-owner thread can hit it concurrently. ``replica_id``
+    identifies this accumulator's worker in a fleet (None = single-chip)."""
 
-    def __init__(self):
+    def __init__(self, replica_id=None):
         self._lock = threading.Lock()
+        self.replica_id = replica_id
         self.stages = StageTimer()
         self.compile_count = 0  # jit cache misses (serve_entry on_trace hook)
         self.submitted = 0
@@ -46,9 +76,12 @@ class ServeMetrics:
         self.expired = 0  # deadline passed while queued
         self.failed = 0  # engine raised; no fallback could serve it
         self.fallbacks = 0  # batches served by the degraded CPU entry
+        self.busy_s = 0.0  # summed dispatch->harvest service time
         self.latencies_s: list[float] = []  # submit -> result, per request
         self.queue_waits_s: list[float] = []  # submit -> batch assembly
         self.batch_rows: list[dict] = []  # one dict per dispatched batch
+        self.warmup_s: dict[str, float] = {}  # bucket key -> warmup seconds
+        self._ema_service_s: dict[str, float] = {}  # bucket key -> EMA
         self._t0 = time.perf_counter()
 
     # -- mutators (called from dispatcher / worker threads) -----------------
@@ -59,9 +92,9 @@ class ServeMetrics:
         with self._lock:
             self.compile_count += 1
 
-    def note_submit(self) -> None:
+    def note_submit(self, n: int = 1) -> None:
         with self._lock:
-            self.submitted += 1
+            self.submitted += n
 
     def note_reject(self) -> None:
         with self._lock:
@@ -79,6 +112,22 @@ class ServeMetrics:
         with self._lock:
             self.fallbacks += 1
 
+    def note_warmup(self, bucket_shape: tuple[int, ...], seconds: float) -> None:
+        """One bucket's `start()` warmup (trace + compile + first dispatch),
+        recorded per bucket so the ledger shows cold-start cost bucket by
+        bucket (ROADMAP item 2's first measurement)."""
+        with self._lock:
+            self.warmup_s[bucket_key(bucket_shape)] = float(seconds)
+
+    def ema_service_s(self, bucket_shape=None):
+        """Per-bucket EMA batch service time — the retry-after and fleet
+        routing signal. With a shape: that bucket's EMA (``EMA_SEED_S``
+        until its first batch lands). Without: a copy of the whole map."""
+        with self._lock:
+            if bucket_shape is None:
+                return dict(self._ema_service_s)
+            return self._ema_service_s.get(bucket_key(bucket_shape), EMA_SEED_S)
+
     def note_batch(
         self,
         *,
@@ -91,29 +140,43 @@ class ServeMetrics:
         queue_waits_s: list[float],
         latencies_s: list[float],
     ) -> None:
-        """One dispatched batch: aggregate row + per-request samples."""
+        """One dispatched batch: aggregate row + per-request samples, and
+        the per-bucket service-time EMA update (first observation seeds the
+        EMA directly; later ones blend 0.8/0.2)."""
         with self._lock:
             self.completed += len(latencies_s)
             self.latencies_s.extend(latencies_s)
             self.queue_waits_s.extend(queue_waits_s)
-            self.batch_rows.append(
-                {
-                    "metric": "serve_batch",
-                    "bucket": list(bucket_shape),
-                    "n_real": n_real,
-                    "fill_ratio": n_real / max_batch,
-                    "pad_waste": pad_waste,
-                    "queue_depth": queue_depth,
-                    "service_s": service_s,
-                    "timestamp": time.time(),
-                }
+            self.busy_s += service_s
+            key = bucket_key(bucket_shape)
+            prev = self._ema_service_s.get(key)
+            self._ema_service_s[key] = (
+                service_s if prev is None else 0.8 * prev + 0.2 * service_s
             )
+            row = {
+                "metric": "serve_batch",
+                "bucket": list(bucket_shape),
+                "n_real": n_real,
+                "fill_ratio": n_real / max_batch,
+                "pad_waste": pad_waste,
+                "queue_depth": queue_depth,
+                "service_s": service_s,
+                "timestamp": time.time(),
+            }
+            if self.replica_id is not None:
+                row["replica_id"] = self.replica_id
+            self.batch_rows.append(row)
 
     # -- reporting ----------------------------------------------------------
 
-    def summary(self) -> dict:
-        """Aggregate window stats; keys are the ledger schema documented in
-        DESIGN.md ("Serving runtime")."""
+    def latency_sample(self) -> list[float]:
+        """Copy of the per-request latency sample (fleet pooling)."""
+        with self._lock:
+            return list(self.latencies_s)
+
+    def snapshot(self) -> dict:
+        """Aggregate window stats; keys are the schema-v2 ledger row
+        documented in the module docstring (every v1 key preserved)."""
         with self._lock:
             window_s = time.perf_counter() - self._t0
             fills = [r["fill_ratio"] for r in self.batch_rows]
@@ -121,6 +184,8 @@ class ServeMetrics:
             depths = [r["queue_depth"] for r in self.batch_rows]
             return {
                 "metric": "serve_summary",
+                "schema_version": SCHEMA_VERSION,
+                "replica_id": self.replica_id,
                 "window_s": window_s,
                 "submitted": self.submitted,
                 "completed": self.completed,
@@ -138,8 +203,16 @@ class ServeMetrics:
                 "latency_p99_ms": percentile_ms(self.latencies_s, 99),
                 "queue_wait_p50_ms": percentile_ms(self.queue_waits_s, 50),
                 "attributions_per_s": self.completed / window_s if window_s > 0 else 0.0,
+                "ema_service_s": dict(self._ema_service_s),
+                "warmup_s": dict(self.warmup_s),
+                "busy_s": self.busy_s,
+                "utilization": self.busy_s / window_s if window_s > 0 else 0.0,
                 "stages": self.stages.summary(),
             }
+
+    def summary(self) -> dict:
+        """Back-compat alias for `snapshot()` (the v1 name)."""
+        return self.snapshot()
 
     def emit(self, writer: JsonlWriter, config: dict | None = None) -> dict:
         """Flush batch rows + the summary row to a JSONL ledger; returns the
@@ -149,7 +222,115 @@ class ServeMetrics:
             rows = list(self.batch_rows)
         for row in rows:
             writer.write(row)
-        summary = self.summary()
+        summary = self.snapshot()
+        if config is not None:
+            summary["config"] = config
+        writer.write(summary)
+        return summary
+
+
+class FleetMetrics:
+    """Fleet-wide aggregator (`serve.fleet.FleetServer`): one `ServeMetrics`
+    per replica worker, one for the fleet-wide oversize pjit entry, and the
+    ``fleet_summary`` ledger row — aggregate attributions/sec across the
+    whole fleet, pooled latency percentiles, per-replica utilization, and
+    the replica-death trail."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._replicas: dict = {}  # replica_id -> ServeMetrics
+        self.deaths: list[dict] = []
+        self.oversize = ServeMetrics(replica_id="fleet")
+        self._t0 = time.perf_counter()
+
+    def replica(self, replica_id) -> ServeMetrics:
+        """Get-or-create the per-replica accumulator."""
+        with self._lock:
+            if replica_id not in self._replicas:
+                self._replicas[replica_id] = ServeMetrics(replica_id=replica_id)
+            return self._replicas[replica_id]
+
+    def note_replica_death(self, replica_id, reason: str = "") -> None:
+        with self._lock:
+            self.deaths.append(
+                {"replica_id": replica_id, "reason": reason, "timestamp": time.time()}
+            )
+
+    def fleet_summary(self) -> dict:
+        """The aggregate row: fleet throughput is completed requests (replica
+        + oversize) over the fleet's window; latencies pool every replica's
+        sample so p99 reflects the slowest routing decisions, not the best
+        replica."""
+        with self._lock:
+            replicas = dict(self._replicas)
+            deaths = list(self.deaths)
+            t0 = self._t0
+        window_s = time.perf_counter() - t0
+        per_replica = []
+        latencies: list[float] = []
+        completed = submitted = rejected = expired = failed = compile_count = 0
+        for rid in sorted(replicas, key=str):
+            m = replicas[rid]
+            s = m.snapshot()
+            completed += s["completed"]
+            submitted += s["submitted"]
+            rejected += s["rejected"]
+            expired += s["expired"]
+            failed += s["failed"]
+            compile_count += s["compile_count"]
+            latencies.extend(m.latency_sample())
+            per_replica.append(
+                {
+                    "replica_id": rid,
+                    "completed": s["completed"],
+                    "batches": s["batches"],
+                    "compile_count": s["compile_count"],
+                    "attributions_per_s": s["completed"] / window_s if window_s > 0 else 0.0,
+                    "utilization": s["busy_s"] / window_s if window_s > 0 else 0.0,
+                    "ema_service_s": s["ema_service_s"],
+                }
+            )
+        os_snap = self.oversize.snapshot()
+        completed += os_snap["completed"]
+        submitted += os_snap["submitted"]
+        latencies.extend(self.oversize.latency_sample())
+        return {
+            "metric": "fleet_summary",
+            "schema_version": SCHEMA_VERSION,
+            "replicas": len(per_replica),
+            "deaths": deaths,
+            "window_s": window_s,
+            "submitted": submitted,
+            "completed": completed,
+            "rejected": rejected,
+            "expired": expired,
+            "failed": failed,
+            "compile_count": compile_count,
+            "attributions_per_s": completed / window_s if window_s > 0 else 0.0,
+            "latency_p50_ms": percentile_ms(latencies, 50),
+            "latency_p99_ms": percentile_ms(latencies, 99),
+            "oversize_batches": os_snap["batches"],
+            "oversize_completed": os_snap["completed"],
+            "per_replica": per_replica,
+        }
+
+    def emit(
+        self,
+        writer: JsonlWriter,
+        config: dict | None = None,
+        replica_configs: dict | None = None,
+    ) -> dict:
+        """Flush every replica ledger (batch rows + per-replica summary),
+        the oversize ledger when it dispatched anything, then the
+        ``fleet_summary`` row; returns the fleet summary."""
+        with self._lock:
+            replicas = dict(self._replicas)
+        for rid in sorted(replicas, key=str):
+            cfg = (replica_configs or {}).get(rid)
+            replicas[rid].emit(writer, config=cfg)
+        if self.oversize.batch_rows:
+            self.oversize.emit(writer, config={"oversize": True})
+        summary = self.fleet_summary()
         if config is not None:
             summary["config"] = config
         writer.write(summary)

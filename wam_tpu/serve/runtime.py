@@ -12,8 +12,13 @@ fans results back out per request.
 Operational semantics (DESIGN.md "Serving runtime"):
 - **Backpressure**: the queue is bounded by ``queue_depth`` items across
   all buckets; `submit` on a full queue raises `QueueFullError` carrying a
-  ``retry_after_s`` estimate (EMA batch service time × queued batches) —
-  reject-with-retry-after, never unbounded buffering.
+  ``retry_after_s`` estimate — the projected drain time summed PER BUCKET
+  ((queued + in-flight batches) × that bucket's EMA service time, from
+  `ServeMetrics.ema_service_s`), so a backed-up 224² bucket does not
+  inflate the retry estimate of a cheap waveform bucket. The same
+  projection (`projected_drain_s`) is the fleet's load-aware routing
+  signal (`serve.fleet`) — reject-with-retry-after, never unbounded
+  buffering.
 - **Coalescing**: the worker serves the bucket whose head request is
   oldest, dispatching when the bucket has ``max_batch`` items or its head
   has waited ``max_wait_ms`` — latency-bounded batch fill.
@@ -33,6 +38,10 @@ Operational semantics (DESIGN.md "Serving runtime"):
   device compute instead of serializing with it. Entry exceptions that
   surface at the deferred `device_get` go through the same degradation
   path as dispatch-time failures (the host batch is kept for replay).
+- **Device pinning** (``device=``): a fleet replica's server commits every
+  staged batch (and its warmup zeros) to its own chip, so N servers in one
+  process drive N chips concurrently instead of all landing on the default
+  device (`serve.fleet.FleetServer` passes one device per replica).
 """
 
 from __future__ import annotations
@@ -141,6 +150,11 @@ class AttributionServer:
     pipelined : keep one batch in flight — stage + dispatch batch *k+1*
         before harvesting batch *k* (module docstring "Pipelining").
         ``False`` restores the synchronous dispatch-then-distribute loop.
+    device : jax Device every staged batch (and warmup) is committed to;
+        None keeps jax's default placement (single-chip behavior). A fleet
+        replica passes its own chip (module docstring "Device pinning").
+    replica_id : this worker's identity in a fleet ledger (None =
+        single-chip); forwarded to a freshly constructed `ServeMetrics`.
     """
 
     def __init__(
@@ -160,6 +174,8 @@ class AttributionServer:
         fallback_factory=None,
         dtype=np.float32,
         pipelined: bool = True,
+        device=None,
+        replica_id=None,
         auto_start: bool = True,
     ):
         if max_batch < 1:
@@ -175,20 +191,26 @@ class AttributionServer:
         self.labeled = labeled
         self.warmup = warmup
         self.compilation_cache = compilation_cache
-        self.metrics = metrics if metrics is not None else ServeMetrics()
+        self.replica_id = replica_id
+        self.metrics = metrics if metrics is not None else ServeMetrics(replica_id=replica_id)
         self.metrics_path = metrics_path
         self._fallback_factory = fallback_factory
         self.dtype = dtype
         self.pipelined = pipelined
+        self._device = device
         self.degraded = False
 
         self._cond = threading.Condition()
         self._queues: dict[Bucket, list[_Request]] = {b: [] for b in self.table}
+        # popped-but-unfinished batches per bucket: the in-flight half of the
+        # projected drain time (queued items alone would read an actively
+        # serving replica as idle)
+        self._active: dict[Bucket, int] = {b: 0 for b in self.table}
         self._pending = 0
         self._closed = False
         self._started = False
         self._worker: threading.Thread | None = None
-        self._ema_batch_s = 0.05  # retry-after seed until the first batch lands
+        self._degrade_lock = threading.Lock()
         if auto_start:
             self.start()
 
@@ -196,7 +218,17 @@ class AttributionServer:
 
     def start(self) -> "AttributionServer":
         """Warm every bucket (one compile each — the only compiles this
-        server will ever do), then launch the worker. Idempotent."""
+        server will ever do), then launch the worker. Idempotent.
+
+        Buckets warm CONCURRENTLY: each warmup is one independent trace +
+        compile, jax tracing is thread-safe, and XLA compiles different
+        graphs in parallel — so N buckets cold-start in ~max(compile)
+        instead of Σ(compile) (the first slice of ROADMAP item 2). Per-
+        bucket warmup seconds land in the ledger (`ServeMetrics.note_warmup`
+        → ``warmup_s``). Caveat: entries that set process-global backend
+        knobs at trace time (`tune.apply_tuned_synth_impl`) resolve them per
+        (workload, shape) — one server's buckets share a workload, so the
+        tuned knobs agree across its concurrent traces."""
         if self._started:
             return self
         if self.compilation_cache:
@@ -213,8 +245,22 @@ class AttributionServer:
             from wam_tpu.tune import load_schedule_cache
 
             load_schedule_cache()
-            for bucket in self.table:
-                self._sync_dispatch(*self._zeros_batch(bucket))
+
+            def _warm(bucket: Bucket) -> None:
+                t0 = time.perf_counter()
+                self._sync_dispatch(*self._stage_zeros(bucket))
+                self.metrics.note_warmup(bucket.shape, time.perf_counter() - t0)
+
+            if len(self.table) == 1:
+                _warm(next(iter(self.table)))
+            else:
+                from concurrent.futures import ThreadPoolExecutor
+
+                with ThreadPoolExecutor(
+                    max_workers=min(len(self.table), 8),
+                    thread_name_prefix="wam-serve-warmup",
+                ) as pool:
+                    list(pool.map(_warm, self.table))  # list(): re-raise failures
         self._worker = threading.Thread(
             target=self._worker_loop, name="wam-serve-worker", daemon=True
         )
@@ -253,6 +299,8 @@ class AttributionServer:
             "labeled": self.labeled,
             "pipelined": self.pipelined,
             "degraded": self.degraded,
+            "replica_id": self.replica_id,
+            "device": str(self._device) if self._device is not None else None,
         }
 
     # -- client side --------------------------------------------------------
@@ -280,8 +328,7 @@ class AttributionServer:
                 raise ServerClosedError("server is not accepting requests")
             if self._pending >= self.queue_depth:
                 self.metrics.note_reject()
-                batches_ahead = -(-self._pending // self.max_batch)
-                raise QueueFullError(retry_after_s=self._ema_batch_s * batches_ahead)
+                raise QueueFullError(retry_after_s=self._drain_locked())
             self._queues[bucket].append(req)
             self._pending += 1
             self._cond.notify_all()
@@ -291,12 +338,42 @@ class AttributionServer:
         """Blocking convenience wrapper: submit + wait."""
         return self.submit(x, y, deadline_ms=deadline_ms).result()
 
+    # -- load signal --------------------------------------------------------
+
+    def _drain_locked(self) -> float:
+        """Projected seconds to drain everything queued + in flight, summed
+        per bucket: (queued batches + active batches) × that bucket's EMA
+        service time (`ServeMetrics.ema_service_s`, seeded until the first
+        batch lands). Caller holds ``_cond``. This is both the
+        `QueueFullError.retry_after_s` estimate and the fleet's routing
+        score."""
+        total = 0.0
+        for b, q in self._queues.items():
+            n_batches = -(-len(q) // self.max_batch) + self._active[b]
+            if n_batches:
+                total += n_batches * self.metrics.ema_service_s(b.shape)
+        return total
+
+    def projected_drain_s(self) -> float:
+        """Thread-safe `_drain_locked` — the load-aware dispatch signal the
+        fleet router reads per submit (`serve.fleet.FleetServer`)."""
+        with self._cond:
+            return self._drain_locked()
+
     # -- worker side --------------------------------------------------------
 
     def _zeros_batch(self, bucket: Bucket):
         x = np.zeros((self.max_batch,) + bucket.shape, self.dtype)
         y = np.zeros((self.max_batch,), np.int32) if self.labeled else None
         return x, y
+
+    def _stage_zeros(self, bucket: Bucket):
+        """Warmup batch, committed to this server's device when pinned so
+        the warmup compile targets the replica's own chip."""
+        xs, ys = self._zeros_batch(bucket)
+        if self._device is None:
+            return xs, ys
+        return put_committed((xs, ys), self._device)
 
     def _call_entry(self, xs, ys):
         if self.degraded:
@@ -309,15 +386,20 @@ class AttributionServer:
         when the accelerator has actually gone away (forced re-probe
         distinguishes a device loss from a plain bug — an in-process
         exception with a healthy accelerator re-raises) and replay the
-        failed batch on it. ``xs``/``ys`` are the kept host buffers."""
-        if self.degraded or self._fallback_factory is None:
+        failed batch on it. ``xs``/``ys`` are the kept host buffers. The
+        degrade transition is serialized so concurrent bucket warmups
+        cannot build the fallback entry twice."""
+        if self._fallback_factory is None:
             raise
-        from wam_tpu import config
+        with self._degrade_lock:
+            if self.degraded:
+                raise  # already on the fallback: this failure is its own
+            from wam_tpu import config
 
-        if config.probe_accelerator(force=True):
-            raise  # accelerator healthy: the failure is not the device
-        self._entry = self._fallback_factory()
-        self.degraded = True
+            if config.probe_accelerator(force=True):
+                raise  # accelerator healthy: the failure is not the device
+            self._entry = self._fallback_factory()
+            self.degraded = True
         self.metrics.note_fallback()
         return jax.device_get(self._entry(xs, ys))
 
@@ -360,6 +442,7 @@ class AttributionServer:
                     take = q[: self.max_batch]
                     del q[: self.max_batch]
                     self._pending -= len(take)
+                    self._active[bucket] += 1  # in flight until _finish_active
                     return bucket, take, self._pending + len(take)
                 if not block:
                     return _NOT_READY
@@ -392,9 +475,11 @@ class AttributionServer:
             if expired:
                 self.metrics.note_expired(len(expired))
             if not live:
+                self._finish_active(bucket)
                 continue
             batch = self._launch_batch(bucket, live, depth)
             if batch is None:  # failed at dispatch; futures already failed
+                self._finish_active(bucket)
                 continue
             if not self.pipelined:
                 self._complete(batch)
@@ -405,9 +490,14 @@ class AttributionServer:
                 self._complete(inflight)
             inflight = batch
 
+    def _finish_active(self, bucket: Bucket) -> None:
+        with self._cond:
+            self._active[bucket] -= 1
+
     def _launch_batch(self, bucket: Bucket, live: list[_Request], depth: int):
         """Assemble the padded host batch, stage it to the device (async
-        upload), and dispatch the entry WITHOUT harvesting the result."""
+        upload, committed to this server's device when pinned), and
+        dispatch the entry WITHOUT harvesting the result."""
         n_real = len(live)
         with self.metrics.stages.stage("assemble"):
             xs = np.stack([pad_item(r.x, bucket) for r in live])
@@ -425,7 +515,7 @@ class AttributionServer:
                     )
             else:
                 ys = None
-            staged = put_committed((xs, ys))
+            staged = put_committed((xs, ys), self._device)
         t0 = time.perf_counter()
         try:
             with self.metrics.stages.stage("dispatch"):
@@ -442,34 +532,37 @@ class AttributionServer:
 
     def _complete(self, batch: _Inflight):
         """Harvest an in-flight batch (block on the device result — where
-        async entry failures surface) and distribute rows to futures."""
+        async entry failures surface) and distribute rows to futures. The
+        per-bucket service-time EMA feeding retry-after / routing updates
+        inside `ServeMetrics.note_batch`."""
         live, n_real = batch.live, len(batch.live)
         try:
-            with self.metrics.stages.stage("harvest"):
-                out = jax.device_get(batch.out)
-        except Exception:
             try:
-                out = self._recover(batch.xs, batch.ys)
-            except Exception as e:
-                for r in live:
-                    r.future.set_exception(e)
-                self.metrics.note_failed(n_real)
-                return
-        service_s = time.perf_counter() - batch.t0
-        # EMA over batch service time feeds the retry-after estimate
-        self._ema_batch_s = 0.8 * self._ema_batch_s + 0.2 * service_s
-        with self.metrics.stages.stage("distribute"):
-            done = time.perf_counter()
-            for i, r in enumerate(live):
-                row = jax.tree_util.tree_map(lambda a: np.asarray(a)[i], out)
-                r.future.set_result(row)
-        self.metrics.note_batch(
-            bucket_shape=batch.bucket.shape,
-            n_real=n_real,
-            max_batch=self.max_batch,
-            pad_waste=float(np.mean([batch.bucket.pad_waste(r.x.shape) for r in live])),
-            queue_depth=batch.depth,
-            service_s=service_s,
-            queue_waits_s=[batch.t0 - r.t_submit for r in live],
-            latencies_s=[done - r.t_submit for r in live],
-        )
+                with self.metrics.stages.stage("harvest"):
+                    out = jax.device_get(batch.out)
+            except Exception:
+                try:
+                    out = self._recover(batch.xs, batch.ys)
+                except Exception as e:
+                    for r in live:
+                        r.future.set_exception(e)
+                    self.metrics.note_failed(n_real)
+                    return
+            service_s = time.perf_counter() - batch.t0
+            with self.metrics.stages.stage("distribute"):
+                done = time.perf_counter()
+                for i, r in enumerate(live):
+                    row = jax.tree_util.tree_map(lambda a: np.asarray(a)[i], out)
+                    r.future.set_result(row)
+            self.metrics.note_batch(
+                bucket_shape=batch.bucket.shape,
+                n_real=n_real,
+                max_batch=self.max_batch,
+                pad_waste=float(np.mean([batch.bucket.pad_waste(r.x.shape) for r in live])),
+                queue_depth=batch.depth,
+                service_s=service_s,
+                queue_waits_s=[batch.t0 - r.t_submit for r in live],
+                latencies_s=[done - r.t_submit for r in live],
+            )
+        finally:
+            self._finish_active(batch.bucket)

@@ -25,6 +25,7 @@ from wam_tpu.tune.cache import (
     load_schedule_cache,
     lookup_schedule,
     record_schedule,
+    resolve_bucket_cap,
     resolve_fan_cap,
     schedule_key,
 )
@@ -43,6 +44,7 @@ __all__ = [
     "load_schedule_cache",
     "lookup_schedule",
     "record_schedule",
+    "resolve_bucket_cap",
     "resolve_fan_cap",
     "schedule_key",
     "fused_relu",

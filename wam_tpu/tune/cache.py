@@ -45,6 +45,7 @@ __all__ = [
     "lookup_schedule",
     "record_schedule",
     "resolve_fan_cap",
+    "resolve_bucket_cap",
     "apply_tuned_synth_impl",
     "invalidate_process_cache",
 ]
@@ -234,4 +235,21 @@ def resolve_fan_cap(batch_size, fan: int, *, workload: str = "eval2d",
     ent = lookup_schedule(workload, shape or (fan,), fan)
     if ent is not None and ent.get("fan_cap"):
         return int(ent["fan_cap"])
+    return default
+
+
+def resolve_bucket_cap(max_batch, shape=None, *, replicas: int = 1,
+                       default: int = 8) -> int:
+    """Serving bucket cap (`ServeConfig.max_batch`): explicit ints pass
+    through; "auto" consults the tuned ``bucket_cap`` for the "serve"
+    workload at this bucket shape — keyed by replica count, since the
+    fleet's oversize dispatch compiles at ``replicas × cap`` rows and the
+    throughput-optimal per-chip cap can shrink as the fleet widens — and
+    falls back to ``default`` (the ServeConfig.max_batch every serve number
+    so far was recorded at)."""
+    if max_batch != "auto":
+        return int(max_batch)
+    ent = lookup_schedule("serve", shape or (), int(replicas))
+    if ent is not None and ent.get("bucket_cap"):
+        return int(ent["bucket_cap"])
     return default
