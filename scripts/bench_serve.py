@@ -82,6 +82,9 @@ def run_bench(cfg, args, n_fleet: int):
     import jax
     import numpy as np
 
+    from wam_tpu import obs
+    from wam_tpu.obs import sentinel as obs_sentinel
+    from wam_tpu.results import JsonlWriter
     from wam_tpu.serve import (
         AttributionServer,
         FleetMetrics,
@@ -90,6 +93,10 @@ def run_bench(cfg, args, n_fleet: int):
         ServeMetrics,
     )
     from wam_tpu.tune import resolve_bucket_cap
+
+    # a sweep shares one process: start each point from zero obs state so
+    # registry totals / spans / compile events are per-point, not cumulative
+    obs.reset()
 
     if args.toy:
         bucket_shapes = [(1, 16, 16)]
@@ -160,7 +167,14 @@ def run_bench(cfg, args, n_fleet: int):
             metrics_path=metrics_path,
             oversize=cfg.oversize,
             pipelined=cfg.pipelined,
+            prom_port=getattr(args, "prom_port", None) or None,
         )
+        if server.prom_server is not None:
+            print(f"/metrics on port {server.prom_server.server_port}")
+
+    # everything the sentinel counts past this line is a post-warmup
+    # (re)trace — the warm serve loop's retrace budget is zero
+    warm_traces = obs_sentinel.trace_count()
 
     budget = threading.Semaphore(n_requests)
     errors = []
@@ -193,19 +207,59 @@ def run_bench(cfg, args, n_fleet: int):
     load_s = time.perf_counter() - t_load0
     server.close()  # drains + emits the ledger
 
+    post_warm_compiles = obs_sentinel.trace_count() - warm_traces
+    events = obs_sentinel.compile_events()
+    if events:
+        writer = JsonlWriter(metrics_path)
+        for ev in events:
+            writer.write({"metric": "compile_event", "schema_version": 2, **ev})
+
     if fleet_metrics is not None:
         fs = fleet_metrics.fleet_summary()
         # served-window throughput: the sweep curve compares load windows,
         # not process lifetimes (warmup/compile time varies per point)
         fs["load_window_s"] = load_s
         fs["attributions_per_s_load"] = fs["completed"] / load_s if load_s > 0 else 0.0
+        fs["post_warm_compiles"] = post_warm_compiles
         return fs, errors
     summary = metrics.snapshot()
     summary["load_window_s"] = load_s
     summary["attributions_per_s_load"] = (
         summary["completed"] / load_s if load_s > 0 else 0.0
     )
+    summary["post_warm_compiles"] = post_warm_compiles
     return summary, errors
+
+
+def _obs_overhead_bench(cfg, args, sweep):
+    """S1 overhead guard: drive the same workload with the obs layer off and
+    on and compare served throughput. The disabled path is the baseline —
+    its cost is one predicate per span/counter call — so the ON-vs-OFF delta
+    bounds the whole layer's tax. Passes unless the enabled run is grossly
+    (>20%) slower: single-machine toy throughput is noisy at the few-percent
+    level, and a hard 2% gate would flake; the printed delta is the honest
+    number for the ledger."""
+    from wam_tpu import obs
+
+    args.toy = True  # the guard is a smoke-scale comparison by design
+    n = sweep[0] if sweep else 1
+    rates = {}
+    for mode in ("off", "on"):
+        obs.configure(enabled=mode == "on")
+        summary, errors = run_bench(cfg, args, n)
+        if errors:
+            print(f"obs-bench ({mode}): {len(errors)} request errors",
+                  file=sys.stderr)
+            return 1
+        rates[mode] = summary["attributions_per_s_load"]
+        print(f"obs={mode}: {rates[mode]:.1f} attributions/s")
+    delta = (rates["off"] - rates["on"]) / rates["off"] if rates["off"] else 0.0
+    print(f"obs overhead: {delta * 100:+.2f}% throughput delta (on vs off)")
+    if delta > 0.20:
+        print("obs overhead exceeds the 20% gross-regression gate",
+              file=sys.stderr)
+        return 1
+    return 0
 
 
 def _pre_scan_fleet(argv):
@@ -247,6 +301,21 @@ def main():
                         help="tiny smoke workload (one bucket, 16 requests)")
     parser.add_argument("--emit", type=str, default="",
                         help="write the sweep/summary JSON here")
+    parser.add_argument("--obs", choices=("on", "off"), default="on",
+                        help="observability layer (spans + registry); "
+                             "the compile sentinel stays live either way")
+    parser.add_argument("--trace", type=str, default="", metavar="PATH",
+                        help="export a Chrome trace-event JSON of the last "
+                             "sweep point (load in Perfetto / about:tracing)")
+    parser.add_argument("--prom-dump", type=str, default="", metavar="PATH",
+                        help="write the Prometheus text exposition of the "
+                             "last sweep point's registry")
+    parser.add_argument("--prom-port", type=int, default=0,
+                        help="serve /metrics over HTTP while fleeted "
+                             "(0 = off; pass 0<port or use an ephemeral one)")
+    parser.add_argument("--obs-bench", action="store_true",
+                        help="overhead guard: run the toy workload with obs "
+                             "off then on and report the throughput delta")
     from wam_tpu.config import ServeConfig, add_config_args, config_from_args
 
     add_config_args(parser, ServeConfig)
@@ -264,6 +333,13 @@ def main():
 
         jax.config.update("jax_platforms", "cpu")
 
+    from wam_tpu import obs
+
+    if args.obs_bench:
+        return _obs_overhead_bench(cfg, args, sweep)
+
+    obs.configure(enabled=args.obs == "on")
+
     curve = []
     any_errors = []
     for n in sweep:
@@ -276,6 +352,7 @@ def main():
             "latency_p50_ms": summary["latency_p50_ms"],
             "latency_p99_ms": summary["latency_p99_ms"],
             "compile_count": summary["compile_count"],
+            "post_warm_compiles": summary["post_warm_compiles"],
         }
         if "per_replica" in summary:
             point["utilization"] = {
@@ -285,6 +362,15 @@ def main():
             point["deaths"] = len(summary["deaths"])
         curve.append(point)
         print(json.dumps(point, indent=2))
+
+    # the per-point obs.reset() means these exports describe the LAST point
+    if args.trace:
+        print(f"trace: {obs.export_chrome_trace(args.trace)}")
+    if args.prom_dump:
+        os.makedirs(os.path.dirname(args.prom_dump) or ".", exist_ok=True)
+        with open(args.prom_dump, "w") as f:
+            f.write(obs.render_prom())
+        print(f"prom: {args.prom_dump}")
 
     if len(curve) > 1:
         base = curve[0]["attributions_per_s"] or 1.0

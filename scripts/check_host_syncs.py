@@ -23,9 +23,14 @@ Two-tier AST scan, no imports of the scanned code:
      fine), and `jax.device_get` / `device_fetch` — a result fetch INSIDE
      a fan step would break the fan engine's one-fetch-per-metric
      contract (`wam_tpu.evalsuite.fan`: fetches happen in `run_fan`,
-     after the jitted body returns, never inside it).
+     after the jitted body returns, never inside it), and wall-clock
+     reads — `time.time()` / `time.perf_counter()` / `time.monotonic()` —
+     which freeze into trace-time constants inside a jitted body: the
+     span looks instrumented but reports the same timestamp forever
+     (obs timing belongs OUTSIDE the traced function, in `obs.tracing`
+     spans around the dispatch).
 
-Scope: wam_tpu/{core,evalsuite,serve,pipeline,wavelets} plus the fleet's
+Scope: wam_tpu/{core,evalsuite,serve,pipeline,wavelets,obs} plus the fleet's
 mesh plumbing (wam_tpu/parallel/{mesh,multihost}.py — the files the serve
 fleet's oversize pjit path routes through). The rest of wam_tpu/parallel
 stays out: halo_modes.py computes static shape products with
@@ -47,8 +52,12 @@ import os
 import sys
 
 DEFAULT_DIRS = ("wam_tpu/core", "wam_tpu/evalsuite", "wam_tpu/serve",
-                "wam_tpu/pipeline", "wam_tpu/wavelets",
+                "wam_tpu/pipeline", "wam_tpu/wavelets", "wam_tpu/obs",
                 "wam_tpu/parallel/mesh.py", "wam_tpu/parallel/multihost.py")
+
+# wall-clock reads that become trace-time constants inside a jitted body
+CLOCK_CALLS = {"time", "perf_counter", "monotonic", "monotonic_ns",
+               "perf_counter_ns", "time_ns"}
 
 # call targets whose function-valued arguments get traced
 TRACING_CALLS = {
@@ -93,7 +102,15 @@ def _collect_traced_names(tree: ast.AST) -> set[str]:
                 if _tail_name(target) in TRACING_CALLS:
                     traced.add(node.name)
         elif isinstance(node, ast.Call):
-            if _tail_name(node.func) in TRACING_CALLS:
+            name = _tail_name(node.func)
+            # "map"/"scan" are tracing calls only off lax — otherwise
+            # ThreadPoolExecutor.map / plain iterables collide
+            if name in ("map", "scan") and not (
+                isinstance(node.func, ast.Attribute)
+                and _tail_name(node.func.value) == "lax"
+            ):
+                continue
+            if name in TRACING_CALLS:
                 for arg in list(node.args) + [kw.value for kw in node.keywords]:
                     traced |= _ref_names(arg)
     return traced
@@ -118,6 +135,11 @@ def _sync_findings(fn: ast.AST, path: str) -> list[str]:
         elif _tail_name(f) in ("device_get", "device_fetch"):
             found.append(f"{loc}: {_tail_name(f)}() in traced function "
                          "(fetches belong in run_fan, after the fan step)")
+        elif (isinstance(f, ast.Attribute) and f.attr in CLOCK_CALLS
+              and isinstance(f.value, ast.Name) and f.value.id == "time"):
+            found.append(f"{loc}: time.{f.attr}() in traced function "
+                         "(freezes to a trace-time constant; time spans "
+                         "outside the jitted body)")
     return found
 
 

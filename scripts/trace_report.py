@@ -1,0 +1,141 @@
+"""Per-phase latency breakdown of a `wam_tpu.obs` Chrome trace.
+
+Consumes the trace-event JSON written by ``bench_serve --trace out.json``
+(or any `obs.export_chrome_trace` call): complete (``ph:"X"``) events whose
+``args`` carry the obs trace identity. Prints one table row per span name —
+count, total/mean/p50/p99 milliseconds, and the share of summed request
+wall time — plus a coverage line: how much of each ``request`` span's
+duration is tiled by spans sharing its ``trace_id`` (queue_wait + service
+should cover ~all of it; a gap means an uninstrumented phase).
+
+    python scripts/trace_report.py results/trace.json
+    python scripts/trace_report.py results/trace.json --min-coverage 0.95
+
+``--min-coverage`` turns the coverage line into a gate (exit 1 below the
+threshold) — the CI teeth for the "spans cover >=95% of request latency"
+acceptance bar.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+ROOT_NAME = "request"
+
+
+def load_events(path: str) -> list[dict]:
+    with open(path) as f:
+        payload = json.load(f)
+    events = payload["traceEvents"] if isinstance(payload, dict) else payload
+    return [e for e in events if e.get("ph") == "X"]
+
+
+def _pct(sorted_vals: list[float], q: float) -> float:
+    if not sorted_vals:
+        return 0.0
+    i = min(len(sorted_vals) - 1, int(round(q * (len(sorted_vals) - 1))))
+    return sorted_vals[i]
+
+
+def _union_s(intervals: list[tuple[float, float]]) -> float:
+    """Total length of the union of [t0, t1) intervals (overlaps counted
+    once — concurrent child spans must not inflate coverage past 100%)."""
+    total = 0.0
+    end = float("-inf")
+    for t0, t1 in sorted(intervals):
+        if t1 <= end:
+            continue
+        total += t1 - max(t0, end)
+        end = t1
+    return total
+
+
+def phase_table(events: list[dict]) -> list[dict]:
+    by_name: dict[str, list[float]] = {}
+    for e in events:
+        by_name.setdefault(e["name"], []).append(e.get("dur", 0.0) / 1e3)
+    request_total = sum(by_name.get(ROOT_NAME, []))
+    rows = []
+    for name, durs in sorted(by_name.items(), key=lambda kv: -sum(kv[1])):
+        durs.sort()
+        total = sum(durs)
+        rows.append({
+            "phase": name,
+            "count": len(durs),
+            "total_ms": total,
+            "mean_ms": total / len(durs),
+            "p50_ms": _pct(durs, 0.50),
+            "p99_ms": _pct(durs, 0.99),
+            "pct_of_request": 100.0 * total / request_total if request_total else 0.0,
+        })
+    return rows
+
+
+def request_coverage(events: list[dict]) -> list[float]:
+    """Per-request covered fraction: the union of same-trace child span
+    intervals clipped to the root ``request`` span, over its duration."""
+    roots = {}
+    children: dict[object, list[tuple[float, float]]] = {}
+    for e in events:
+        tid = e.get("args", {}).get("trace_id")
+        t0, t1 = e.get("ts", 0.0), e.get("ts", 0.0) + e.get("dur", 0.0)
+        if e["name"] == ROOT_NAME:
+            roots[tid] = (t0, t1)
+        elif tid is not None:
+            children.setdefault(tid, []).append((t0, t1))
+    out = []
+    for tid, (r0, r1) in roots.items():
+        if r1 <= r0:
+            continue
+        clipped = [
+            (max(t0, r0), min(t1, r1))
+            for t0, t1 in children.get(tid, [])
+            if min(t1, r1) > max(t0, r0)
+        ]
+        out.append(_union_s(clipped) / (r1 - r0))
+    return out
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("trace", help="Chrome trace JSON from bench_serve --trace")
+    parser.add_argument("--min-coverage", type=float, default=None, metavar="FRAC",
+                        help="exit 1 when mean request span coverage is below "
+                             "this fraction (e.g. 0.95)")
+    args = parser.parse_args()
+
+    events = load_events(args.trace)
+    if not events:
+        print("no complete (ph:X) events in trace", file=sys.stderr)
+        return 1
+
+    rows = phase_table(events)
+    header = f"{'phase':<20} {'count':>6} {'total ms':>10} {'mean ms':>9} " \
+             f"{'p50 ms':>9} {'p99 ms':>9} {'% of req':>9}"
+    print(header)
+    print("-" * len(header))
+    for r in rows:
+        print(f"{r['phase']:<20} {r['count']:>6} {r['total_ms']:>10.2f} "
+              f"{r['mean_ms']:>9.3f} {r['p50_ms']:>9.3f} {r['p99_ms']:>9.3f} "
+              f"{r['pct_of_request']:>8.1f}%")
+
+    cov = request_coverage(events)
+    if cov:
+        mean_cov = sum(cov) / len(cov)
+        print(f"\nrequests: {len(cov)}  span coverage of request latency: "
+              f"mean {mean_cov * 100:.1f}%  min {min(cov) * 100:.1f}%")
+        if args.min_coverage is not None and mean_cov < args.min_coverage:
+            print(f"coverage below --min-coverage={args.min_coverage}",
+                  file=sys.stderr)
+            return 1
+    elif args.min_coverage is not None:
+        print("no request spans in trace; cannot gate coverage", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -4,7 +4,9 @@
   tuned fan_cap AND the fan_chunk images-per-chunk override;
 - the single-fetch contract: exactly ONE `jax.device_get` per metric call
   (μ-fidelity, insertion/deletion AUC, input fidelity, baseline fans) —
-  probed by patching `jax.device_get` itself;
+  probed with `fan.fetch_scope` (the thread-isolated scoped counter; the
+  eval2d test double-probes by also patching `jax.device_get` itself, the
+  late-binding contract);
 - parity: the fan-engine metric paths reproduce the per-chunk reference
   path bit for bit at f32 on CPU, across chunk geometries;
 - tuned-chunk plumbing through Eval1DWAM / Eval2DWAM / EvalImageBaselines.
@@ -135,6 +137,29 @@ def test_device_fetch_counter():
     assert fan.fetch_count() == 0
 
 
+def test_fetch_scope_counts_nest_and_survive_exit():
+    with fan.fetch_scope() as outer:
+        fan.device_fetch(jnp.zeros(2))
+        with fan.fetch_scope() as inner:
+            fan.device_fetch(jnp.zeros(2))
+        assert inner.count == 1  # inner sees only its own window...
+        assert outer.count == 2  # ...outer sees both
+    fan.device_fetch(jnp.zeros(2))  # after exit: no longer counted
+    assert outer.count == 2 and inner.count == 1
+
+
+def test_fetch_scope_is_thread_isolated():
+    import threading
+
+    with fan.fetch_scope() as fs:
+        t = threading.Thread(target=lambda: fan.device_fetch(jnp.zeros(2)))
+        t.start()
+        t.join()
+        assert fs.count == 0  # another thread's fetches don't leak in
+        fan.device_fetch(jnp.zeros(2))
+        assert fs.count == 1
+
+
 def test_one_fetch_per_metric_call_eval2d(img_model_fn, count_device_get):
     from wam_tpu.evalsuite.eval2d import Eval2DWAM
 
@@ -146,17 +171,19 @@ def test_one_fetch_per_metric_call_eval2d(img_model_fn, count_device_get):
     y = [1, 3]
     ev.precompute(x, np.asarray(y))
     count_device_get.clear()
-    ev.insertion(x, y, n_iter=8)
-    assert len(count_device_get) == 1
-    count_device_get.clear()
-    ev.deletion(x, y, n_iter=8)
-    assert len(count_device_get) == 1
-    count_device_get.clear()
-    ev.mu_fidelity(x, y, grid_size=8, sample_size=6, subset_size=12)
-    assert len(count_device_get) == 1
+    with fan.fetch_scope() as fs:
+        ev.insertion(x, y, n_iter=8)
+    assert fs.count == 1
+    assert len(count_device_get) == 1  # scoped and patched probes agree
+    with fan.fetch_scope() as fs:
+        ev.deletion(x, y, n_iter=8)
+    assert fs.count == 1
+    with fan.fetch_scope() as fs:
+        ev.mu_fidelity(x, y, grid_size=8, sample_size=6, subset_size=12)
+    assert fs.count == 1
 
 
-def test_one_fetch_per_metric_call_baselines(count_device_get):
+def test_one_fetch_per_metric_call_baselines():
     from wam_tpu.evalsuite.eval_baselines import EvalImageBaselines
 
     model = TinyImgModel()
@@ -166,15 +193,15 @@ def test_one_fetch_per_metric_call_baselines(count_device_get):
     x = jnp.asarray(np.random.default_rng(1).standard_normal((1, 3, 32, 32)),
                     dtype=jnp.float32)
     ev.precompute(x, np.asarray([0]))
-    count_device_get.clear()
-    ev.insertion(x, [0], n_iter=8)
-    assert len(count_device_get) == 1
-    count_device_get.clear()
-    ev.mu_fidelity(x, [0], grid_size=8, sample_size=5, subset_size=10)
-    assert len(count_device_get) == 1
+    with fan.fetch_scope() as fs:
+        ev.insertion(x, [0], n_iter=8)
+    assert fs.count == 1
+    with fan.fetch_scope() as fs:
+        ev.mu_fidelity(x, [0], grid_size=8, sample_size=5, subset_size=10)
+    assert fs.count == 1
 
 
-def test_one_fetch_per_metric_call_eval1d_input_fidelity(count_device_get):
+def test_one_fetch_per_metric_call_eval1d_input_fidelity():
     from wam_tpu.evalsuite.eval1d import Eval1DWAM
     from wam_tpu.wam1d import normalize_waveforms
 
@@ -189,13 +216,13 @@ def test_one_fetch_per_metric_call_eval1d_input_fidelity(count_device_get):
 
     y = [0, 1]
     ev.precompute(normalize_waveforms(x), np.asarray(y))
-    count_device_get.clear()
-    preds = ev.input_fidelity(x, y, target="melspec")
-    assert len(count_device_get) == 1  # the raw-logits tensor, fetched once
+    with fan.fetch_scope() as fs:
+        preds = ev.input_fidelity(x, y, target="melspec")
+    assert fs.count == 1  # the raw-logits tensor, fetched once
     assert len(preds) == 2
-    count_device_get.clear()
-    ev.faithfulness_of_spectra(x, y, target="melspec")
-    assert len(count_device_get) == 1
+    with fan.fetch_scope() as fs:
+        ev.faithfulness_of_spectra(x, y, target="melspec")
+    assert fs.count == 1
 
 
 # -- parity vs the per-chunk reference path ---------------------------------

@@ -1,0 +1,421 @@
+"""Unified observability layer (`wam_tpu/obs`): request-scoped tracing,
+the fleet-wide metrics registry, and the compile/retrace sentinel.
+
+Unit coverage for each pillar plus the integration contracts the layer
+was built for:
+
+- the Chrome trace export of a fake-entry fleet run is structurally valid
+  (``ph:"X"``, per-request trace ids shared by queue_wait/service child
+  spans, non-negative durations) and its spans cover >=95% of request
+  wall latency — gated through ``scripts/trace_report.py --min-coverage``;
+- the registry's totals round-trip against the v2 JSONL ledger exactly
+  (the ``obs_snapshot`` row and the ``serve_summary`` row agree);
+- `assert_no_retrace` holds across a WARM 2-replica serve loop with real
+  jitted entries (the one-compile-per-bucket-per-replica invariant);
+- disabled mode records nothing and freezes every registry instrument.
+
+Runs on the virtual 8-device CPU mesh the conftest forces."""
+
+import json
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+
+import jax
+import numpy as np
+import pytest
+
+from conftest import need_devices
+from wam_tpu import obs
+from wam_tpu.obs import sentinel, tracing
+from wam_tpu.obs.registry import registry
+
+
+@pytest.fixture(autouse=True)
+def obs_clean():
+    """Every test starts from zero obs state and leaves tracing enabled."""
+    obs.configure(enabled=True, ring_size=4096)
+    obs.reset()
+    yield
+    obs.configure(enabled=True, ring_size=4096)
+    obs.reset()
+
+
+# -- tracing ------------------------------------------------------------------
+
+
+def test_span_nesting_shares_trace_and_parents():
+    with obs.span("outer", cat="t") as parent:
+        with obs.span("inner", cat="t", k=1):
+            pass
+    rows = {r["name"]: r for r in obs.spans()}
+    assert rows["inner"]["trace_id"] == rows["outer"]["trace_id"]
+    assert rows["inner"]["parent_id"] == rows["outer"]["span_id"]
+    assert rows["outer"]["parent_id"] is None
+    assert rows["inner"]["attrs"] == {"k": 1}
+    assert rows["inner"]["t1"] >= rows["inner"]["t0"]
+    assert parent.name == "outer"
+
+
+def test_detached_root_and_retroactive_spans():
+    root = obs.start_span("request", cat="t")
+    t0 = time.perf_counter()
+    t1 = t0 + 0.25
+    obs.record_span("queue_wait", t0, t1, parent=(root.trace_id, root.span_id),
+                    cat="t")
+    root.end()
+    rows = {r["name"]: r for r in obs.spans()}
+    assert rows["queue_wait"]["trace_id"] == rows["request"]["trace_id"]
+    assert rows["queue_wait"]["parent_id"] == rows["request"]["span_id"]
+    assert rows["queue_wait"]["t1"] - rows["queue_wait"]["t0"] == pytest.approx(0.25)
+
+
+def test_use_context_propagates_across_threads():
+    root = obs.start_span("request", cat="t")
+    ctx = (root.trace_id, root.span_id)
+
+    def worker():
+        with obs.use_context(ctx):
+            with obs.span("service", cat="t"):
+                pass
+
+    t = threading.Thread(target=worker)
+    t.start()
+    t.join()
+    root.end()
+    rows = {r["name"]: r for r in obs.spans()}
+    assert rows["service"]["trace_id"] == root.trace_id
+    assert rows["service"]["parent_id"] == root.span_id
+
+
+def test_disabled_mode_records_nothing_and_is_a_shared_noop():
+    obs.configure(enabled=False)
+    s1 = obs.span("a")
+    s2 = obs.span("b")
+    assert s1 is s2 is obs.NULL_SPAN  # one shared no-op object, no allocs
+    with s1:
+        pass
+    obs.record_span("c", 0.0, 1.0)
+    assert obs.spans() == []
+    c = registry.counter("wam_tpu_test_disabled_total")
+    c.inc()
+    assert c.value() == 0.0  # registry mutations frozen too
+
+
+def test_ring_size_bounds_and_keeps_newest():
+    obs.configure(ring_size=4)
+    for i in range(10):
+        with obs.span(f"s{i}"):
+            pass
+    names = [r["name"] for r in obs.spans()]
+    assert names == ["s6", "s7", "s8", "s9"]
+
+
+# -- registry -----------------------------------------------------------------
+
+
+def test_counter_gauge_histogram_basics():
+    c = registry.counter("wam_tpu_test_ops_total", "ops", labels=("kind",))
+    c.inc(kind="a")
+    c.inc(2, kind="a")
+    assert c.value(kind="a") == 3.0
+    with pytest.raises(ValueError):
+        c.inc(-1, kind="a")
+    with pytest.raises(ValueError):
+        c.inc(kind="a", extra="nope")
+
+    g = registry.gauge("wam_tpu_test_depth")
+    g.set(5)
+    g.dec(2)
+    assert g.value() == 3.0
+
+    h = registry.histogram("wam_tpu_test_lat_seconds", buckets=(0.1, 1.0))
+    h.observe(0.05)
+    h.observe(0.5)
+    h.observe(50.0)
+    assert h.count() == 3
+    assert h.sum() == pytest.approx(50.55)
+
+
+def test_registry_get_or_create_and_type_mismatch():
+    a = registry.counter("wam_tpu_test_same_total")
+    b = registry.counter("wam_tpu_test_same_total")
+    assert a is b
+    with pytest.raises(ValueError):
+        registry.gauge("wam_tpu_test_same_total")
+
+
+def test_render_prom_exposition_format():
+    c = registry.counter("wam_tpu_test_fmt_total", "help text", labels=("r",))
+    c.inc(r='q"x"')
+    h = registry.histogram("wam_tpu_test_fmt_seconds", buckets=(0.1, 1.0))
+    h.observe(0.5)
+    text = obs.render_prom()
+    assert "# HELP wam_tpu_test_fmt_total help text" in text
+    assert "# TYPE wam_tpu_test_fmt_total counter" in text
+    assert 'wam_tpu_test_fmt_total{r="q\\"x\\""} 1' in text
+    # cumulative buckets: 0.5 lands in le=1.0 and le=+Inf but not le=0.1
+    assert 'wam_tpu_test_fmt_seconds_bucket{le="0.1"} 0' in text
+    assert 'wam_tpu_test_fmt_seconds_bucket{le="1"} 1' in text
+    assert 'wam_tpu_test_fmt_seconds_bucket{le="+Inf"} 1' in text
+    assert "wam_tpu_test_fmt_seconds_sum 0.5" in text
+    assert "wam_tpu_test_fmt_seconds_count 1" in text
+
+
+def test_registry_reset_zeroes_but_keeps_instruments():
+    c = registry.counter("wam_tpu_test_reset_total")
+    c.inc(7)
+    registry.reset()
+    assert c.value() == 0.0
+    assert registry.counter("wam_tpu_test_reset_total") is c
+
+
+# -- sentinel -----------------------------------------------------------------
+
+
+def test_sentinel_attribution_and_ambient_labels():
+    with sentinel.label(replica=3, bucket="1x16x16", phase="warmup"):
+        ev = sentinel.record_trace("serve", detail="entry")
+    assert (ev["replica"], ev["bucket"], ev["phase"]) == (3, "1x16x16", "warmup")
+    # explicit non-None labels override ambient; None does NOT shadow
+    with sentinel.label(replica=1, bucket="b"):
+        ev2 = sentinel.record_trace("serve", replica=2, bucket=None)
+    assert (ev2["replica"], ev2["bucket"]) == (2, "b")
+    assert sentinel.trace_count() == 2
+    assert registry.counter(
+        "wam_tpu_compile_jit_traces_total").value(entry_kind="serve") == 2.0
+    assert ev["origin"]  # some wam_tpu/test frames survive the obs filter
+
+
+def test_assert_no_retrace_raises_with_events():
+    with obs.assert_no_retrace():
+        pass  # clean block passes
+    with pytest.raises(obs.RetraceError) as ei:
+        with obs.assert_no_retrace():
+            sentinel.record_trace("serve", bucket="1x8x8")
+    assert len(ei.value.events) == 1
+    assert "1x8x8" in str(ei.value)
+    # a propagating exception is never masked by the retrace check
+    with pytest.raises(RuntimeError):
+        with obs.assert_no_retrace():
+            sentinel.record_trace("serve")
+            raise RuntimeError("real failure")
+
+
+def test_sentinel_counts_aot_events():
+    sentinel.record_aot("miss", "k1")
+    sentinel.record_aot("export", "k1")
+    sentinel.record_aot("hit", "k1")
+    sentinel.record_aot("hit", "k1")
+    assert sentinel.aot_event_count("hit") == 2
+    assert sentinel.aot_event_count() == 4
+    assert registry.counter(
+        "wam_tpu_compile_aot_events_total").value(event="hit") == 2.0
+
+
+def test_sentinel_stays_live_when_obs_disabled():
+    obs.configure(enabled=False)
+    with pytest.raises(obs.RetraceError):
+        with obs.assert_no_retrace():
+            sentinel.record_trace("serve")
+    assert sentinel.trace_count() == 1  # event counted...
+    assert registry.counter(
+        "wam_tpu_compile_jit_traces_total").value(entry_kind="serve") == 0.0
+    # ...even though the (disabled) registry counter stayed frozen
+
+
+# -- chrome export / HTTP -----------------------------------------------------
+
+
+def test_export_chrome_trace_format(tmp_path):
+    with obs.span("outer", cat="t", bucket="1x16x16"):
+        with obs.span("inner", cat="t"):
+            pass
+    path = obs.export_chrome_trace(str(tmp_path / "trace.json"))
+    payload = json.loads((tmp_path / "trace.json").read_text())
+    assert path == str(tmp_path / "trace.json")
+    xs = [e for e in payload["traceEvents"] if e["ph"] == "X"]
+    assert {e["name"] for e in xs} == {"outer", "inner"}
+    for e in xs:
+        assert e["dur"] >= 0 and e["ts"] > 0  # µs on the perf_counter base
+        assert e["args"]["trace_id"] and e["args"]["span_id"]
+    outer = next(e for e in xs if e["name"] == "outer")
+    assert outer["args"]["bucket"] == "1x16x16"
+    metas = [e for e in payload["traceEvents"] if e["ph"] == "M"]
+    assert any(m["name"] == "thread_name" for m in metas)
+
+
+def test_metrics_http_endpoint():
+    registry.counter("wam_tpu_test_http_total").inc(5)
+    server = obs.start_metrics_server(0)  # ephemeral port
+    try:
+        port = server.server_port
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=5).read().decode()
+        assert "wam_tpu_test_http_total 5" in body
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(f"http://127.0.0.1:{port}/nope", timeout=5)
+    finally:
+        obs.stop_metrics_server(server)
+
+
+# -- serve integration --------------------------------------------------------
+
+
+def _fake_entry_server(metrics_path=None, **kw):
+    from wam_tpu.serve import AttributionServer, ServeMetrics
+
+    metrics = ServeMetrics()
+    server = AttributionServer(
+        lambda xs, ys: np.asarray(xs) * 2.0,
+        [(4,)],
+        max_batch=4,
+        max_wait_ms=0.0,
+        warmup=False,
+        metrics=metrics,
+        metrics_path=metrics_path,
+        **kw,
+    )
+    return server, metrics
+
+
+def test_serve_registry_matches_ledger_roundtrip(tmp_path):
+    """S3: the prom registry and the JSONL ledger are two views of the SAME
+    counts — the serve_summary row, the obs_snapshot row, and collect()
+    must agree exactly."""
+    path = str(tmp_path / "ledger.jsonl")
+    server, metrics = _fake_entry_server(metrics_path=path)
+    x = np.zeros((4,), np.float32)
+    try:
+        for _ in range(6):
+            np.testing.assert_array_equal(server.attribute(x, 0), x * 2.0)
+    finally:
+        server.close()  # emits serve_summary + obs_snapshot
+
+    rows = [json.loads(l) for l in open(path) if l.strip()]
+    summary = next(r for r in rows if r["metric"] == "serve_summary")
+    snap = next(r for r in rows if r["metric"] == "obs_snapshot")
+    live = registry.collect()
+    assert summary["submitted"] == summary["completed"] == 6
+    for field in ("submitted", "completed", "rejected", "expired"):
+        key = f'wam_tpu_serve_{field}_total{{replica="-"}}'
+        ledger_val = snap["registry"].get(key, 0.0)
+        assert ledger_val == live.get(key, 0.0) == float(summary[field])
+    lat_count = f'wam_tpu_serve_latency_seconds_count{{replica="-"}}'
+    assert snap["registry"][lat_count] == float(summary["completed"])
+    batch_rows = [r for r in rows if r["metric"] == "serve_batch"]
+    assert sum(
+        v for k, v in live.items()
+        if k.startswith("wam_tpu_serve_batches_total")) == len(batch_rows)
+
+
+def test_fleet_trace_export_is_valid_and_covers_requests(tmp_path):
+    """S4: a fake-entry fleet run exports a structurally valid Chrome trace
+    whose per-request span trees tile the request wall time (>=95%,
+    enforced through scripts/trace_report.py --min-coverage)."""
+    need_devices(2)
+    from wam_tpu.serve import FleetMetrics, FleetServer
+
+    n_req = 8
+    fleet = FleetServer(
+        lambda rid, m: lambda xs, ys: np.asarray(xs) * 2.0,
+        [(4,)],
+        replicas=2,
+        max_batch=2,
+        max_wait_ms=0.0,
+        warmup=False,
+        metrics=FleetMetrics(),
+    )
+    x = np.zeros((4,), np.float32)
+    try:
+        futs = [fleet.submit(x, 0) for _ in range(n_req)]
+        for f in futs:
+            f.result(timeout=10)
+    finally:
+        fleet.close()
+
+    path = str(tmp_path / "trace.json")
+    obs.export_chrome_trace(path)
+    events = [e for e in json.loads(open(path).read())["traceEvents"]
+              if e.get("ph") == "X"]
+    roots = [e for e in events if e["name"] == "request"]
+    assert len(roots) == n_req
+    by_trace = {}
+    for e in events:
+        by_trace.setdefault(e["args"]["trace_id"], set()).add(e["name"])
+    for r in roots:
+        names = by_trace[r["args"]["trace_id"]]
+        # every request's trace carries admission + the retroactive
+        # queue_wait/service spans recorded by the replica worker
+        assert {"admission", "queue_wait", "service"} <= names
+    assert all(e["dur"] >= 0 for e in events)
+
+    report = subprocess.run(
+        [sys.executable, "scripts/trace_report.py", path,
+         "--min-coverage", "0.95"],
+        capture_output=True, text=True, timeout=60)
+    assert report.returncode == 0, report.stdout + report.stderr
+    assert "span coverage" in report.stdout
+
+
+def test_no_retrace_across_warm_two_replica_loop():
+    """Acceptance: a WARM 2-replica fleet with real jitted entries serves a
+    mixed exact/padded stream without a single fresh jit trace."""
+    need_devices(2)
+    from wam_tpu.serve import FleetMetrics, FleetServer
+
+    fleet = FleetServer(
+        lambda rid, m: __import__("wam_tpu.serve.entry", fromlist=["jit_entry"])
+        .jit_entry(lambda xs, ys: xs * 2.0, on_trace=m.note_compile),
+        [(4,), (8,)],
+        replicas=2,
+        max_batch=2,
+        max_wait_ms=0.0,
+        warmup=True,  # one compile per (bucket, replica), all before serving
+        metrics=FleetMetrics(),
+    )
+    try:
+        warm_traces = sentinel.trace_count()
+        assert warm_traces >= 1  # warmup itself went through the sentinel
+        assert all(
+            e["phase"] == "warmup" for e in sentinel.compile_events())
+        with obs.assert_no_retrace():
+            futs = [fleet.submit(np.zeros((n,), np.float32), 0)
+                    for n in (4, 8, 3, 4, 7, 8)]  # exact + padded shapes
+            for f in futs:
+                f.result(timeout=30)
+    finally:
+        fleet.close()
+    assert sentinel.trace_count() == warm_traces
+
+
+def test_obs_config_dataclass_configures_layer():
+    from wam_tpu.config import ObsConfig
+
+    obs.configure(ObsConfig(enabled=False, ring_size=8))
+    assert not tracing._STATE.enabled
+    assert tracing._STATE.ring.maxlen == 8
+    obs.configure(ObsConfig())
+    assert tracing._STATE.enabled
+
+
+def test_stager_and_fan_publish_to_registry():
+    from wam_tpu.evalsuite.fan import fan_runner, run_fan
+    from wam_tpu.pipeline.stager import put_committed
+
+    x = np.zeros((2, 8), np.float32)
+    put_committed(x)
+    assert registry.counter(
+        "wam_tpu_stager_h2d_bytes_total").value() == float(x.nbytes)
+
+    runner = fan_runner(lambda a: a * 2.0, donate=False)
+    out = run_fan(runner, (np.ones((4,), np.float32),))
+    np.testing.assert_array_equal(out, np.full((4,), 2.0))
+    assert registry.counter(
+        "wam_tpu_fan_result_fetches_total").value() == 1.0
+    names = [r["name"] for r in obs.spans()]
+    assert "fan.dispatch" in names and "fan.fetch" in names
+    # the fan step's first trace landed on the sentinel as entry_kind="fan"
+    assert any(e["entry_kind"] == "fan" for e in sentinel.compile_events())

@@ -21,6 +21,7 @@ __all__ = [
     "WAM3DConfig",
     "EvalConfig",
     "ServeConfig",
+    "ObsConfig",
     "select_backend",
     "enable_compilation_cache",
     "add_config_args",
@@ -205,6 +206,20 @@ class ServeConfig:
             for part in self.buckets.split(",")
             if part.strip()
         ]
+
+
+@dataclass
+class ObsConfig:
+    """Knobs of the unified observability layer (`wam_tpu.obs`). Apply
+    with ``wam_tpu.obs.configure(cfg)``. ``enabled=False`` turns every
+    span/counter call into a near-zero-overhead no-op (the compile
+    sentinel keeps counting — trace-time-rare by construction).
+    ``prom_port`` is consumed by `serve.FleetServer(prom_port=...)` /
+    ``bench_serve --prom-port``: 0 = no endpoint."""
+
+    enabled: bool = True
+    ring_size: int = 4096  # span ring capacity (oldest spans drop first)
+    prom_port: int = 0  # /metrics HTTP port; 0 = disabled
 
 
 @dataclass

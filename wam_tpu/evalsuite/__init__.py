@@ -12,6 +12,7 @@ from wam_tpu.evalsuite.fan import (
     device_fetch,
     fan_runner,
     fetch_count,
+    fetch_scope,
     plan_fan,
     reset_fetch_count,
     run_fan,
@@ -36,6 +37,7 @@ __all__ = [
     "run_fan",
     "device_fetch",
     "fetch_count",
+    "fetch_scope",
     "reset_fetch_count",
     "EvalImageBaselines",
     "EvalAudioBaselines",

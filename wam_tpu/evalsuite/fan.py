@@ -30,9 +30,13 @@ donation-protected invocation that ends in the single fetch.
 from __future__ import annotations
 
 import dataclasses
+import threading
 
 import jax
 import jax.numpy as jnp
+
+from wam_tpu.obs import tracing as _obs_tracing
+from wam_tpu.obs.registry import registry as _registry
 
 __all__ = [
     "FanPlan",
@@ -45,34 +49,80 @@ __all__ = [
     "device_fetch",
     "fetch_count",
     "reset_fetch_count",
+    "fetch_scope",
 ]
 
 
 # -- the single result fetch ----------------------------------------------
 
 _FETCH_COUNT = 0
+_fetch_tls = threading.local()  # per-thread stack of live fetch_scopes
+
+_c_fetches = _registry.counter(
+    "wam_tpu_fan_result_fetches_total",
+    "device_fetch calls (one per fan metric call is the contract)")
 
 
 def device_fetch(out):
     """THE result fetch: one `jax.device_get` of the whole result tree.
 
     Every fan metric funnels its device→host transfer through here, so the
-    one-fetch contract is testable two ways: `fetch_count()` deltas, or
-    patching ``jax.device_get`` itself (the call is late-bound on purpose —
-    tests monkeypatch the attribute and count)."""
+    one-fetch contract is testable three ways: a `fetch_scope()` delta (the
+    scoped counter — preferred), the legacy process-global `fetch_count()`,
+    or patching ``jax.device_get`` itself (the call is late-bound on
+    purpose — tests monkeypatch the attribute and count). Each call also
+    lands on the obs registry's fan-fetch counter."""
     global _FETCH_COUNT
     _FETCH_COUNT += 1
+    for scope in getattr(_fetch_tls, "scopes", ()):
+        scope._count += 1
+    _c_fetches.inc()
     return jax.device_get(out)
 
 
 def fetch_count() -> int:
-    """Number of `device_fetch` calls since import / last reset."""
+    """Number of `device_fetch` calls since import / last reset — the
+    legacy PROCESS-GLOBAL counter (scripts/bench_eval.py per-row deltas).
+    Concurrent threads (fleet replicas, parallel test runs) all bump it;
+    for an isolated count use `fetch_scope`."""
     return _FETCH_COUNT
 
 
 def reset_fetch_count() -> None:
     global _FETCH_COUNT
     _FETCH_COUNT = 0
+
+
+class fetch_scope:
+    """Scoped, thread-isolated fetch counter:
+
+        with fetch_scope() as fs:
+            metric(...)
+        assert fs.count == 1
+
+    Counts only `device_fetch` calls made by THE CURRENT THREAD while the
+    scope is live, so fleet replica workers and parallel test runs cannot
+    cross-contaminate each other's probes (the process-global
+    `fetch_count` cannot make that promise). Scopes nest — each level
+    counts independently. ``count`` stays readable after exit."""
+
+    def __init__(self):
+        self._count = 0
+
+    @property
+    def count(self) -> int:
+        return self._count
+
+    def __enter__(self) -> "fetch_scope":
+        scopes = getattr(_fetch_tls, "scopes", None)
+        if scopes is None:
+            scopes = _fetch_tls.scopes = []
+        scopes.append(self)
+        return self
+
+    def __exit__(self, *exc):
+        _fetch_tls.scopes.remove(self)
+        return False
 
 
 # -- chunk geometry --------------------------------------------------------
@@ -207,8 +257,17 @@ def fan_runner(body, *, mesh=None, data_axis: str = "data",
     if aot_key is not None:
         from wam_tpu.pipeline.aot import cached_entry
 
-        return cached_entry(body, aot_key, donate_argnums=argnums)
-    return jax.jit(body, donate_argnums=argnums)
+        return cached_entry(body, aot_key, donate_argnums=argnums,
+                            obs_kind="fan")
+
+    from wam_tpu.obs import sentinel as _obs_sentinel
+
+    def probed(*step_args):
+        # trace-time only: fan-step compiles land on the compile sentinel
+        _obs_sentinel.record_trace("fan", detail=getattr(body, "__name__", ""))
+        return body(*step_args)
+
+    return jax.jit(probed, donate_argnums=argnums)
 
 
 def run_fan(runner, args: tuple, *, donate: bool | None = None, mesh=None,
@@ -228,4 +287,7 @@ def run_fan(runner, args: tuple, *, donate: bool | None = None, mesh=None,
             donation_safe(a, True) if i in protect else a
             for i, a in enumerate(args)
         )
-    return device_fetch(runner(*args))
+    with _obs_tracing.span("fan.dispatch", cat="fan"):
+        out = runner(*args)
+    with _obs_tracing.span("fan.fetch", cat="fan"):
+        return device_fetch(out)

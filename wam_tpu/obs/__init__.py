@@ -1,0 +1,78 @@
+"""wam_tpu.obs — unified observability: tracing, metrics, compile sentinel.
+
+Three pillars, one import surface:
+
+- **Request-scoped tracing** (`obs.span`, `obs.start_span`,
+  `obs.record_span`, `obs.export_chrome_trace`) — per-request span trees
+  with trace/parent ids on monotonic clocks, exported as Chrome
+  trace-event JSON. See `wam_tpu.obs.tracing`.
+- **Metrics registry** (`obs.registry`, `obs.render_prom`,
+  `obs.start_metrics_server`) — process-level counters/gauges/histograms
+  in the ``wam_tpu_<subsystem>_<name>`` namespace with Prometheus text
+  exposition. See `wam_tpu.obs.registry`.
+- **Compile/retrace sentinel** (`obs.sentinel`, `obs.assert_no_retrace`)
+  — every jit trace and AOT cache event counted and attributed. See
+  `wam_tpu.obs.sentinel`.
+
+`configure(ObsConfig(...))` (or `configure(enabled=False)`) flips the
+shared enabled flag: disabled, spans are a shared no-op singleton and
+registry mutations return on one branch — near-zero overhead. The
+sentinel keeps counting regardless (compile events are trace-time-rare
+and the retrace invariant must hold even in overhead-sensitive runs).
+
+`reset()` clears spans, registry values, and sentinel events — bench
+sweep points and tests call it between runs so process-global state
+can't leak across measurements.
+
+This package imports only the stdlib and (lazily, for profiler
+annotations) jax — never wam_tpu.serve/pipeline/evalsuite, which all
+import obs. That one-way edge is what lets every subsystem publish here
+without cycles.
+"""
+
+from __future__ import annotations
+
+from wam_tpu.obs import sentinel
+from wam_tpu.obs.httpd import start_metrics_server, stop_metrics_server
+from wam_tpu.obs.registry import Registry, registry, render_prom
+from wam_tpu.obs.sentinel import (RetraceError, assert_no_retrace,
+                                  compile_events, record_aot, record_trace,
+                                  trace_count)
+from wam_tpu.obs.tracing import (NULL_SPAN, Span, clear_spans,
+                                 current_context, enabled,
+                                 export_chrome_trace, record_span,
+                                 set_enabled, set_ring_size, span, spans,
+                                 start_span, use_context)
+
+__all__ = [
+    "span", "start_span", "record_span", "current_context", "use_context",
+    "spans", "clear_spans", "export_chrome_trace", "Span", "NULL_SPAN",
+    "registry", "Registry", "render_prom", "start_metrics_server",
+    "stop_metrics_server",
+    "sentinel", "record_trace", "record_aot", "trace_count",
+    "compile_events", "assert_no_retrace", "RetraceError",
+    "configure", "reset", "enabled", "set_enabled", "set_ring_size",
+]
+
+
+def configure(cfg=None, *, enabled: bool | None = None,
+              ring_size: int | None = None) -> None:
+    """Apply an `ObsConfig` (duck-typed: any object with
+    enabled/ring_size/prom_port attrs) or individual overrides. Starting
+    the prom endpoint is the server's job (`FleetServer(prom_port=...)`)
+    — configure only sets process-level tracing state."""
+    if cfg is not None:
+        enabled = cfg.enabled if enabled is None else enabled
+        ring_size = getattr(cfg, "ring_size", None) if ring_size is None else ring_size
+    if enabled is not None:
+        set_enabled(enabled)
+    if ring_size is not None:
+        set_ring_size(ring_size)
+
+
+def reset() -> None:
+    """Clear all recorded observability state: span ring, registry
+    values (instruments stay registered), sentinel events + counts."""
+    clear_spans()
+    registry.reset()
+    sentinel.clear_events()

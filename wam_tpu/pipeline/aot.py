@@ -67,6 +67,7 @@ def _register_pytree_serializations() -> None:
 
 _register_pytree_serializations()
 
+from wam_tpu.obs import sentinel
 from wam_tpu.pipeline.donation import resolve_donate
 
 __all__ = [
@@ -192,6 +193,7 @@ def cached_jit(
     donate_argnums: Sequence[int] = (),
     on_trace: Callable[[], None] | None = None,
     cache_dir: str | None = None,
+    obs_kind: str = "aot",
 ):
     """One executable for ``fn`` at ``example_args``' shapes/dtypes.
 
@@ -199,11 +201,15 @@ def cached_jit(
     traced (``on_trace`` never fires). Miss: trace+export ``fn`` once
     (``on_trace`` fires once), persist, and serve the exported module.
     Disabled cache or export failure falls back to a plain `jax.jit(fn)`.
-    Returns a callable with ``fn``'s signature.
+    Returns a callable with ``fn``'s signature. Every trace of ``fn`` is
+    also reported to the compile sentinel (under ``obs_kind``), and cache
+    hit/miss/export outcomes land on the sentinel's AOT counters.
     """
     donate_argnums = tuple(donate_argnums)
 
     def probed(*args):
+        # trace-time only — one execution per jit cache miss
+        sentinel.record_trace(obs_kind, detail=key)
         if on_trace is not None:
             on_trace()
         return fn(*args)
@@ -213,6 +219,7 @@ def cached_jit(
         return plain
     exported = load_aot(key, cache_dir)
     if exported is None:
+        sentinel.record_aot("miss", key)
         specs = [_specs_like(a) for a in example_args]
         try:
             if jax_export is None:
@@ -221,7 +228,10 @@ def cached_jit(
         except Exception as e:
             _warn_once(key, f"export failed: {type(e).__name__}: {e}")
             return plain
-        save_aot(key, exported, cache_dir)
+        if save_aot(key, exported, cache_dir) is not None:
+            sentinel.record_aot("export", key)
+    else:
+        sentinel.record_aot("hit", key)
     call = exported.call
     return jax.jit(call, donate_argnums=donate_argnums)
 
@@ -233,6 +243,7 @@ def cached_entry(
     donate_argnums: Sequence[int] = (),
     on_trace: Callable[[], None] | None = None,
     cache_dir: str | None = None,
+    obs_kind: str = "aot",
 ):
     """Shape-dispatching callable over the AOT cache.
 
@@ -257,6 +268,7 @@ def cached_entry(
                 donate_argnums=donate_argnums,
                 on_trace=on_trace,
                 cache_dir=cache_dir,
+                obs_kind=obs_kind,
             )
             fns[sig] = fn
         return fn(*args)

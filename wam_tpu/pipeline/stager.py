@@ -24,9 +24,16 @@ from typing import Any, Iterable, Iterator
 
 import jax
 
+from wam_tpu.obs import tracing as _obs_tracing
+from wam_tpu.obs.registry import registry as _registry
+
 __all__ = ["put_committed", "stage_to_device", "DeviceStager"]
 
 _DONE = object()
+
+_h2d_bytes = _registry.counter(
+    "wam_tpu_stager_h2d_bytes_total",
+    "host->device bytes staged through put_committed")
 
 
 def put_committed(tree, sharding=None):
@@ -35,7 +42,15 @@ def put_committed(tree, sharding=None):
     single Device broadcasts over the tree, which is how each fleet replica
     pins its staged batches and warmup zeros to its own chip,
     `serve/runtime.py` "Device pinning"). Dispatch is asynchronous — the
-    returned arrays are futures over the transfer."""
+    returned arrays are futures over the transfer. When observability is
+    on, the staged leaf bytes land on the obs H2D counter (host-side
+    ``.nbytes`` of the pre-transfer leaves — no device sync)."""
+    if _obs_tracing._STATE.enabled:
+        n = 0
+        for leaf in jax.tree_util.tree_leaves(tree):
+            n += getattr(leaf, "nbytes", 0)
+        if n:
+            _h2d_bytes.inc(n)
     if sharding is None:
         return jax.device_put(tree)
     return jax.device_put(tree, sharding)

@@ -440,11 +440,17 @@ def trace(name: str):
 
 class StageTimer:
     """Accumulating wall-clock timer: `with timer.stage("dwt"): ...`;
-    blocks on device results when given an output to ready-wait."""
+    blocks on device results when given an output to ready-wait.
 
-    def __init__(self):
+    With ``span_prefix`` set (e.g. ``"serve."``), every stage interval is
+    also recorded as an obs span named ``{span_prefix}{name}`` — it
+    parents to the calling thread's current span context, so stages that
+    run inside a request's context join that request's trace for free."""
+
+    def __init__(self, span_prefix: str | None = None):
         self.totals: dict[str, float] = defaultdict(float)
         self.counts: dict[str, int] = defaultdict(int)
+        self.span_prefix = span_prefix
 
     @contextlib.contextmanager
     def stage(self, name: str):
@@ -452,8 +458,15 @@ class StageTimer:
         try:
             yield
         finally:
-            self.totals[name] += time.perf_counter() - t0
+            t1 = time.perf_counter()
+            self.totals[name] += t1 - t0
             self.counts[name] += 1
+            if self.span_prefix is not None:
+                from wam_tpu.obs import tracing as _obs_tracing
+
+                _obs_tracing.record_span(
+                    f"{self.span_prefix}{name}", t0, t1,
+                    parent=_obs_tracing.current_context(), cat="stage")
 
     def timed(self, name: str, fn, *args, **kwargs):
         with self.stage(name):

@@ -28,6 +28,7 @@ from typing import Callable
 
 import jax
 
+from wam_tpu.obs import sentinel
 from wam_tpu.pipeline.donation import resolve_donate
 
 __all__ = ["jit_entry", "fleet_aot_key"]
@@ -50,12 +51,15 @@ def jit_entry(
     donate: bool | None = None,
     on_trace: Callable[[], None] | None = None,
     aot_key: str | None = None,
+    obs_kind: str = "serve",
 ):
     """Wrap ``impl(x, y)`` as a serving entry (see module docstring).
 
     ``donate=None`` resolves to "donate on TPU only" — XLA:CPU leaves
     donated buffers unused and warns per call. ``aot_key`` opts the entry
-    into the AOT executable cache."""
+    into the AOT executable cache. Every jit trace is also reported to the
+    compile sentinel (`wam_tpu.obs.sentinel`) under ``obs_kind``, tagged
+    with whatever bucket/replica/phase labels the caller's thread holds."""
     if aot_key is not None:
         from wam_tpu.pipeline.aot import cached_entry
 
@@ -64,11 +68,24 @@ def jit_entry(
             aot_key,
             donate_argnums=(0,) if resolve_donate(donate) else (),
             on_trace=on_trace,
+            obs_kind=obs_kind,
         )
 
     def wrapped(x, y):
+        # trace-time only: one execution per jit cache miss
+        sentinel.record_trace(obs_kind, detail=getattr(impl, "__name__", ""),
+                              bucket=_bucket_of(x))
         if on_trace is not None:
-            on_trace()  # trace-time only: one call per jit cache miss
+            on_trace()
         return impl(x, y)
 
     return jax.jit(wrapped, donate_argnums=(0,) if resolve_donate(donate) else ())
+
+
+def _bucket_of(x):
+    """Bucket label for a compile event: the traced input's shape (jit
+    passes ShapedArray tracers, so .shape is static and host-safe)."""
+    try:
+        return "x".join(str(d) for d in x.shape)
+    except Exception:
+        return None

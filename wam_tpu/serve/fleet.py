@@ -67,8 +67,10 @@ from dataclasses import dataclass, field
 import jax
 import numpy as np
 
+from wam_tpu.obs import sentinel as obs_sentinel
+from wam_tpu.obs import tracing as obs_tracing
 from wam_tpu.pipeline.stager import put_committed
-from wam_tpu.serve.buckets import Bucket, BucketTable, pad_item
+from wam_tpu.serve.buckets import Bucket, BucketTable, bucket_key, pad_item
 from wam_tpu.serve.metrics import FleetMetrics, ServeMetrics
 from wam_tpu.serve.runtime import (
     AttributionServer,
@@ -109,6 +111,9 @@ class _FleetRequest:
     deadline_at: float | None  # perf_counter timestamp, None = no deadline
     future: Future
     tried: set = field(default_factory=set)
+    # obs trace identity: every admission/queue/service span of this
+    # request (including re-routes after a death) parents here
+    ctx: tuple | None = None
 
 
 class FleetServer:
@@ -130,6 +135,10 @@ class FleetServer:
     metrics : a shared `FleetMetrics` (fresh when None); per-replica
         `ServeMetrics` are created through it so the fleet summary sees
         every ledger.
+    prom_port : when not None, serve the obs registry in Prometheus text
+        format at ``GET http://127.0.0.1:{prom_port}/metrics`` for this
+        fleet's lifetime (`wam_tpu.obs.start_metrics_server`; pass 0 to
+        bind an ephemeral port — read ``fleet.prom_server.server_port``).
     """
 
     def __init__(
@@ -152,6 +161,7 @@ class FleetServer:
         dtype=np.float32,
         pipelined: bool = True,
         auto_start: bool = True,
+        prom_port: int | None = None,
     ):
         if not callable(entry_factory):
             raise TypeError("entry_factory must be callable(replica_id, metrics)")
@@ -206,6 +216,11 @@ class FleetServer:
 
             self._mesh = replica_mesh(n, self.devices)
             self._os_entry = entry_factory(OVERSIZE_ENTRY_ID, self.metrics.oversize)
+        self.prom_server = None
+        if prom_port is not None:
+            from wam_tpu.obs import start_metrics_server
+
+            self.prom_server = start_metrics_server(prom_port)
         if auto_start:
             self.start()
 
@@ -243,6 +258,11 @@ class FleetServer:
                 config=self.describe(),
                 replica_configs={r.rid: r.server.describe() for r in self._replicas},
             )
+        if self.prom_server is not None:
+            from wam_tpu.obs import stop_metrics_server
+
+            stop_metrics_server(self.prom_server)
+            self.prom_server = None
         self._started = False
 
     def __enter__(self):
@@ -281,7 +301,22 @@ class FleetServer:
         else:
             deadline_at = now + deadline_ms / 1e3
         req = _FleetRequest(x, y, bucket, deadline_at, Future())
-        self._route(req, raise_errors=True)
+        if obs_tracing._STATE.enabled:
+            # detached per-request root: ends on whichever thread resolves
+            # the fleet future (worker callback), closing the trace
+            root = obs_tracing.start_span(
+                "request", cat="fleet", bucket=bucket_key(bucket.shape))
+            req.ctx = root.context
+            req.future.add_done_callback(
+                lambda f: root.end(
+                    error=type(f.exception()).__name__ if f.exception() else None))
+            try:
+                self._route(req, raise_errors=True)
+            except Exception as e:
+                root.end(error=type(e).__name__)  # rejected before queueing
+                raise
+        else:
+            self._route(req, raise_errors=True)
         return req.future
 
     def attribute(self, x, y=None, deadline_ms: float | None = None):
@@ -341,6 +376,15 @@ class FleetServer:
                 raise exc
             req.future.set_exception(exc)
 
+        # admission span under the request's trace: scoring + the routed
+        # submit happen inside, so re-routes after a death show up as a
+        # second admission span on the same trace id
+        with obs_tracing.use_context(req.ctx), obs_tracing.span(
+            "admission", cat="fleet", rerouted=bool(req.tried)
+        ):
+            return self._route_inner(req, _fail)
+
+    def _route_inner(self, req: _FleetRequest, _fail) -> None:
         with self._lock:
             if self._closed or not self._started:
                 return _fail(ServerClosedError("fleet is not accepting requests"))
@@ -415,28 +459,38 @@ class FleetServer:
         metrics.note_submit(len(xs))
         outs = []
         with self._os_lock:
+            bkey = bucket_key(bucket.shape)
             for lo in range(0, len(xs), rows_per):
                 chunk = xs[lo : lo + rows_per]
                 k = len(chunk)
                 t0 = time.perf_counter()
-                with metrics.stages.stage("assemble"):
-                    padded = np.stack([pad_item(r, bucket) for r in chunk])
-                    if k < rows_per:
-                        # replicate-pad rows, same exactness argument as the
-                        # single-chip batch pad (serve.buckets)
-                        reps = np.repeat(padded[:1], rows_per - k, axis=0)
-                        padded = np.concatenate([padded, reps])
-                    if self.labeled:
-                        yc = ys[lo : lo + rows_per]
+                # one span per fleet-wide chunk; compile-sentinel labels so
+                # the oversize graph's (expected) first trace self-identifies
+                with obs_tracing.span(
+                    "oversize_chunk", cat="fleet", bucket=bkey, n_real=k
+                ), obs_sentinel.label(
+                    replica=OVERSIZE_ENTRY_ID, bucket=bkey, phase="oversize"
+                ):
+                    with metrics.stages.stage("assemble"):
+                        padded = np.stack([pad_item(r, bucket) for r in chunk])
                         if k < rows_per:
-                            yc = np.concatenate([yc, np.repeat(yc[:1], rows_per - k)])
-                        sx, sy = put_committed((padded, yc), (xspec, yspec))
-                    else:
-                        sx, sy = put_committed(padded, xspec), None
-                with metrics.stages.stage("dispatch"):
-                    out = self._os_entry(sx, sy)
-                with metrics.stages.stage("harvest"):
-                    out = jax.device_get(out)
+                            # replicate-pad rows, same exactness argument as
+                            # the single-chip batch pad (serve.buckets)
+                            reps = np.repeat(padded[:1], rows_per - k, axis=0)
+                            padded = np.concatenate([padded, reps])
+                        if self.labeled:
+                            yc = ys[lo : lo + rows_per]
+                            if k < rows_per:
+                                yc = np.concatenate(
+                                    [yc, np.repeat(yc[:1], rows_per - k)]
+                                )
+                            sx, sy = put_committed((padded, yc), (xspec, yspec))
+                        else:
+                            sx, sy = put_committed(padded, xspec), None
+                    with metrics.stages.stage("dispatch"):
+                        out = self._os_entry(sx, sy)
+                    with metrics.stages.stage("harvest"):
+                        out = jax.device_get(out)
                 service_s = time.perf_counter() - t0
                 metrics.note_batch(
                     bucket_shape=bucket.shape,

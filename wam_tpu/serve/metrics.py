@@ -38,13 +38,71 @@ import time
 
 import numpy as np
 
+from wam_tpu.obs.registry import registry as _obs_registry
 from wam_tpu.profiling import StageTimer
 from wam_tpu.results import JsonlWriter
 from wam_tpu.serve.buckets import bucket_key
 
-__all__ = ["ServeMetrics", "FleetMetrics", "percentile_ms", "SCHEMA_VERSION"]
+__all__ = ["ServeMetrics", "FleetMetrics", "percentile_ms", "SCHEMA_VERSION",
+           "write_obs_snapshot"]
 
 SCHEMA_VERSION = 2
+
+# -- obs registry instruments (second sink; JSONL schema untouched) ---------
+# Counters mirror the ServeMetrics counters 1:1 so `obs.render_prom()` and
+# the JSONL summary can be cross-checked exactly (bench_serve --emit test).
+# Label cardinality: replica id ("-" when unset) and bucket key only.
+
+def _rlabel(replica_id) -> str:
+    return "-" if replica_id is None else str(replica_id)
+
+
+_c_submitted = _obs_registry.counter(
+    "wam_tpu_serve_submitted_total", "requests accepted by submit()",
+    labels=("replica",))
+_c_completed = _obs_registry.counter(
+    "wam_tpu_serve_completed_total", "requests resolved with a result",
+    labels=("replica",))
+_c_rejected = _obs_registry.counter(
+    "wam_tpu_serve_rejected_total", "requests rejected by backpressure",
+    labels=("replica",))
+_c_expired = _obs_registry.counter(
+    "wam_tpu_serve_expired_total", "requests whose deadline passed queued",
+    labels=("replica",))
+_c_failed = _obs_registry.counter(
+    "wam_tpu_serve_failed_total", "requests failed with no fallback",
+    labels=("replica",))
+_c_fallbacks = _obs_registry.counter(
+    "wam_tpu_serve_fallback_batches_total",
+    "batches served by the degraded CPU entry", labels=("replica",))
+_c_compiles = _obs_registry.counter(
+    "wam_tpu_serve_compile_total", "serve-entry jit cache misses",
+    labels=("replica",))
+_c_batches = _obs_registry.counter(
+    "wam_tpu_serve_batches_total", "dispatched batches",
+    labels=("replica", "bucket"))
+_g_queue_depth = _obs_registry.gauge(
+    "wam_tpu_serve_queue_depth",
+    "queue depth observed at batch assembly", labels=("replica", "bucket"))
+_g_ema_service = _obs_registry.gauge(
+    "wam_tpu_serve_ema_service_seconds",
+    "per-bucket EMA batch service time (routing signal)",
+    labels=("replica", "bucket"))
+_h_latency = _obs_registry.histogram(
+    "wam_tpu_serve_latency_seconds", "submit->result request latency",
+    labels=("replica",))
+_h_service = _obs_registry.histogram(
+    "wam_tpu_serve_service_seconds", "dispatch->harvest batch service time",
+    labels=("replica",))
+_g_warmup = _obs_registry.gauge(
+    "wam_tpu_fleet_warmup_seconds", "per-bucket warmup wall time",
+    labels=("replica", "bucket"))
+_c_deaths = _obs_registry.counter(
+    "wam_tpu_fleet_replica_deaths_total", "replicas marked dead fleet-wide")
+_g_fleet_compiles = _obs_registry.gauge(
+    "wam_tpu_fleet_compile_count",
+    "compile_count per replica as of the last fleet_summary()",
+    labels=("replica",))
 
 # Per-bucket EMA service-time seed until the first batch of that bucket
 # lands: the retry-after / routing estimate for a never-served bucket.
@@ -68,7 +126,9 @@ class ServeMetrics:
     def __init__(self, replica_id=None):
         self._lock = threading.Lock()
         self.replica_id = replica_id
-        self.stages = StageTimer()
+        self._rl = _rlabel(replica_id)  # obs registry replica label
+        # span_prefix threads batch-stage intervals into request traces
+        self.stages = StageTimer(span_prefix="serve.")
         self.compile_count = 0  # jit cache misses (serve_entry on_trace hook)
         self.submitted = 0
         self.completed = 0
@@ -91,33 +151,41 @@ class ServeMetrics:
         i.e. once per (bucket) cache miss."""
         with self._lock:
             self.compile_count += 1
+        _c_compiles.inc(replica=self._rl)
 
     def note_submit(self, n: int = 1) -> None:
         with self._lock:
             self.submitted += n
+        _c_submitted.inc(n, replica=self._rl)
 
     def note_reject(self) -> None:
         with self._lock:
             self.rejected += 1
+        _c_rejected.inc(replica=self._rl)
 
     def note_expired(self, n: int = 1) -> None:
         with self._lock:
             self.expired += n
+        _c_expired.inc(n, replica=self._rl)
 
     def note_failed(self, n: int = 1) -> None:
         with self._lock:
             self.failed += n
+        _c_failed.inc(n, replica=self._rl)
 
     def note_fallback(self) -> None:
         with self._lock:
             self.fallbacks += 1
+        _c_fallbacks.inc(replica=self._rl)
 
     def note_warmup(self, bucket_shape: tuple[int, ...], seconds: float) -> None:
         """One bucket's `start()` warmup (trace + compile + first dispatch),
         recorded per bucket so the ledger shows cold-start cost bucket by
         bucket (ROADMAP item 2's first measurement)."""
+        key = bucket_key(bucket_shape)
         with self._lock:
-            self.warmup_s[bucket_key(bucket_shape)] = float(seconds)
+            self.warmup_s[key] = float(seconds)
+        _g_warmup.set(float(seconds), replica=self._rl, bucket=key)
 
     def ema_service_s(self, bucket_shape=None):
         """Per-bucket EMA batch service time — the retry-after and fleet
@@ -166,6 +234,15 @@ class ServeMetrics:
             if self.replica_id is not None:
                 row["replica_id"] = self.replica_id
             self.batch_rows.append(row)
+        # registry publication (second sink, outside the accumulator lock)
+        _c_completed.inc(len(latencies_s), replica=self._rl)
+        _c_batches.inc(replica=self._rl, bucket=key)
+        _g_queue_depth.set(queue_depth, replica=self._rl, bucket=key)
+        _g_ema_service.set(self._ema_service_s[key], replica=self._rl,
+                           bucket=key)
+        _h_service.observe(service_s, replica=self._rl)
+        for lat in latencies_s:
+            _h_latency.observe(lat, replica=self._rl)
 
     # -- reporting ----------------------------------------------------------
 
@@ -214,10 +291,15 @@ class ServeMetrics:
         """Back-compat alias for `snapshot()` (the v1 name)."""
         return self.snapshot()
 
-    def emit(self, writer: JsonlWriter, config: dict | None = None) -> dict:
+    def emit(self, writer: JsonlWriter, config: dict | None = None,
+             obs_snapshot: bool = True) -> dict:
         """Flush batch rows + the summary row to a JSONL ledger; returns the
         summary. ``config`` is attached to the summary row the way
-        `results.MetricRecord` carries its config."""
+        `results.MetricRecord` carries its config. Unless suppressed
+        (``obs_snapshot=False`` — `FleetMetrics.emit` writes ONE fleet-wide
+        snapshot instead of N per-replica copies), an ``obs_snapshot`` row
+        with the registry's flattened values follows the summary — the
+        periodic registry-in-the-ledger record."""
         with self._lock:
             rows = list(self.batch_rows)
         for row in rows:
@@ -226,7 +308,22 @@ class ServeMetrics:
         if config is not None:
             summary["config"] = config
         writer.write(summary)
+        if obs_snapshot:
+            write_obs_snapshot(writer)
         return summary
+
+
+def write_obs_snapshot(writer: JsonlWriter) -> dict:
+    """One ``obs_snapshot`` ledger row: the registry's flattened values at
+    this instant (a NEW row kind — existing v2 rows are untouched)."""
+    row = {
+        "metric": "obs_snapshot",
+        "schema_version": SCHEMA_VERSION,
+        "registry": _obs_registry.collect(),
+        "timestamp": time.time(),
+    }
+    writer.write(row)
+    return row
 
 
 class FleetMetrics:
@@ -255,6 +352,7 @@ class FleetMetrics:
             self.deaths.append(
                 {"replica_id": replica_id, "reason": reason, "timestamp": time.time()}
             )
+        _c_deaths.inc()
 
     def fleet_summary(self) -> dict:
         """The aggregate row: fleet throughput is completed requests (replica
@@ -279,6 +377,12 @@ class FleetMetrics:
             failed += s["failed"]
             compile_count += s["compile_count"]
             latencies.extend(m.latency_sample())
+            # registry publication: compile/warmup state as of this summary
+            # (idempotent gauge sets; warmup gauges were set at note_warmup
+            # time, re-set here so post-reset summaries repopulate them)
+            _g_fleet_compiles.set(s["compile_count"], replica=_rlabel(rid))
+            for bucket, secs in s["warmup_s"].items():
+                _g_warmup.set(secs, replica=_rlabel(rid), bucket=bucket)
             per_replica.append(
                 {
                     "replica_id": rid,
@@ -327,11 +431,13 @@ class FleetMetrics:
             replicas = dict(self._replicas)
         for rid in sorted(replicas, key=str):
             cfg = (replica_configs or {}).get(rid)
-            replicas[rid].emit(writer, config=cfg)
+            replicas[rid].emit(writer, config=cfg, obs_snapshot=False)
         if self.oversize.batch_rows:
-            self.oversize.emit(writer, config={"oversize": True})
+            self.oversize.emit(writer, config={"oversize": True},
+                               obs_snapshot=False)
         summary = self.fleet_summary()
         if config is not None:
             summary["config"] = config
         writer.write(summary)
+        write_obs_snapshot(writer)
         return summary

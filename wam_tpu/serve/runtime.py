@@ -54,8 +54,10 @@ from dataclasses import dataclass, field
 import jax
 import numpy as np
 
+from wam_tpu.obs import sentinel as obs_sentinel
+from wam_tpu.obs import tracing as obs_tracing
 from wam_tpu.pipeline.stager import put_committed
-from wam_tpu.serve.buckets import Bucket, BucketTable, pad_item
+from wam_tpu.serve.buckets import Bucket, BucketTable, bucket_key, pad_item
 from wam_tpu.serve.metrics import ServeMetrics
 
 __all__ = [
@@ -97,6 +99,10 @@ class _Request:
     t_submit: float
     deadline: float | None  # perf_counter timestamp, None = no deadline
     future: Future = field(default_factory=Future)
+    # obs trace identity: (trace_id, span_id) this request's spans parent
+    # to — captured at submit (the fleet router's context, or a fresh root
+    # this server starts for direct submits)
+    ctx: tuple | None = None
 
 
 @dataclass
@@ -248,7 +254,14 @@ class AttributionServer:
 
             def _warm(bucket: Bucket) -> None:
                 t0 = time.perf_counter()
-                self._sync_dispatch(*self._stage_zeros(bucket))
+                # compile-sentinel attribution: traces fired here are
+                # expected warmup compiles, not steady-state retraces
+                with obs_sentinel.label(
+                    replica=self.replica_id,
+                    bucket=bucket_key(bucket.shape),
+                    phase="warmup",
+                ):
+                    self._sync_dispatch(*self._stage_zeros(bucket))
                 self.metrics.note_warmup(bucket.shape, time.perf_counter() - t0)
 
             if len(self.table) == 1:
@@ -323,6 +336,21 @@ class AttributionServer:
         else:
             deadline = now + deadline_ms / 1e3
         req = _Request(x, y, bucket, now, deadline)
+        if obs_tracing._STATE.enabled:
+            ctx = obs_tracing.current_context()
+            if ctx is None:
+                # direct (fleet-less) submit: this server owns the request
+                # root span, ended when the future resolves either way
+                root = obs_tracing.start_span(
+                    "request", cat="serve",
+                    bucket="x".join(str(d) for d in bucket.shape),
+                    replica=self.replica_id)
+                ctx = root.context
+                req.future.add_done_callback(
+                    lambda f: root.end(
+                        error=type(f.exception()).__name__
+                        if f.exception() else None))
+            req.ctx = ctx
         with self._cond:
             if self._closed or not self._started:
                 raise ServerClosedError("server is not accepting requests")
@@ -518,7 +546,11 @@ class AttributionServer:
             staged = put_committed((xs, ys), self._device)
         t0 = time.perf_counter()
         try:
-            with self.metrics.stages.stage("dispatch"):
+            with obs_sentinel.label(
+                replica=self.replica_id,
+                bucket=bucket_key(bucket.shape),
+                phase="serve",
+            ), self.metrics.stages.stage("dispatch"):
                 out = self._call_entry(*staged)
         except Exception:
             try:
@@ -554,6 +586,20 @@ class AttributionServer:
                 for i, r in enumerate(live):
                     row = jax.tree_util.tree_map(lambda a: np.asarray(a)[i], out)
                     r.future.set_result(row)
+            if obs_tracing._STATE.enabled:
+                # retroactive per-request phases: the worker only knows a
+                # request's queue wait once its batch pops, so the spans are
+                # recorded from timestamps already in hand — together they
+                # tile submit->done, the trace_report coverage contract
+                bkey = bucket_key(batch.bucket.shape)
+                for r in live:
+                    obs_tracing.record_span(
+                        "queue_wait", r.t_submit, batch.t0, parent=r.ctx,
+                        cat="serve", bucket=bkey, replica=self.replica_id)
+                    obs_tracing.record_span(
+                        "service", batch.t0, done, parent=r.ctx,
+                        cat="serve", bucket=bkey, replica=self.replica_id,
+                        n_real=n_real)
             self.metrics.note_batch(
                 bucket_shape=batch.bucket.shape,
                 n_real=n_real,
