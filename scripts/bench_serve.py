@@ -132,6 +132,18 @@ def run_bench(cfg, args, n_fleet: int):
         )
         entry_factory = lambda rid, m: wam.serve_entry(on_trace=m.note_compile)
 
+    # health plane (ServeConfig defaults: health on, no HBM cap, no SLO)
+    health_cfg = (
+        obs.HealthConfig(
+            quarantine_after=cfg.health_quarantine_n,
+            recovery_s=cfg.health_recovery_s,
+        )
+        if cfg.health
+        else None
+    )
+    mem_budget = int(cfg.hbm_budget_mb * 2**20) or None
+    slo_policy = cfg.slo or None
+
     metrics_path = cfg.metrics_path or "results/bench_serve.jsonl"
     if n_fleet == 1:
         # single-chip serving stays the plain server — the fleet layer must
@@ -149,6 +161,9 @@ def run_bench(cfg, args, n_fleet: int):
             metrics=metrics,
             metrics_path=metrics_path,
             pipelined=cfg.pipelined,
+            health=health_cfg,
+            slo=slo_policy,
+            memory=mem_budget,
         )
         fleet_metrics = None
     else:
@@ -168,6 +183,9 @@ def run_bench(cfg, args, n_fleet: int):
             oversize=cfg.oversize,
             pipelined=cfg.pipelined,
             prom_port=getattr(args, "prom_port", None) or None,
+            health=health_cfg,
+            slo=slo_policy,
+            memory_budget=mem_budget,
         )
         if server.prom_server is not None:
             print(f"/metrics on port {server.prom_server.server_port}")
@@ -232,34 +250,82 @@ def run_bench(cfg, args, n_fleet: int):
 
 
 def _obs_overhead_bench(cfg, args, sweep):
-    """S1 overhead guard: drive the same workload with the obs layer off and
-    on and compare served throughput. The disabled path is the baseline —
-    its cost is one predicate per span/counter call — so the ON-vs-OFF delta
-    bounds the whole layer's tax. Passes unless the enabled run is grossly
-    (>20%) slower: single-machine toy throughput is noisy at the few-percent
-    level, and a hard 2% gate would flake; the printed delta is the honest
-    number for the ledger."""
+    """S1 overhead guard: drive the same workload with the obs layer off,
+    on, and on WITH the health plane (per-batch health vector + SLO
+    tracking) and compare served throughput. The disabled path is the
+    baseline — its cost is one predicate per span/counter call — so the
+    deltas bound the whole layer's tax and the health plane's increment on
+    top of it. Passes unless an enabled run is grossly (>20%) slower:
+    single-machine toy throughput is noisy at the few-percent level, and a
+    hard 2% gate would flake; the printed deltas are the honest numbers
+    for the ledger."""
+    import dataclasses
+
     from wam_tpu import obs
 
     args.toy = True  # the guard is a smoke-scale comparison by design
     n = sweep[0] if sweep else 1
     rates = {}
-    for mode in ("off", "on"):
-        obs.configure(enabled=mode == "on")
-        summary, errors = run_bench(cfg, args, n)
+    modes = (
+        ("off", False, dataclasses.replace(cfg, health=False)),
+        ("on", True, dataclasses.replace(cfg, health=False)),
+        ("on+health", True,
+         dataclasses.replace(cfg, health=True, slo="p99_ms=1000")),
+    )
+    for mode, enabled, mode_cfg in modes:
+        obs.configure(enabled=enabled)
+        summary, errors = run_bench(mode_cfg, args, n)
         if errors:
             print(f"obs-bench ({mode}): {len(errors)} request errors",
                   file=sys.stderr)
             return 1
         rates[mode] = summary["attributions_per_s_load"]
         print(f"obs={mode}: {rates[mode]:.1f} attributions/s")
-    delta = (rates["off"] - rates["on"]) / rates["off"] if rates["off"] else 0.0
-    print(f"obs overhead: {delta * 100:+.2f}% throughput delta (on vs off)")
-    if delta > 0.20:
-        print("obs overhead exceeds the 20% gross-regression gate",
-              file=sys.stderr)
-        return 1
+    base = rates["off"]
+    for mode in ("on", "on+health"):
+        delta = (base - rates[mode]) / base if base else 0.0
+        print(f"obs overhead ({mode} vs off): {delta * 100:+.2f}% "
+              "throughput delta")
+        if delta > 0.20:
+            print(f"obs overhead ({mode}) exceeds the 20% gross-regression "
+                  "gate", file=sys.stderr)
+            return 1
     return 0
+
+
+def _print_slo_report(path):
+    """Per-bucket SLO table from a serve ledger's ``slo_status`` rows (the
+    LAST row per replica wins — `ServeMetrics.emit` writes one per drain)."""
+    latest = {}
+    try:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    row = json.loads(line)
+                except ValueError:
+                    continue
+                if row.get("metric") == "slo_status":
+                    latest[str(row.get("replica_id"))] = row
+    except OSError:
+        print(f"slo-report: no ledger at {path}", file=sys.stderr)
+        return
+    if not latest:
+        print(f"slo-report: no slo_status rows in {path} "
+              "(was the server built with an --slo policy?)", file=sys.stderr)
+        return
+    print(f"\nSLO report ({path})")
+    hdr = (f"{'replica':>8} {'bucket':>14} {'n':>5} {'p99_ms':>8} "
+           f"{'err%':>6} {'health%':>8} {'burn':>6}")
+    print(hdr)
+    print("-" * len(hdr))
+    for rid in sorted(latest):
+        for bkey, st in sorted(latest[rid].get("buckets", {}).items()):
+            print(f"{rid:>8} {bkey:>14} {st['n']:>5} "
+                  f"{st['p99_s'] * 1e3:>8.2f} {st['error_rate'] * 100:>6.2f} "
+                  f"{st['health_rate'] * 100:>8.2f} {st['burn_rate']:>6.2f}")
 
 
 def _pre_scan_fleet(argv):
@@ -315,7 +381,10 @@ def main():
                              "(0 = off; pass 0<port or use an ephemeral one)")
     parser.add_argument("--obs-bench", action="store_true",
                         help="overhead guard: run the toy workload with obs "
-                             "off then on and report the throughput delta")
+                             "off / on / on+health and report the deltas")
+    parser.add_argument("--slo-report", action="store_true",
+                        help="print the per-bucket SLO table from the "
+                             "ledger's slo_status rows after the run")
     from wam_tpu.config import ServeConfig, add_config_args, config_from_args
 
     add_config_args(parser, ServeConfig)
@@ -394,6 +463,8 @@ def main():
         with open(args.emit, "w") as f:
             json.dump(payload, f, indent=2)
         print(f"emitted: {args.emit}")
+    if args.slo_report:
+        _print_slo_report(cfg.metrics_path or "results/bench_serve.jsonl")
     if any_errors:
         print(f"{len(any_errors)} request errors, first: {any_errors[0]}",
               file=sys.stderr)

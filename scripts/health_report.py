@@ -1,0 +1,174 @@
+"""Health-plane report from a serve JSONL ledger.
+
+Reads the ledger written by ``bench_serve`` / `ServeMetrics.emit`
+(``--metrics-path``) and prints the health plane's three surfaces side by
+side:
+
+- **numeric health** — the ``wam_tpu_health_*`` series captured in the
+  ledger's ``obs_snapshot`` row (batches checked, non-finite batches and
+  values, saturation fraction, grad-norm / max-abs gauges, quarantine
+  state per replica);
+- **memory** — the ``wam_tpu_memory_*`` series (per-bucket HBM watermarks,
+  live bytes, budget, admission rejects, staged bytes);
+- **SLO** — the per-bucket ``slo_status`` rows (window size, p99, error /
+  health rate, burn-rate against the declared objectives).
+
+    python scripts/health_report.py results/bench_serve.jsonl
+    python scripts/health_report.py results/bench_serve.jsonl --json
+
+``--json`` emits the joined report as one JSON object instead of tables
+(for dashboards / CI artifacts). Exit 1 when any replica is quarantined or
+any bucket's burn-rate exceeds 1.0 — the report doubles as a cheap gate.
+"""
+
+import argparse
+import json
+import os
+import re
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+_SERIES = re.compile(r'^(?P<name>[a-zA-Z0-9_:]+)(?:\{(?P<labels>.*)\})?$')
+_LABEL = re.compile(r'(\w+)="((?:[^"\\]|\\.)*)"')
+
+
+def parse_series(key: str) -> tuple[str, dict]:
+    """Split a ``name{label="v",...}`` registry-collect key into
+    (name, labels) — the obs_snapshot row's flat-key format."""
+    m = _SERIES.match(key)
+    if not m:
+        return key, {}
+    labels = {
+        k: v.replace('\\"', '"').replace("\\\\", "\\")
+        for k, v in _LABEL.findall(m.group("labels") or "")
+    }
+    return m.group("name"), labels
+
+
+def load_ledger(path: str) -> tuple[dict, list[dict]]:
+    """(last obs_snapshot registry, every slo_status row) from a ledger."""
+    registry: dict = {}
+    slo_rows: list[dict] = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                row = json.loads(line)
+            except ValueError:
+                continue
+            metric = row.get("metric")
+            if metric == "obs_snapshot":
+                registry = row.get("registry", {})  # last snapshot wins
+            elif metric == "slo_status":
+                slo_rows.append(row)
+    return registry, slo_rows
+
+
+def series_table(registry: dict, prefix: str) -> list[dict]:
+    """Rows for every registry series under ``prefix``, labels unpacked."""
+    rows = []
+    for key, value in registry.items():
+        name, labels = parse_series(key)
+        if name.startswith(prefix):
+            rows.append({"series": name[len(prefix):], **labels,
+                         "value": value})
+    rows.sort(key=lambda r: (r["series"], r.get("replica", ""),
+                             r.get("bucket", "")))
+    return rows
+
+
+def _print_series(title: str, rows: list[dict]) -> None:
+    print(f"\n{title}")
+    if not rows:
+        print("  (no series in the ledger's obs_snapshot)")
+        return
+    hdr = f"  {'series':<28} {'replica':>8} {'bucket':>14} {'source':>7} {'value':>14}"
+    print(hdr)
+    print("  " + "-" * (len(hdr) - 2))
+    for r in rows:
+        val = r["value"]
+        sval = f"{val:,.0f}" if float(val).is_integer() else f"{val:.6g}"
+        print(f"  {r['series']:<28} {r.get('replica', '-'):>8} "
+              f"{r.get('bucket', '-'):>14} {r.get('source', '-'):>7} {sval:>14}")
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("ledger", help="serve JSONL ledger "
+                        "(bench_serve --metrics-path / ServeMetrics.emit)")
+    parser.add_argument("--json", action="store_true",
+                        help="emit one JSON object instead of tables")
+    args = parser.parse_args()
+
+    try:
+        registry, slo_rows = load_ledger(args.ledger)
+    except OSError as e:
+        print(f"cannot read ledger: {e}", file=sys.stderr)
+        return 1
+
+    health = series_table(registry, "wam_tpu_health_")
+    memory = series_table(registry, "wam_tpu_memory_")
+
+    # last slo_status per replica wins (emit writes one per drain)
+    latest_slo: dict = {}
+    for row in slo_rows:
+        latest_slo[str(row.get("replica_id"))] = row
+
+    quarantined = [
+        r for r in health
+        if r["series"] == "replica_quarantined" and r["value"] > 0
+    ]
+    burning = [
+        (rid, bkey, st)
+        for rid, row in sorted(latest_slo.items())
+        for bkey, st in sorted(row.get("buckets", {}).items())
+        if st.get("burn_rate", 0.0) > 1.0
+    ]
+
+    if args.json:
+        print(json.dumps({
+            "ledger": args.ledger,
+            "health": health,
+            "memory": memory,
+            "slo": latest_slo,
+            "quarantined_replicas": [r.get("replica") for r in quarantined],
+            "burning_buckets": [
+                {"replica": rid, "bucket": bkey, **st}
+                for rid, bkey, st in burning
+            ],
+        }, indent=2))
+    else:
+        _print_series("numeric health (wam_tpu_health_*)", health)
+        _print_series("memory accounting (wam_tpu_memory_*)", memory)
+        print("\nSLO status (slo_status rows)")
+        if not latest_slo:
+            print("  (no slo_status rows — server built without an SLO policy)")
+        else:
+            hdr = (f"  {'replica':>8} {'bucket':>14} {'n':>5} {'p99_ms':>8} "
+                   f"{'err%':>6} {'health%':>8} {'burn':>6}")
+            print(hdr)
+            print("  " + "-" * (len(hdr) - 2))
+            for rid, row in sorted(latest_slo.items()):
+                for bkey, st in sorted(row.get("buckets", {}).items()):
+                    print(f"  {rid:>8} {bkey:>14} {st['n']:>5} "
+                          f"{st['p99_s'] * 1e3:>8.2f} "
+                          f"{st['error_rate'] * 100:>6.2f} "
+                          f"{st['health_rate'] * 100:>8.2f} "
+                          f"{st['burn_rate']:>6.2f}")
+
+    if quarantined or burning:
+        for r in quarantined:
+            print(f"GATE: replica {r.get('replica')} is quarantined",
+                  file=sys.stderr)
+        for rid, bkey, st in burning:
+            print(f"GATE: replica {rid} bucket {bkey} burn-rate "
+                  f"{st['burn_rate']:.2f} > 1.0", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

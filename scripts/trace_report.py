@@ -10,10 +10,19 @@ should cover ~all of it; a gap means an uninstrumented phase).
 
     python scripts/trace_report.py results/trace.json
     python scripts/trace_report.py results/trace.json --min-coverage 0.95
+    python scripts/trace_report.py results/trace.json \\
+        --compiles results/bench_serve.jsonl
 
 ``--min-coverage`` turns the coverage line into a gate (exit 1 below the
 threshold) — the CI teeth for the "spans cover >=95% of request latency"
 acceptance bar.
+
+``--compiles LEDGER`` joins the serve ledger's ``compile_event`` rows (the
+compile sentinel's labeled trace records, written by bench_serve) into the
+report: the phase table gains a ``compiles`` column (sentinel phase →
+span-name mapping below), and a standalone section breaks every compile
+down by phase / entry kind / bucket / origin — where the retraces actually
+landed, next to where the time went.
 """
 
 import argparse
@@ -24,6 +33,61 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 ROOT_NAME = "request"
+
+# compile sentinel label phase -> the span name that phase's device time
+# lands under in the trace (the join key for the `compiles` column)
+COMPILE_PHASE_TO_SPAN = {
+    "serve": "service",
+    "oversize": "oversize_chunk",
+    "fan": "fan.dispatch",
+}
+
+
+def load_compile_events(path: str) -> list[dict]:
+    """``compile_event`` rows from a serve JSONL ledger (bench_serve writes
+    one per sentinel-recorded trace; non-JSON / other-metric lines skip)."""
+    rows = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                row = json.loads(line)
+            except ValueError:
+                continue
+            if row.get("metric") == "compile_event":
+                rows.append(row)
+    return rows
+
+
+def compiles_by_span(compile_rows: list[dict]) -> dict[str, int]:
+    """Compile counts keyed by the span name each sentinel phase maps to
+    (unknown phases key under their own name, so nothing silently drops)."""
+    out: dict[str, int] = {}
+    for row in compile_rows:
+        phase = str(row.get("phase") or "?")
+        span = COMPILE_PHASE_TO_SPAN.get(phase, phase)
+        out[span] = out.get(span, 0) + 1
+    return out
+
+
+def compile_table(compile_rows: list[dict]) -> list[dict]:
+    """Per (phase, entry kind, bucket, origin) compile counts, most first."""
+    groups: dict[tuple, int] = {}
+    for row in compile_rows:
+        key = (
+            str(row.get("phase") or "-"),
+            str(row.get("entry_kind") or "-"),
+            str(row.get("bucket") or "-"),
+            str(row.get("origin") or "-"),
+        )
+        groups[key] = groups.get(key, 0) + 1
+    return [
+        {"phase": k[0], "entry_kind": k[1], "bucket": k[2], "origin": k[3],
+         "count": n}
+        for k, n in sorted(groups.items(), key=lambda kv: (-kv[1], kv[0]))
+    ]
 
 
 def load_events(path: str) -> list[dict]:
@@ -105,6 +169,10 @@ def main() -> int:
     parser.add_argument("--min-coverage", type=float, default=None, metavar="FRAC",
                         help="exit 1 when mean request span coverage is below "
                              "this fraction (e.g. 0.95)")
+    parser.add_argument("--compiles", type=str, default=None, metavar="LEDGER",
+                        help="serve JSONL ledger whose compile_event rows "
+                             "get joined into the phase table + a per-"
+                             "phase compile breakdown section")
     args = parser.parse_args()
 
     events = load_events(args.trace)
@@ -112,15 +180,47 @@ def main() -> int:
         print("no complete (ph:X) events in trace", file=sys.stderr)
         return 1
 
+    compile_rows: list[dict] = []
+    span_compiles: dict[str, int] = {}
+    if args.compiles:
+        try:
+            compile_rows = load_compile_events(args.compiles)
+        except OSError as e:
+            print(f"cannot read --compiles ledger: {e}", file=sys.stderr)
+            return 1
+        span_compiles = compiles_by_span(compile_rows)
+
     rows = phase_table(events)
     header = f"{'phase':<20} {'count':>6} {'total ms':>10} {'mean ms':>9} " \
              f"{'p50 ms':>9} {'p99 ms':>9} {'% of req':>9}"
+    if args.compiles:
+        header += f" {'compiles':>9}"
     print(header)
     print("-" * len(header))
     for r in rows:
-        print(f"{r['phase']:<20} {r['count']:>6} {r['total_ms']:>10.2f} "
-              f"{r['mean_ms']:>9.3f} {r['p50_ms']:>9.3f} {r['p99_ms']:>9.3f} "
-              f"{r['pct_of_request']:>8.1f}%")
+        line = (f"{r['phase']:<20} {r['count']:>6} {r['total_ms']:>10.2f} "
+                f"{r['mean_ms']:>9.3f} {r['p50_ms']:>9.3f} {r['p99_ms']:>9.3f} "
+                f"{r['pct_of_request']:>8.1f}%")
+        if args.compiles:
+            line += f" {span_compiles.get(r['phase'], 0):>9}"
+        print(line)
+
+    if args.compiles:
+        unmatched = set(span_compiles) - {r["phase"] for r in rows}
+        print(f"\ncompile events: {len(compile_rows)} "
+              f"({args.compiles})")
+        if compile_rows:
+            chdr = (f"{'phase':<10} {'entry kind':<14} {'bucket':<14} "
+                    f"{'origin':<18} {'count':>6}")
+            print(chdr)
+            print("-" * len(chdr))
+            for c in compile_table(compile_rows):
+                print(f"{c['phase']:<10} {c['entry_kind']:<14} "
+                      f"{c['bucket']:<14} {c['origin']:<18} {c['count']:>6}")
+        if unmatched:
+            # typically warmup: those compiles predate any request span
+            print("no matching trace span for phases: "
+                  + ", ".join(sorted(unmatched)))
 
     cov = request_coverage(events)
     if cov:

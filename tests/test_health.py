@@ -1,0 +1,471 @@
+"""Health plane (`wam_tpu/obs/{health,memory,slo}.py` + serve wiring):
+on-device numeric-health monitors, HBM memory accounting, and the
+SLO/error-budget engine wired into fleet admission.
+
+Acceptance contracts pinned here:
+
+- the fan engine's one-fetch invariant holds WITH health piggybacking on
+  (`fetch_scope` counts exactly 1 — the 6-float vector rides the result
+  fetch);
+- a warm 2-replica fleet with health-fused jitted entries serves a mixed
+  stream under `assert_no_retrace` (the health leaf is part of the same
+  compiled program, and `batch_stats`' structural jit is invisible to the
+  sentinel by design);
+- a poisoned (NaN-emitting) replica is quarantined after N consecutive
+  non-finite batches and routed around with NO request loss; un-poisoning
+  restores it within the recovery window;
+- ``slo_status`` ledger rows round-trip EXACTLY against the
+  ``wam_tpu_slo_*`` registry gauges (same floats, two sinks);
+- cold-bucket admission rejects with ``retry_after`` when the projected
+  watermark exceeds the budget (simulated-memory ``in_use_fn``), and the
+  bucket admits freely once warm.
+
+Runs on the virtual 8-device CPU mesh the conftest forces."""
+
+import json
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from conftest import need_devices
+from wam_tpu import obs
+from wam_tpu.obs import health as obs_health
+from wam_tpu.obs import sentinel, slo as obs_slo
+from wam_tpu.obs.health import HealthConfig, HealthMonitor
+from wam_tpu.obs.memory import MemoryBudget, estimate_entry_bytes
+from wam_tpu.obs.registry import registry
+
+
+@pytest.fixture(autouse=True)
+def obs_clean():
+    """Every test starts from zero obs state and leaves tracing enabled."""
+    obs.configure(enabled=True, ring_size=4096)
+    obs.reset()
+    yield
+    obs.configure(enabled=True, ring_size=4096)
+    obs.reset()
+
+
+# -- health_stats (device side) ----------------------------------------------
+
+
+def test_health_stats_vector_layout():
+    import jax.numpy as jnp
+
+    vec = np.asarray(obs_health.health_stats(
+        {"m": jnp.asarray([0.5, -1.0, jnp.nan, jnp.inf])}))
+    assert vec.shape == (obs_health.HEALTH_VEC_SIZE,)
+    s = obs_health.summarize(vec)
+    assert s["nonfinite"] == 2 and s["total"] == 4
+    assert not s["finite"]
+    # NaN must NOT leak into the saturation count (abs>=thr is False for
+    # NaN); |-1.0| and |inf| are at/above the threshold and do count
+    assert vec[2] == 2.0
+
+
+def test_health_stats_clean_batch_and_grad_pooling():
+    import jax.numpy as jnp
+
+    out = jnp.asarray([0.25, 0.5])
+    grads = {"w": jnp.asarray([3.0, 4.0])}
+    s = obs_health.summarize(obs_health.health_stats(out, grads))
+    assert s["finite"] and s["total"] == 4  # output + gradient elements pool
+    assert s["grad_norm"] == pytest.approx(5.0)  # sqrt(9 + 16)
+    # combine path (what health-fused engine entries emit) agrees
+    combined = obs_health.combine_output_grads(
+        obs_health.health_stats(out), obs_health.health_stats(grads))
+    s2 = obs_health.summarize(np.asarray(combined))
+    assert s2["total"] == 4 and s2["grad_norm"] == pytest.approx(5.0)
+
+
+def test_health_monitor_quarantine_and_probation():
+    mon = HealthMonitor(HealthConfig(quarantine_after=2, recovery_s=10.0))
+    bad = np.array([1, 4, 0, 4, np.nan, np.nan], np.float32)
+    good = np.array([0, 4, 0, 4, 0.5, 1.0], np.float32)
+    assert mon.note(good, now=0.0) and mon.ok(now=0.0)
+    assert not mon.note(bad, now=1.0)
+    assert mon.ok(now=1.0)  # one bad batch is not a quarantine
+    mon.note(bad, now=2.0)
+    assert mon.quarantined and not mon.ok(now=2.0)
+    assert mon.ok(now=12.5)  # probation: recovery_s elapsed
+    mon.note(bad, now=13.0)  # a bad probe re-arms the recovery clock
+    assert not mon.ok(now=14.0)
+    mon.note(good, now=15.0)  # one healthy batch clears it entirely
+    assert not mon.quarantined and mon.ok(now=15.0)
+
+
+# -- fan piggyback (one-fetch invariant) --------------------------------------
+
+
+def test_fan_single_fetch_with_health_on():
+    import jax.numpy as jnp
+
+    from wam_tpu.evalsuite.fan import fan_runner, fetch_scope, run_fan
+
+    assert obs_health.fan_health_enabled()
+    runner = fan_runner(lambda x: x * 2.0)
+    with fetch_scope() as fs:
+        out = run_fan(runner, (jnp.ones((8,), jnp.float32),))
+    assert fs.count == 1  # the stats rode the metric's single fetch
+    np.testing.assert_array_equal(out, np.full((8,), 2.0, np.float32))
+    assert registry.counter("wam_tpu_health_checks_total").value(
+        source="fan", replica="-") == 1.0
+
+
+def test_fan_health_gates_off_with_obs():
+    import jax.numpy as jnp
+
+    from wam_tpu.evalsuite.fan import fan_runner, run_fan
+
+    obs.configure(enabled=False)
+    try:
+        runner = fan_runner(lambda x: x + 1.0)
+        run_fan(runner, (jnp.zeros((4,), jnp.float32),))
+        assert not obs_health.fan_health_enabled()
+    finally:
+        obs.configure(enabled=True)
+    assert registry.counter("wam_tpu_health_checks_total").value(
+        source="fan", replica="-") == 0.0
+
+
+# -- serve integration --------------------------------------------------------
+
+
+class _PoisonEntry:
+    """Fake serving entry whose output turns NaN while ``poisoned`` is set.
+    Numpy in/out — exercises the worker's post-hoc `batch_stats` dispatch
+    path (the one fake/user entries take)."""
+
+    def __init__(self):
+        self.poisoned = threading.Event()
+
+    def __call__(self, xs, ys):
+        out = np.asarray(xs, np.float32) * 2.0
+        if self.poisoned.is_set():
+            out = out + np.nan
+        return out
+
+
+def test_single_server_quarantine_and_recovery():
+    from wam_tpu.serve import AttributionServer
+
+    entry = _PoisonEntry()
+    server = AttributionServer(
+        entry, [(4,)], max_batch=1, max_wait_ms=0.0, warmup=False,
+        health=HealthConfig(quarantine_after=2, recovery_s=0.05),
+    )
+    x = np.ones((4,), np.float32)
+    try:
+        server.attribute(x, 0)
+        assert server.health_ok()
+        entry.poisoned.set()
+        for _ in range(2):
+            # poisoned batches still RESOLVE (NaN result, no exception) —
+            # quarantine is a routing signal, not a request failure
+            assert np.isnan(server.attribute(x, 0)).all()
+        assert not server.health_ok()
+        entry.poisoned.clear()
+        time.sleep(0.06)
+        assert server.health_ok()  # probation window reached
+        np.testing.assert_array_equal(server.attribute(x, 0), x * 2.0)
+        assert server.health_ok()
+        assert not server._health.quarantined  # fully cleared, not probation
+        d = server.describe()["health"]
+        assert d["nonfinite_batches"] == 2 and not d["quarantined"]
+    finally:
+        server.close()
+
+
+def test_fleet_routes_around_poisoned_replica_no_request_loss():
+    need_devices(2)
+    from wam_tpu.serve import FleetMetrics, FleetServer
+
+    entries = {}
+
+    def factory(rid, m):
+        entries[rid] = _PoisonEntry()
+        return entries[rid]
+
+    metrics = FleetMetrics()
+    fleet = FleetServer(
+        factory, [(4,)], replicas=2, max_batch=1, max_wait_ms=0.0,
+        warmup=False, metrics=metrics,
+        health=HealthConfig(quarantine_after=2, recovery_s=0.05),
+    )
+    x = np.ones((4,), np.float32)
+    try:
+        # idle-tie routing lands on replica 0 (deterministic rid tie-break);
+        # poison it and drive sequentially so each health verdict is
+        # recorded before the next routing decision
+        entries[0].poisoned.set()
+        results = [fleet.attribute(x, 0) for _ in range(6)]
+        assert len(results) == 6  # NO request loss: every future resolved
+        assert fleet.describe()["quarantined"] == [0]
+        assert fleet.describe()["dead"] == []  # quarantine is NOT death
+        # requests after the quarantine flowed to the healthy replica
+        assert metrics.replica(1).completed >= 4
+        assert all(np.isfinite(r).all() for r in results[-3:])
+
+        # recovery: un-poison, wait out the window, and let probe traffic
+        # through (probation readmits replica 0 to the healthy partition)
+        entries[0].poisoned.clear()
+        time.sleep(0.06)
+        r0 = fleet._replicas[0].server
+        assert r0.health_ok()
+        np.testing.assert_array_equal(r0.attribute(x, 0), x * 2.0)
+        assert fleet.describe()["quarantined"] == []
+    finally:
+        fleet.close()
+
+
+def test_no_retrace_across_warm_health_fused_fleet():
+    """A warm 2-replica fleet with HEALTH-FUSED jitted entries serves a
+    mixed exact/padded stream without a single fresh jit trace — the
+    health vector is a leaf of the already-compiled program."""
+    need_devices(2)
+    from wam_tpu.serve import FleetMetrics, FleetServer
+    from wam_tpu.serve.entry import jit_entry
+
+    fleet = FleetServer(
+        lambda rid, m: jit_entry(
+            lambda xs, ys: xs * 2.0, on_trace=m.note_compile,
+            with_health=True),
+        [(4,), (8,)],
+        replicas=2,
+        max_batch=2,
+        max_wait_ms=0.0,
+        warmup=True,
+        metrics=FleetMetrics(),
+        health=True,
+    )
+    try:
+        warm_traces = sentinel.trace_count()
+        assert warm_traces >= 1
+        with obs.assert_no_retrace():
+            futs = [fleet.submit(np.zeros((n,), np.float32), 0)
+                    for n in (4, 8, 3, 4, 7, 8)]
+            for f in futs:
+                f.result(timeout=30)
+    finally:
+        fleet.close()
+    assert sentinel.trace_count() == warm_traces
+    # the fused path actually ran the health reduction per batch
+    assert registry.counter("wam_tpu_health_checks_total").value(
+        source="serve", replica="0") >= 1.0
+
+
+# -- SLO engine ---------------------------------------------------------------
+
+
+def test_slo_burn_rate_components():
+    tr = obs_slo.SLOTracker("p99_ms=100,error_rate=0.1,health_rate=0.9")
+    for i in range(98):
+        tr.note("4", latency_s=0.01, now=100.0 + i * 1e-3)
+    tr.note("4", latency_s=0.5, now=100.2)  # one request over the p99 target
+    tr.note_error("4", 1, now=100.3)
+    st = tr.bucket_stats("4", now=100.4)
+    assert st["n"] == 100
+    assert st["error_rate"] == pytest.approx(0.01)
+    assert st["health_rate"] == pytest.approx(0.99)
+    # burn components: error 0.01/0.1 = 0.1; health 0.01/0.1 = 0.1;
+    # latency (1/99 over-target)/0.01 ~ 1.0101 -> the max wins
+    assert st["burn_rate"] == pytest.approx((1 / 99) / 0.01)
+    assert tr.penalty_s("4", now=100.4) == pytest.approx(
+        ((1 / 99) / 0.01 - 1.0) * obs_slo.PENALTY_SCALE_S)
+    # entries age out of the rolling window entirely
+    assert tr.bucket_stats("4", now=1000.0)["n"] == 0
+
+
+def test_slo_status_row_roundtrips_registry_exactly(tmp_path):
+    """The slo_status ledger row and the wam_tpu_slo_* gauges are computed
+    from the SAME floats — a JSON round trip of the row must equal the live
+    gauge values bit-for-bit."""
+    from wam_tpu.results import JsonlWriter
+    from wam_tpu.serve.metrics import SCHEMA_VERSION, write_slo_status
+
+    tr = obs_slo.SLOTracker("p99_ms=25,error_rate=0.05", replica_id=0)
+    rng = np.random.default_rng(7)
+    # timestamps must sit inside the rolling window at snapshot time, and
+    # write_slo_status snapshots at the REAL perf_counter clock
+    base = time.perf_counter()
+    for i in range(37):
+        tr.note("1x16x16", latency_s=float(rng.uniform(0.001, 0.06)),
+                ok=True, healthy=bool(i % 5), now=base + i * 1e-3)
+    tr.note_error("1x16x16", 3, now=base + 0.1)
+
+    path = str(tmp_path / "ledger.jsonl")
+    row = write_slo_status(JsonlWriter(path), tr)
+    assert row["schema_version"] == SCHEMA_VERSION
+    back = json.loads(open(path).read().strip())
+    assert back["metric"] == "slo_status"
+    gauges = {
+        "burn_rate": "wam_tpu_slo_burn_rate",
+        "error_rate": "wam_tpu_slo_error_rate",
+        "health_rate": "wam_tpu_slo_health_rate",
+        "p99_s": "wam_tpu_slo_p99_seconds",
+        "n": "wam_tpu_slo_window_requests",
+    }
+    stats = back["buckets"]["1x16x16"]
+    assert stats["n"] == 40
+    for field, gname in gauges.items():
+        live = registry.gauge(gname).value(replica="0", bucket="1x16x16")
+        assert stats[field] == live, (field, stats[field], live)
+
+
+def test_server_emits_slo_status_ledger_row(tmp_path):
+    from wam_tpu.serve import AttributionServer
+
+    path = str(tmp_path / "serve.jsonl")
+    server = AttributionServer(
+        lambda xs, ys: np.asarray(xs) * 2.0, [(4,)],
+        max_batch=2, max_wait_ms=0.0, warmup=False,
+        metrics_path=path, slo="p99_ms=1000,error_rate=0.5",
+    )
+    x = np.zeros((4,), np.float32)
+    try:
+        for _ in range(5):
+            server.attribute(x, 0)
+    finally:
+        server.close()
+    rows = [json.loads(l) for l in open(path) if l.strip()]
+    slo_rows = [r for r in rows if r["metric"] == "slo_status"]
+    assert len(slo_rows) == 1
+    st = slo_rows[0]["buckets"]["4"]
+    assert st["n"] == 5 and st["error_rate"] == 0.0
+    assert st["burn_rate"] == 0.0  # well under both objectives
+    assert slo_rows[0]["objectives"]["*"]["p99_ms"] == 1000.0
+
+
+# -- memory accounting / admission -------------------------------------------
+
+
+def test_memory_cold_bucket_admission_with_simulated_memory():
+    from wam_tpu.serve import AttributionServer, MemoryAdmissionError, QueueFullError
+
+    budget = MemoryBudget(budget_bytes=1024, in_use_fn=lambda: 900,
+                          retry_after_s=2.5, replica_id=None)
+    server = AttributionServer(
+        lambda xs, ys: np.asarray(xs) * 2.0, [(4,)],
+        max_batch=4, max_wait_ms=0.0, warmup=False, memory=budget,
+    )
+    x = np.ones((4,), np.float32)
+    try:
+        # cold bucket: projected 900 + estimate(4 rows x 4 elems x f32 x4)
+        # = 900 + 256 > 1024 -> reject-with-retry-after
+        with pytest.raises(MemoryAdmissionError) as ei:
+            server.submit(x, 0)
+        assert isinstance(ei.value, QueueFullError)  # fleet-compatible
+        assert ei.value.retry_after_s == 2.5
+        assert ei.value.bucket == "4"
+        assert budget.rejects == 1
+        assert registry.counter(
+            "wam_tpu_memory_admission_rejects_total").value(replica="-") == 1.0
+        # once the bucket is warm its memory is already paid for: admitted
+        # regardless of the in-use reading
+        budget.capture_watermark("4", estimate_entry_bytes((4,), 4))
+        np.testing.assert_array_equal(server.attribute(x, 0), x * 2.0)
+    finally:
+        server.close()
+
+
+def test_memory_watermark_captured_at_warmup():
+    from wam_tpu.serve import AttributionServer
+    from wam_tpu.serve.entry import jit_entry
+
+    server = AttributionServer(
+        jit_entry(lambda xs, ys: xs * 2.0), [(4,)],
+        max_batch=2, max_wait_ms=0.0, warmup=True, memory=1 << 30,
+    )
+    try:
+        assert server._memory.is_warm("4")
+        wm = server._memory.describe()["watermarks"]["4"]
+        assert wm > 0
+        assert registry.gauge("wam_tpu_memory_bucket_watermark_bytes").value(
+            replica="-", bucket="4") == float(wm)
+        # warm bucket admits under any budget pressure
+        x = np.ones((4,), np.float32)
+        np.testing.assert_array_equal(server.attribute(x, 0), x * 2.0)
+    finally:
+        server.close()
+
+
+def test_estimate_entry_bytes_and_staged_feed():
+    assert estimate_entry_bytes((3, 32, 32), 8) == 3 * 32 * 32 * 8 * 4 * 4
+    assert estimate_entry_bytes((4,), 1, multiplier=1.0, aot_bytes=100) == 116
+    from wam_tpu.pipeline.stager import put_committed
+
+    before = registry.gauge("wam_tpu_memory_staged_bytes").value()
+    put_committed(np.zeros((8,), np.float32))
+    assert registry.gauge("wam_tpu_memory_staged_bytes").value() == before + 32
+
+
+# -- /metrics e2e -------------------------------------------------------------
+
+# one Prometheus 0.0.4 sample line: name{labels} value  (value may be a
+# float, integer, nan, or +/-inf rendering)
+_PROM_SAMPLE = (
+    r'^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[a-zA-Z_][a-zA-Z0-9_]*="[^"]*"'
+    r'(,[a-zA-Z_][a-zA-Z0-9_]*="[^"]*")*\})? '
+    r'(-?\d+(\.\d+)?([eE][+-]?\d+)?|nan|[+-]?inf)$'
+)
+
+
+def test_fleet_metrics_endpoint_exposes_health_plane():
+    need_devices(2)
+    import re
+
+    from wam_tpu.serve import FleetMetrics, FleetServer
+
+    fleet = FleetServer(
+        lambda rid, m: lambda xs, ys: np.asarray(xs) * 2.0,
+        [(4,)], replicas=2, max_batch=2, max_wait_ms=0.0, warmup=False,
+        metrics=FleetMetrics(), prom_port=0,
+        health=True, slo="p99_ms=1000", memory_budget=1 << 30,
+    )
+    x = np.zeros((4,), np.float32)
+    try:
+        futs = [fleet.submit(x, 0) for _ in range(8)]
+        for f in futs:
+            f.result(timeout=10)
+        port = fleet.prom_server.server_port
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=5).read().decode()
+    finally:
+        fleet.close()
+
+    for family in ("wam_tpu_health_checks_total", "wam_tpu_slo_burn_rate",
+                   "wam_tpu_memory_budget_bytes"):
+        assert f"# TYPE {family}" in body, family
+        assert any(l.startswith(family) for l in body.splitlines()), family
+    sample_re = re.compile(_PROM_SAMPLE)
+    for line in body.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        assert sample_re.match(line), f"unparseable exposition line: {line!r}"
+
+
+# -- profiling satellite: xplane interval union -------------------------------
+
+
+class _Span:
+    def __init__(self, offset_ps, duration_ps):
+        self.offset_ps = offset_ps
+        self.duration_ps = duration_ps
+
+
+def test_device_time_union_deduplicates_overlapping_module_spans():
+    """Overlapping "XLA Modules" spans (pipelined dispatch) must be counted
+    by interval union, not summed — a plain sum reports 250ps for spans
+    covering only 200ps here."""
+    from wam_tpu.profiling import _union_seconds
+
+    spans = [_Span(0, 100), _Span(50, 100), _Span(200, 50)]
+    assert _union_seconds(spans) == pytest.approx(200e-12)
+    # disjoint spans still sum exactly
+    assert _union_seconds([_Span(0, 10), _Span(20, 10)]) == pytest.approx(20e-12)
+    # fully-nested spans count once
+    assert _union_seconds([_Span(0, 100), _Span(25, 50)]) == pytest.approx(100e-12)
+    assert _union_seconds([]) == 0.0

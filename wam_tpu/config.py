@@ -197,6 +197,14 @@ class ServeConfig:
     device: str = "auto"
     fleet: int = 1  # replica workers (one per chip); 1 = single-chip server
     oversize: str = "pjit"  # "pjit" | "fanout" (serve/fleet oversize path)
+    # -- health plane (obs.health / obs.memory / obs.slo) -------------------
+    health: bool = True  # on-device numeric-health monitors + quarantine
+    health_quarantine_n: int = 3  # consecutive non-finite batches -> degraded
+    health_recovery_s: float = 30.0  # quarantine probation window
+    hbm_budget_mb: float = 0.0  # per-replica HBM budget (MiB); 0 = no limit
+    # per-bucket SLOs, e.g. "p99_ms=50,error_rate=0.01,health_rate=0.999"
+    # optionally bucket-prefixed: "3x224x224: p99_ms=30; *: p99_ms=80"
+    slo: str = ""
 
     def bucket_shapes(self) -> list[tuple[int, ...]]:
         if not self.buckets:

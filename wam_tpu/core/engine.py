@@ -128,6 +128,18 @@ class WamEngine:
         grads = self.grads_from_coeffs(coeffs, y, self.spatial_shape(x.shape))
         return coeffs, grads
 
+    def attribute_with_health(self, x: jax.Array, y: jax.Array | None):
+        """`attribute` plus the gradient tree's numeric-health vector
+        (`wam_tpu.obs.health.health_stats` over the coefficient gradients
+        — the per-call grad-norm / NaN-Inf summary). Pure jax: health-fused
+        serving entries fold the vector into the same compiled graph, so
+        the stats ride the result fetch already happening. Returns
+        ``(coeffs, grads, health_vec)``."""
+        from wam_tpu.obs.health import health_stats
+
+        coeffs, grads = self.attribute(x, y)
+        return coeffs, grads, health_stats(grads)
+
     def attribute_with_front_grads(self, x: jax.Array, y: jax.Array | None):
         """Like `attribute`, additionally returning the gradient at the
         front-end output (the reference's `melspecs.retain_grad()` tap,

@@ -289,5 +289,18 @@ def run_fan(runner, args: tuple, *, donate: bool | None = None, mesh=None,
         )
     with _obs_tracing.span("fan.dispatch", cat="fan"):
         out = runner(*args)
+    from wam_tpu.obs.health import batch_stats, fan_health_enabled, publish_stats
+
+    if fan_health_enabled():
+        # numeric-health piggyback: one extra tiny DISPATCH
+        # (`batch_stats` is its own jitted reduction over the result
+        # tree), zero extra FETCHES — the 6-float vector rides the
+        # metric's single `device_fetch` below, so the one-fetch
+        # contract (`fetch_scope` probes) is untouched.
+        stats = batch_stats(out)
+        with _obs_tracing.span("fan.fetch", cat="fan"):
+            host, host_stats = device_fetch((out, stats))
+        publish_stats(host_stats, source="fan")
+        return host
     with _obs_tracing.span("fan.fetch", cat="fan"):
         return device_fetch(out)

@@ -14,6 +14,18 @@ Three pillars, one import surface:
   — every jit trace and AOT cache event counted and attributed. See
   `wam_tpu.obs.sentinel`.
 
+The health plane (DESIGN.md "Health plane") builds on the pillars:
+
+- **Numeric health** (`obs.health`) — on-device NaN/Inf + saturation +
+  grad-norm reductions riding inside existing result fetches, and the
+  `HealthMonitor` quarantine state machine the fleet routes around.
+- **Memory accounting** (`obs.memory`) — per-bucket HBM watermarks at
+  warmup, a live staged-bytes gauge, and the `MemoryBudget` cold-bucket
+  admission check.
+- **SLO engine** (`obs.slo`) — declarative per-bucket objectives, rolling
+  burn rates, and the routing penalty that sheds load off a replica
+  burning its error budget.
+
 `configure(ObsConfig(...))` (or `configure(enabled=False)`) flips the
 shared enabled flag: disabled, spans are a shared no-op singleton and
 registry mutations return on one branch — near-zero overhead. The
@@ -32,8 +44,11 @@ without cycles.
 
 from __future__ import annotations
 
-from wam_tpu.obs import sentinel
+from wam_tpu.obs import health, memory, sentinel, slo
+from wam_tpu.obs.health import HealthConfig, HealthMonitor, health_stats
 from wam_tpu.obs.httpd import start_metrics_server, stop_metrics_server
+from wam_tpu.obs.memory import MemoryBudget
+from wam_tpu.obs.slo import SLObjectives, SLOTracker, parse_slo
 from wam_tpu.obs.registry import Registry, registry, render_prom
 from wam_tpu.obs.sentinel import (RetraceError, assert_no_retrace,
                                   compile_events, record_aot, record_trace,
@@ -51,6 +66,9 @@ __all__ = [
     "stop_metrics_server",
     "sentinel", "record_trace", "record_aot", "trace_count",
     "compile_events", "assert_no_retrace", "RetraceError",
+    "health", "memory", "slo",
+    "HealthConfig", "HealthMonitor", "health_stats", "MemoryBudget",
+    "SLObjectives", "SLOTracker", "parse_slo",
     "configure", "reset", "enabled", "set_enabled", "set_ring_size",
 ]
 

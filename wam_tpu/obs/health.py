@@ -1,0 +1,319 @@
+"""Numeric-health monitoring (health-plane pillar 1).
+
+WAM's output quality rests on a numerically delicate chain — differentiable
+IDWT reconstruction, bf16 synthesis with f32 accumulation, gradient
+estimators that need explicit ``nan_to_num`` hygiene — and this module is
+what watches it in production. The design constraint is the same one the
+eval fan engine lives by: **zero extra result fetches**. `health_stats` is
+a pure-jax reduction producing one tiny fixed-size vector that rides
+*inside* the result tree already being fetched:
+
+- fused into the serving graph when the entry was built with
+  ``serve_entry(with_health=True)`` (`serve.entry.jit_entry`) — the stats
+  are one more output leaf of the same compiled program;
+- dispatched post-hoc by the serve worker (`batch_stats`) for entries that
+  are not health-fused (fake entries, user callables) — a second tiny
+  *dispatch*, still harvested in the worker's single existing
+  ``device_get``;
+- piggybacked onto the fan engine's single `device_fetch`
+  (`evalsuite.fan.run_fan`): the fetched tree becomes ``(out, stats)`` and
+  the fetch count stays exactly 1 (`fetch_scope` pins this).
+
+The host side (`summarize`, `publish_stats`, `HealthMonitor`) turns the
+vector into ``wam_tpu_health_*`` registry series and the quarantine
+decision: N consecutive non-finite batches mark a replica degraded —
+`serve.fleet.FleetServer` routes around it like a death, but unlike a
+death it is *recoverable*: after ``recovery_s`` the replica accepts probe
+traffic again and one healthy batch clears the quarantine.
+
+Like the rest of `wam_tpu.obs`, this module imports only the stdlib at
+import time; jax/numpy are imported lazily inside the device-side helpers.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+import time
+from dataclasses import dataclass
+
+from wam_tpu.obs import tracing as _tracing
+from wam_tpu.obs.registry import registry as _registry
+
+__all__ = [
+    "HEALTH_VEC_SIZE",
+    "SAT_THRESHOLD",
+    "health_stats",
+    "combine_output_grads",
+    "batch_stats",
+    "summarize",
+    "publish_stats",
+    "HealthConfig",
+    "HealthMonitor",
+    "fan_health_enabled",
+    "set_fan_health",
+]
+
+# The on-device vector layout (f32, fixed size so every health-fused graph
+# has the same extra output shape):
+#   [0] non-finite element count (output tree + gradient tree when given)
+#   [1] total inexact elements behind [0]
+#   [2] saturation count over the OUTPUT: |v| >= SAT_THRESHOLD
+#   [3] output element count (denominator of the saturation fraction)
+#   [4] max |v| over the output
+#   [5] sum of squares over the GRADIENTS (output when no gradient tree) —
+#       grad_norm = sqrt of this, the per-call grad-norm summary
+HEALTH_VEC_SIZE = 6
+
+# Engines max-normalize attribution mosaics into [0, 1]; a value this close
+# to the top of the range counts as saturated (a clipped/flat attribution).
+SAT_THRESHOLD = 0.995
+
+# Fan-engine health piggyback switch (module-level: the fan has no server
+# object to carry per-instance config). Gated on the obs enabled flag too.
+_FAN_HEALTH = True
+
+
+def set_fan_health(enabled: bool) -> None:
+    global _FAN_HEALTH
+    _FAN_HEALTH = bool(enabled)
+
+
+def fan_health_enabled() -> bool:
+    """Whether `evalsuite.fan.run_fan` should piggyback health stats onto
+    its single fetch: the module switch AND the obs enabled flag."""
+    return _FAN_HEALTH and _tracing._STATE.enabled
+
+
+# -- device side (pure jax, usable inside jit) ------------------------------
+
+
+def _inexact_leaves(tree):
+    import jax
+    import jax.numpy as jnp
+
+    return [
+        l for l in jax.tree_util.tree_leaves(tree)
+        if hasattr(l, "dtype") and jnp.issubdtype(l.dtype, jnp.inexact)
+    ]
+
+
+def health_stats(out, grads=None, *, sat_threshold: float = SAT_THRESHOLD):
+    """The on-device health reduction: one ``(HEALTH_VEC_SIZE,)`` f32
+    vector over an attribution output tree (and optionally the coefficient
+    gradients behind it). Pure jax — traceable inside a serving entry so
+    the stats are one more leaf of the already-fetched result, never a
+    second fetch. Counts are f32 sums (exact to 2**24 elements — far above
+    any serve batch; a giant fan tree may round, which cannot flip the
+    finite/non-finite decision)."""
+    import jax.numpy as jnp
+
+    leaves = _inexact_leaves(out)
+    if not leaves:
+        return jnp.zeros((HEALTH_VEC_SIZE,), jnp.float32)
+    gleaves = _inexact_leaves(grads) if grads is not None else []
+    if not gleaves:
+        grads = None  # no gradient tree (or nothing inexact in it)
+        gleaves = leaves
+
+    def _f(x):
+        return jnp.asarray(x, jnp.float32)
+
+    nonfinite = sum(_f(jnp.sum(~jnp.isfinite(l))) for l in leaves)
+    total = float(sum(l.size for l in leaves))
+    if grads is not None:
+        nonfinite = nonfinite + sum(_f(jnp.sum(~jnp.isfinite(l)))
+                                    for l in gleaves)
+        total += float(sum(l.size for l in gleaves))
+    # NaN propagates through abs/>= as False, so a poisoned batch shows up
+    # in the non-finite count, not a phantom saturation count
+    sat = sum(_f(jnp.sum(jnp.abs(l) >= sat_threshold)) for l in leaves)
+    out_count = float(sum(l.size for l in leaves))
+    max_abs = jnp.stack([jnp.max(jnp.abs(_f(l))) for l in leaves]).max()
+    sumsq = sum(jnp.sum(jnp.square(_f(l))) for l in gleaves)
+    return jnp.stack([
+        nonfinite, jnp.float32(total), sat, jnp.float32(out_count),
+        max_abs, sumsq,
+    ])
+
+
+def combine_output_grads(out_vec, grad_vec):
+    """Merge an output-tree vector with a gradient-tree vector into one:
+    non-finite/total pool both trees, saturation/max stay output-only, the
+    grad-norm sum-of-squares comes from the gradients. Used by health-fused
+    engine entries (`core.engine.WamEngine.attribute_with_health`)."""
+    import jax.numpy as jnp
+
+    return jnp.stack([
+        out_vec[0] + grad_vec[0],
+        out_vec[1] + grad_vec[1],
+        out_vec[2], out_vec[3], out_vec[4],
+        grad_vec[5],
+    ])
+
+
+_stats_jit = None
+
+
+def batch_stats(out):
+    """Dispatch the health reduction on-device for a result tree that is
+    NOT health-fused (fake entries, arbitrary callables). Returns a device
+    array future — the caller harvests it together with the result in its
+    one existing ``device_get`` (`serve.runtime._complete`). The jit here
+    is a plain one (invisible to the compile sentinel on purpose: these
+    retraces are per result *structure*, not serving-entry cache misses)."""
+    global _stats_jit
+    import jax
+
+    if _stats_jit is None:
+        _stats_jit = jax.jit(lambda tree: health_stats(tree))
+    return _stats_jit(out)
+
+
+# -- host side --------------------------------------------------------------
+
+
+def summarize(vec) -> dict:
+    """Host-side view of a fetched health vector."""
+    import numpy as np
+
+    v = [float(x) for x in np.asarray(vec).reshape(-1)]
+    nonfinite, total, sat, out_n, max_abs, sumsq = v[:HEALTH_VEC_SIZE]
+    return {
+        "nonfinite": int(nonfinite),
+        "total": int(total),
+        "finite": nonfinite == 0.0,
+        "sat_frac": sat / out_n if out_n else 0.0,
+        "max_abs": max_abs,
+        # sqrt(NaN) is NaN, which is the honest grad norm of a poisoned batch
+        "grad_norm": math.sqrt(sumsq) if sumsq == sumsq and sumsq >= 0.0
+        else float("nan"),
+    }
+
+
+def _label(value) -> str:
+    return "-" if value is None else str(value)
+
+
+_c_checks = _registry.counter(
+    "wam_tpu_health_checks_total", "health vectors evaluated",
+    labels=("source", "replica"))
+_c_bad_batches = _registry.counter(
+    "wam_tpu_health_nonfinite_batches_total",
+    "batches whose output carried any NaN/Inf", labels=("source", "replica"))
+_c_bad_values = _registry.counter(
+    "wam_tpu_health_nonfinite_values_total",
+    "individual non-finite elements observed", labels=("source", "replica"))
+_g_sat = _registry.gauge(
+    "wam_tpu_health_saturation_fraction",
+    "fraction of output elements at/above the saturation threshold",
+    labels=("source", "replica", "bucket"))
+_g_maxabs = _registry.gauge(
+    "wam_tpu_health_max_abs", "max |output| of the last checked batch",
+    labels=("source", "replica", "bucket"))
+_g_gnorm = _registry.gauge(
+    "wam_tpu_health_grad_norm", "grad-norm summary of the last checked batch",
+    labels=("source", "replica", "bucket"))
+_g_quarantined = _registry.gauge(
+    "wam_tpu_health_quarantined",
+    "1 while the replica is quarantined by the health monitor",
+    labels=("replica",))
+_g_consecutive = _registry.gauge(
+    "wam_tpu_health_consecutive_nonfinite",
+    "current run of consecutive non-finite batches", labels=("replica",))
+
+
+def publish_stats(vec, *, source: str, replica=None, bucket=None) -> bool:
+    """Publish one fetched health vector to the ``wam_tpu_health_*`` series.
+    Returns whether the batch was finite (the quarantine input)."""
+    s = summarize(vec)
+    src, rl, bk = _label(source), _label(replica), _label(bucket)
+    _c_checks.inc(source=src, replica=rl)
+    if not s["finite"]:
+        _c_bad_batches.inc(source=src, replica=rl)
+        _c_bad_values.inc(s["nonfinite"], source=src, replica=rl)
+    _g_sat.set(s["sat_frac"], source=src, replica=rl, bucket=bk)
+    _g_maxabs.set(s["max_abs"], source=src, replica=rl, bucket=bk)
+    _g_gnorm.set(s["grad_norm"], source=src, replica=rl, bucket=bk)
+    return s["finite"]
+
+
+@dataclass(frozen=True)
+class HealthConfig:
+    """Quarantine policy knobs (`ServeConfig.health_*` surfaces them on the
+    CLI). ``quarantine_after`` consecutive non-finite batches mark the
+    replica degraded; after ``recovery_s`` it accepts probe traffic again
+    and one healthy batch clears the state (a bad probe re-arms it)."""
+
+    enabled: bool = True
+    quarantine_after: int = 3
+    recovery_s: float = 30.0
+    sat_threshold: float = SAT_THRESHOLD
+
+
+class HealthMonitor:
+    """Per-server quarantine state machine over the batch health stream.
+
+    ``note(vec)`` is called by the serve worker once per harvested batch
+    (before results are distributed, so routing observes the updated state
+    no later than the client sees the result); ``ok()`` is read by the
+    fleet router. Thread-safe; ``now`` is injectable for deterministic
+    tests."""
+
+    def __init__(self, config: HealthConfig | None = None, *, replica_id=None):
+        self.config = config if config is not None else HealthConfig()
+        self.replica_id = replica_id
+        self._rl = _label(replica_id)
+        self._lock = threading.Lock()
+        self._consecutive = 0
+        self._quarantined_at: float | None = None
+        self.checks = 0
+        self.nonfinite_batches = 0
+
+    def note(self, vec, *, bucket=None, now: float | None = None) -> bool:
+        """Record one batch's health vector; returns whether it was finite."""
+        finite = publish_stats(vec, source="serve", replica=self.replica_id,
+                               bucket=bucket)
+        now = time.perf_counter() if now is None else now
+        with self._lock:
+            self.checks += 1
+            if finite:
+                self._consecutive = 0
+                self._quarantined_at = None
+            else:
+                self.nonfinite_batches += 1
+                self._consecutive += 1
+                if self._consecutive >= self.config.quarantine_after:
+                    # (re-)arm: a bad probe during probation restarts the
+                    # recovery clock
+                    self._quarantined_at = now
+            _g_consecutive.set(self._consecutive, replica=self._rl)
+            _g_quarantined.set(0.0 if self._quarantined_at is None else 1.0,
+                               replica=self._rl)
+        return finite
+
+    @property
+    def quarantined(self) -> bool:
+        with self._lock:
+            return self._quarantined_at is not None
+
+    def ok(self, now: float | None = None) -> bool:
+        """Routing predicate: healthy, or quarantined-but-probational
+        (``recovery_s`` elapsed — let probe traffic through so a recovered
+        replica can prove itself)."""
+        if not self.config.enabled:
+            return True
+        with self._lock:
+            if self._quarantined_at is None:
+                return True
+            now = time.perf_counter() if now is None else now
+            return (now - self._quarantined_at) >= self.config.recovery_s
+
+    def describe(self) -> dict:
+        with self._lock:
+            return {
+                "checks": self.checks,
+                "nonfinite_batches": self.nonfinite_batches,
+                "consecutive_nonfinite": self._consecutive,
+                "quarantined": self._quarantined_at is not None,
+            }

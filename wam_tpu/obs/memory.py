@@ -1,0 +1,202 @@
+"""HBM memory accounting + cold-bucket admission (health-plane pillar 2).
+
+Before this module, `serve/runtime.py` rejected on queue depth alone: a
+cold bucket's first request triggers a warmup compile + buffer allocation
+with no idea whether the chip has room for it. `MemoryBudget` closes that
+gap with three pieces:
+
+- **Watermark capture at warmup**: right after a bucket's warmup dispatch
+  the server records the device's peak-bytes watermark (via
+  ``device.memory_stats()`` where the backend exposes it — TPU and recent
+  CPU runtimes do) with an *estimated-bytes fallback* computed from the
+  bucket shape × max_batch × dtype plus the AOT executable size when one
+  is cached (`estimate_entry_bytes`). A warm bucket is thereafter always
+  admitted — its memory is already paid for.
+- **Live-bytes gauge**: the stager's existing per-transfer byte counter
+  feeds ``wam_tpu_memory_staged_bytes`` (`note_staged`, called from
+  `pipeline.stager.put_committed`), so dashboards see transfer pressure
+  next to the watermark series without any device sync.
+- **Admission check**: `admit()` — called by `AttributionServer.submit`
+  before queueing — projects ``bytes_in_use + estimate`` for a COLD bucket
+  and rejects with a ``retry_after_s`` (surfaced as
+  `serve.runtime.MemoryAdmissionError`, a `QueueFullError` subclass so the
+  fleet treats it as ordinary backpressure) when the projection exceeds
+  the configured budget.
+
+``in_use_fn`` injects a simulated bytes-in-use reading for deterministic
+tests (and for platforms with no ``memory_stats()`` at all, where the
+fallback is the max recorded watermark).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+from wam_tpu.obs.registry import registry as _registry
+
+__all__ = [
+    "MemoryBudget",
+    "device_memory_stats",
+    "estimate_entry_bytes",
+    "executable_bytes",
+    "note_staged",
+]
+
+
+def _label(value) -> str:
+    return "-" if value is None else str(value)
+
+
+_g_watermark = _registry.gauge(
+    "wam_tpu_memory_bucket_watermark_bytes",
+    "device peak-bytes watermark captured at the bucket's warmup "
+    "(estimated when the backend exposes no memory_stats)",
+    labels=("replica", "bucket"))
+_g_in_use = _registry.gauge(
+    "wam_tpu_memory_device_bytes_in_use",
+    "device bytes in use as of the last admission projection",
+    labels=("replica",))
+_g_budget = _registry.gauge(
+    "wam_tpu_memory_budget_bytes", "configured device memory budget",
+    labels=("replica",))
+_c_rejects = _registry.counter(
+    "wam_tpu_memory_admission_rejects_total",
+    "cold-bucket submits rejected because the projected watermark "
+    "exceeded the budget", labels=("replica",))
+_g_staged = _registry.gauge(
+    "wam_tpu_memory_staged_bytes",
+    "cumulative host->device bytes staged (live-bytes feed from the "
+    "stager's transfer counter)")
+
+
+def note_staged(nbytes: int) -> None:
+    """Live-bytes feed: `pipeline.stager.put_committed` forwards every
+    staged transfer's host-side byte count here (gauge mutation no-ops
+    when obs is disabled, same as the counter next to it)."""
+    _g_staged.inc(nbytes)
+
+
+def device_memory_stats(device=None) -> dict | None:
+    """``device.memory_stats()`` guarded against backends that lack it
+    (the method may be missing, raise, or return None/{}). ``device=None``
+    asks the first local device."""
+    try:
+        import jax
+
+        if device is None:
+            devs = jax.local_devices()
+            if not devs:
+                return None
+            device = devs[0]
+        stats = device.memory_stats()
+    except Exception:
+        return None
+    return dict(stats) if stats else None
+
+
+def estimate_entry_bytes(bucket_shape, max_batch: int, itemsize: int = 4, *,
+                         multiplier: float = 4.0, aot_bytes: int = 0) -> int:
+    """Estimated-bytes fallback for a bucket's device footprint: the padded
+    input batch (``max_batch × prod(shape) × itemsize``) times a working-set
+    multiplier (input + output + ~2x transient coefficient pyramids — the
+    IDWT chain holds per-level subband buffers live across the VJP), plus
+    the AOT executable size when one is cached (`executable_bytes` of
+    `pipeline.aot.aot_entry_path`). Deliberately coarse: it only has to be
+    the right order of magnitude for admission, and only until the first
+    real watermark replaces it."""
+    elems = int(max_batch)
+    for d in bucket_shape:
+        elems *= int(d)
+    return int(elems * int(itemsize) * float(multiplier)) + int(aot_bytes)
+
+
+def executable_bytes(path: str | None) -> int:
+    """Size of a serialized AOT executable (0 when absent). Callers resolve
+    the path via `wam_tpu.pipeline.aot.aot_entry_path` — obs stays free of
+    wam_tpu imports (the one-way dependency edge)."""
+    if not path:
+        return 0
+    try:
+        return os.path.getsize(path)
+    except OSError:
+        return 0
+
+
+class MemoryBudget:
+    """Per-device HBM accounting + the cold-bucket admission decision.
+
+    One instance per `AttributionServer` (the fleet builds one per replica
+    from its ``memory_budget`` bytes). ``budget_bytes`` None/0 disables
+    admission but keeps watermark capture. Thread-safe: warmups run
+    concurrently across buckets."""
+
+    def __init__(self, budget_bytes: int | None = None, *, device=None,
+                 replica_id=None, retry_after_s: float = 1.0,
+                 in_use_fn=None):
+        self.budget_bytes = int(budget_bytes) if budget_bytes else None
+        self.retry_after_s = float(retry_after_s)
+        self.replica_id = replica_id
+        self._rl = _label(replica_id)
+        self._device = device
+        self._in_use_fn = in_use_fn
+        self._lock = threading.Lock()
+        self._watermarks: dict[str, int] = {}
+        self.rejects = 0
+        if self.budget_bytes:
+            _g_budget.set(self.budget_bytes, replica=self._rl)
+
+    def capture_watermark(self, bucket_key: str, fallback_bytes: int) -> int:
+        """Record a bucket's post-warmup watermark: the device's
+        ``peak_bytes_in_use`` when the backend reports one, else the
+        caller's shape-derived estimate. The bucket is 'warm' (always
+        admitted) from here on."""
+        stats = device_memory_stats(self._device)
+        wm = None
+        if stats:
+            wm = stats.get("peak_bytes_in_use", stats.get("bytes_in_use"))
+        wm = int(fallback_bytes) if wm is None else int(wm)
+        with self._lock:
+            self._watermarks[bucket_key] = wm
+        _g_watermark.set(wm, replica=self._rl, bucket=bucket_key)
+        return wm
+
+    def is_warm(self, bucket_key: str) -> bool:
+        with self._lock:
+            return bucket_key in self._watermarks
+
+    def bytes_in_use(self) -> int:
+        """Current device bytes in use: the injected reading, else the
+        backend's live counter, else the largest recorded watermark (the
+        most conservative figure a stats-less backend can offer)."""
+        if self._in_use_fn is not None:
+            return int(self._in_use_fn())
+        stats = device_memory_stats(self._device)
+        if stats and stats.get("bytes_in_use") is not None:
+            return int(stats["bytes_in_use"])
+        with self._lock:
+            return max(self._watermarks.values(), default=0)
+
+    def admit(self, bucket_key: str, estimate_bytes: int) -> float | None:
+        """Admission decision for one submit: None admits; a float is the
+        ``retry_after_s`` to reject with. Warm buckets and unbudgeted
+        servers always admit; a cold bucket is admitted only when its
+        projected watermark (bytes in use + estimate) fits the budget."""
+        if self.budget_bytes is None or self.is_warm(bucket_key):
+            return None
+        in_use = self.bytes_in_use()
+        _g_in_use.set(in_use, replica=self._rl)
+        if in_use + int(estimate_bytes) <= self.budget_bytes:
+            return None
+        with self._lock:
+            self.rejects += 1
+        _c_rejects.inc(replica=self._rl)
+        return self.retry_after_s
+
+    def describe(self) -> dict:
+        with self._lock:
+            return {
+                "budget_bytes": self.budget_bytes,
+                "watermarks": dict(self._watermarks),
+                "rejects": self.rejects,
+            }

@@ -1,0 +1,259 @@
+"""Declarative SLOs + rolling-window burn rates (health-plane pillar 3).
+
+An *objective* is a per-bucket target over a rolling window: p99 latency,
+error rate, health rate (`SLObjectives`). A *burn rate* is how fast the
+window is consuming its error budget — 1.0 means exactly on budget, >1
+means the objective will be violated if the window's behavior persists
+(the standard SRE multi-window formulation, collapsed to one window):
+
+- latency burn  = fraction of requests over the p99 target / 0.01 (the 1%
+  a p99 objective budgets for),
+- error burn    = observed error rate / error-rate budget,
+- health burn   = observed unhealthy fraction / (1 - health-rate target),
+- burn_rate     = max of the enabled components (disabled ones — target
+  0/unset — contribute nothing).
+
+`SLOTracker` keeps one deque of ``(t, latency_s, ok, healthy)`` per bucket,
+prunes it to ``window_s`` on every read, and surfaces the results three
+ways, all fed from the SAME floats so they can be cross-checked exactly:
+
+- ``wam_tpu_slo_*`` registry gauges (→ ``/metrics``), republished at most
+  once a second from the note path so scrapes see live values;
+- an ``slo_status`` row in the v2 JSONL ledger
+  (`serve.metrics.write_slo_status` wraps `snapshot_row`);
+- a routing penalty: `penalty_s` maps burn > 1 onto seconds added to the
+  fleet's load score, so a replica burning its budget sheds load *before*
+  it dies (`serve.fleet.FleetServer._score`).
+
+Objective policies are declared as CLI-friendly strings in
+``ServeConfig.slo`` (`parse_slo`): ``"p99_ms=250,error_rate=0.01"`` applies
+one objective set to every bucket; per-bucket overrides are
+``;``-separated with a bucket-key prefix —
+``"*:p99_ms=250;3x32x32:p99_ms=100,health_rate=0.99"``.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from dataclasses import asdict, dataclass
+
+from wam_tpu.obs.registry import registry as _registry
+
+__all__ = ["SLObjectives", "parse_slo", "SLOTracker", "PENALTY_SCALE_S"]
+
+# penalty_s = max(0, burn_rate - 1) * this — at burn 2x the replica looks
+# one EMA-seed's worth of service time busier than it is, enough to lose
+# routing ties without starving it outright
+PENALTY_SCALE_S = 0.05
+
+# republish gauges from the note path at most this often (full window
+# stats per note would sort the latency sample on every request)
+_PUBLISH_MIN_INTERVAL_S = 1.0
+
+
+@dataclass(frozen=True)
+class SLObjectives:
+    """One bucket's objectives over a rolling window. A zero/unset target
+    disables that component (its burn contributes 0)."""
+
+    p99_ms: float = 0.0
+    error_rate: float = 0.0
+    health_rate: float = 0.0
+    window_s: float = 60.0
+
+
+def parse_slo(spec) -> dict | None:
+    """Parse a ``ServeConfig.slo`` policy string into a ``{bucket_key:
+    SLObjectives}`` map ('*' = default). Accepts an existing map or a bare
+    `SLObjectives` (becomes the '*' entry); returns None for empty specs."""
+    if spec is None or spec == "":
+        return None
+    if isinstance(spec, SLObjectives):
+        return {"*": spec}
+    if isinstance(spec, dict):
+        return {
+            str(k): (v if isinstance(v, SLObjectives) else SLObjectives(**v))
+            for k, v in spec.items()
+        }
+    policy: dict[str, SLObjectives] = {}
+    for part in str(spec).split(";"):
+        part = part.strip()
+        if not part:
+            continue
+        bucket = "*"
+        body = part
+        # a bucket prefix is "<key>:"; objective keys always carry '='
+        if ":" in part and "=" not in part.split(":", 1)[0]:
+            bucket, body = part.split(":", 1)
+            bucket = bucket.strip()
+        kwargs = {}
+        for kv in body.split(","):
+            kv = kv.strip()
+            if not kv:
+                continue
+            k, _, v = kv.partition("=")
+            k = k.strip()
+            if k not in ("p99_ms", "error_rate", "health_rate", "window_s"):
+                raise ValueError(f"unknown SLO objective {k!r} in {spec!r}")
+            kwargs[k] = float(v)
+        policy[bucket] = SLObjectives(**kwargs)
+    return policy or None
+
+
+def _label(value) -> str:
+    return "-" if value is None else str(value)
+
+
+_g_burn = _registry.gauge(
+    "wam_tpu_slo_burn_rate",
+    "error-budget burn rate over the rolling window (max component; "
+    ">1 = violating)", labels=("replica", "bucket"))
+_g_err = _registry.gauge(
+    "wam_tpu_slo_error_rate", "observed error rate over the window",
+    labels=("replica", "bucket"))
+_g_health = _registry.gauge(
+    "wam_tpu_slo_health_rate", "observed healthy fraction over the window",
+    labels=("replica", "bucket"))
+_g_p99 = _registry.gauge(
+    "wam_tpu_slo_p99_seconds", "observed p99 latency over the window",
+    labels=("replica", "bucket"))
+_g_n = _registry.gauge(
+    "wam_tpu_slo_window_requests", "requests inside the rolling window",
+    labels=("replica", "bucket"))
+
+
+class SLOTracker:
+    """Rolling-window SLO accounting for one server (fleet replicas each
+    carry their own). ``policy`` is anything `parse_slo` accepts; a None
+    policy tracks nothing and burns nothing. Thread-safe; ``now`` is
+    injectable for deterministic tests."""
+
+    def __init__(self, policy, *, replica_id=None):
+        self.policy = parse_slo(policy) or {}
+        self.replica_id = replica_id
+        self._rl = _label(replica_id)
+        self._lock = threading.Lock()
+        self._windows: dict[str, deque] = {}
+        self._last_publish = 0.0
+
+    def objectives_for(self, bucket_key: str) -> SLObjectives | None:
+        return self.policy.get(bucket_key, self.policy.get("*"))
+
+    # -- note path (serve worker) -------------------------------------------
+
+    def note(self, bucket_key: str, *, latency_s: float = 0.0,
+             ok: bool = True, healthy: bool = True,
+             now: float | None = None) -> None:
+        """One resolved request. Errors and expiries go through
+        `note_error` (they have no meaningful latency sample)."""
+        if self.objectives_for(bucket_key) is None:
+            return
+        now = time.perf_counter() if now is None else now
+        publish = False
+        with self._lock:
+            self._windows.setdefault(bucket_key, deque()).append(
+                (now, float(latency_s), bool(ok), bool(healthy)))
+            if now - self._last_publish >= _PUBLISH_MIN_INTERVAL_S:
+                self._last_publish = now
+                publish = True
+        if publish:
+            self.snapshot_row(now=now)
+
+    def note_error(self, bucket_key: str, n: int = 1,
+                   now: float | None = None) -> None:
+        """Failed/expired requests: counted against the error AND health
+        budgets, no latency sample."""
+        if self.objectives_for(bucket_key) is None:
+            return
+        now = time.perf_counter() if now is None else now
+        with self._lock:
+            w = self._windows.setdefault(bucket_key, deque())
+            for _ in range(int(n)):
+                w.append((now, 0.0, False, False))
+
+    # -- window reads -------------------------------------------------------
+
+    def _pruned(self, bucket_key: str, now: float) -> list:
+        """Prune + copy one bucket's window. Caller holds no lock."""
+        obj = self.objectives_for(bucket_key)
+        horizon = now - (obj.window_s if obj else 60.0)
+        with self._lock:
+            w = self._windows.get(bucket_key)
+            if w is None:
+                return []
+            while w and w[0][0] < horizon:
+                w.popleft()
+            return list(w)
+
+    def bucket_stats(self, bucket_key: str, now: float | None = None) -> dict:
+        """The window's observed rates + burn components, computed once and
+        shared verbatim by the gauges, the ledger row, and the routing
+        penalty (the exact-round-trip invariant). p99 is reported in
+        SECONDS everywhere — no ms<->s conversion between the sinks."""
+        now = time.perf_counter() if now is None else now
+        obj = self.objectives_for(bucket_key) or SLObjectives()
+        window = self._pruned(bucket_key, now)
+        n = len(window)
+        if n == 0:
+            return {"n": 0, "error_rate": 0.0, "health_rate": 1.0,
+                    "p99_s": 0.0, "burn_rate": 0.0}
+        errors = sum(1 for _, _, ok, _ in window if not ok)
+        unhealthy = sum(1 for _, _, _, h in window if not h)
+        error_rate = errors / n
+        health_rate = 1.0 - unhealthy / n
+        lats = sorted(lat for _, lat, ok, _ in window if ok)
+        if lats:
+            i = min(len(lats) - 1, int(round(0.99 * (len(lats) - 1))))
+            p99_s = lats[i]
+        else:
+            p99_s = 0.0
+        burn = 0.0
+        if obj.error_rate > 0.0:
+            burn = max(burn, error_rate / obj.error_rate)
+        if obj.health_rate > 0.0:
+            allowed = max(1.0 - obj.health_rate, 1e-9)
+            burn = max(burn, (1.0 - health_rate) / allowed)
+        if obj.p99_ms > 0.0 and lats:
+            over = sum(1 for lat in lats if lat > obj.p99_ms / 1e3)
+            burn = max(burn, (over / len(lats)) / 0.01)
+        return {"n": n, "error_rate": error_rate, "health_rate": health_rate,
+                "p99_s": p99_s, "burn_rate": burn}
+
+    def burn_rate(self, bucket_key: str, now: float | None = None) -> float:
+        return self.bucket_stats(bucket_key, now=now)["burn_rate"]
+
+    def penalty_s(self, bucket_key: str, now: float | None = None) -> float:
+        """Routing penalty: seconds added to the fleet's load score while
+        this bucket burns over budget (0 at/below burn 1.0)."""
+        return max(0.0, self.burn_rate(bucket_key, now=now) - 1.0) * PENALTY_SCALE_S
+
+    # -- snapshot (gauges + ledger row, same floats) ------------------------
+
+    def snapshot_row(self, publish: bool = True,
+                     now: float | None = None) -> dict:
+        """Per-bucket stats as an ``slo_status`` ledger-row body, publishing
+        the same float values to the ``wam_tpu_slo_*`` gauges when asked —
+        one computation, two sinks, exact agreement by construction.
+        (`serve.metrics.write_slo_status` adds the schema envelope.)"""
+        now = time.perf_counter() if now is None else now
+        with self._lock:
+            keys = sorted(self._windows)
+        buckets = {}
+        for bkey in keys:
+            st = self.bucket_stats(bkey, now=now)
+            buckets[bkey] = st
+            if publish:
+                _g_burn.set(st["burn_rate"], replica=self._rl, bucket=bkey)
+                _g_err.set(st["error_rate"], replica=self._rl, bucket=bkey)
+                _g_health.set(st["health_rate"], replica=self._rl, bucket=bkey)
+                _g_p99.set(st["p99_s"], replica=self._rl, bucket=bkey)
+                _g_n.set(st["n"], replica=self._rl, bucket=bkey)
+        return {
+            "metric": "slo_status",
+            "replica_id": self.replica_id,
+            "objectives": {k: asdict(v) for k, v in self.policy.items()},
+            "buckets": buckets,
+            "timestamp": time.time(),
+        }

@@ -51,6 +51,11 @@ def put_committed(tree, sharding=None):
             n += getattr(leaf, "nbytes", 0)
         if n:
             _h2d_bytes.inc(n)
+            # live-bytes feed for the HBM accounting gauge (obs.memory):
+            # same host-side byte count, second sink
+            from wam_tpu.obs import memory as _obs_memory
+
+            _obs_memory.note_staged(n)
     if sharding is None:
         return jax.device_put(tree)
     return jax.device_put(tree, sharding)

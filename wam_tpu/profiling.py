@@ -91,13 +91,17 @@ def _union_seconds(events) -> float:
 
 
 def _device_busy_seconds(logdir: str) -> float | None:
-    """Total device execution time in a profiler capture: sum of "XLA
-    Modules" event durations on the TPU device plane (one event per program
-    execution — the program's device span). A plain sum over the per-op
-    "XLA Ops" line double-counts ~2× (events overlap/nest: measured 0.738 s
-    op-sum vs 0.379 s module span on the flagship step), so the fallback
-    when no module line exists is the op-interval UNION. None when no TPU
-    device plane exists (CPU backend).
+    """Total device execution time in a profiler capture: the interval
+    UNION of "XLA Modules" events on the TPU device plane (one event per
+    program execution — the program's device span). Module spans overlap
+    too once dispatch is pipelined (batch k+1's program starts while k is
+    still running on a multi-queue device, and donated-alias programs can
+    report nested spans), so a plain duration sum over-reports busy time
+    exactly like the per-op line does — every line is union-reduced. A
+    plain sum over the per-op "XLA Ops" line double-counts ~2× (events
+    overlap/nest: measured 0.738 s op-sum vs 0.379 s module span on the
+    flagship step); it is the fallback when no module line exists. None
+    when no TPU device plane exists (CPU backend).
 
     Multi-chip captures expose one TPU plane PER DEVICE, each carrying the
     same SPMD program's span — summing across planes would report k× the
@@ -126,8 +130,7 @@ def _device_busy_seconds(logdir: str) -> float | None:
             continue
         lines = {line.name: line for line in plane.lines}
         if "XLA Modules" in lines and lines["XLA Modules"].events:
-            per_plane.append(sum(ev.duration_ps
-                                 for ev in lines["XLA Modules"].events) / 1e12)
+            per_plane.append(_union_seconds(lines["XLA Modules"].events))
         elif "XLA Ops" in lines:
             per_plane.append(_union_seconds(lines["XLA Ops"].events))
     return max(per_plane) if per_plane else None

@@ -25,6 +25,7 @@ from wam_tpu.serve.metrics import SCHEMA_VERSION, FleetMetrics, ServeMetrics, pe
 from wam_tpu.serve.runtime import (
     AttributionServer,
     DeadlineExceededError,
+    MemoryAdmissionError,
     QueueFullError,
     ServeError,
     ServerClosedError,
@@ -39,6 +40,7 @@ __all__ = [
     "NoLiveReplicaError",
     "ServeError",
     "QueueFullError",
+    "MemoryAdmissionError",
     "DeadlineExceededError",
     "ServerClosedError",
     "ServeMetrics",

@@ -52,6 +52,7 @@ def jit_entry(
     on_trace: Callable[[], None] | None = None,
     aot_key: str | None = None,
     obs_kind: str = "serve",
+    with_health: bool | str = False,
 ):
     """Wrap ``impl(x, y)`` as a serving entry (see module docstring).
 
@@ -59,27 +60,63 @@ def jit_entry(
     donated buffers unused and warns per call. ``aot_key`` opts the entry
     into the AOT executable cache. Every jit trace is also reported to the
     compile sentinel (`wam_tpu.obs.sentinel`) under ``obs_kind``, tagged
-    with whatever bucket/replica/phase labels the caller's thread holds."""
+    with whatever bucket/replica/phase labels the caller's thread holds.
+
+    ``with_health=True`` fuses the numeric-health reduction into the SAME
+    compiled graph: the entry returns ``(out, health_vec)`` where the
+    vector is `wam_tpu.obs.health.health_stats` over the output — one more
+    output leaf of the program already being fetched, never a second
+    fetch. ``with_health="fused"`` declares that ``impl`` ALREADY returns
+    that tuple (engines that fold gradient stats into the vector use this,
+    e.g. via `WamEngine.attribute_with_health`). Either way the returned
+    entry carries ``entry.wam_health = True`` so the serve worker knows to
+    unpack, and the AOT key is tagged ``|health`` — a health-fused export
+    must never cache-hit a plain one."""
+    fused = with_health == "fused"
+    if with_health and not fused:
+        from wam_tpu.obs.health import health_stats
+
+        base_impl = impl
+
+        def impl(x, y):  # noqa: F811 - deliberate health-wrapped rebind
+            out = base_impl(x, y)
+            return out, health_stats(out)
+
+        impl.__name__ = getattr(base_impl, "__name__", "entry") + "+health"
+    if with_health and aot_key is not None:
+        aot_key = f"{aot_key}|health"
+
     if aot_key is not None:
         from wam_tpu.pipeline.aot import cached_entry
 
-        return cached_entry(
+        jitted = cached_entry(
             impl,
             aot_key,
             donate_argnums=(0,) if resolve_donate(donate) else (),
             on_trace=on_trace,
             obs_kind=obs_kind,
         )
+    else:
+        def wrapped(x, y):
+            # trace-time only: one execution per jit cache miss
+            sentinel.record_trace(obs_kind,
+                                  detail=getattr(impl, "__name__", ""),
+                                  bucket=_bucket_of(x))
+            if on_trace is not None:
+                on_trace()
+            return impl(x, y)
 
-    def wrapped(x, y):
-        # trace-time only: one execution per jit cache miss
-        sentinel.record_trace(obs_kind, detail=getattr(impl, "__name__", ""),
-                              bucket=_bucket_of(x))
-        if on_trace is not None:
-            on_trace()
-        return impl(x, y)
+        jitted = jax.jit(
+            wrapped, donate_argnums=(0,) if resolve_donate(donate) else ())
+    if not with_health:
+        return jitted
 
-    return jax.jit(wrapped, donate_argnums=(0,) if resolve_donate(donate) else ())
+    # plain-function shell: jit/AOT callables reject attribute assignment
+    def entry(x, y):
+        return jitted(x, y)
+
+    entry.wam_health = True
+    return entry
 
 
 def _bucket_of(x):

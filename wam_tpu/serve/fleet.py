@@ -139,6 +139,20 @@ class FleetServer:
         format at ``GET http://127.0.0.1:{prom_port}/metrics`` for this
         fleet's lifetime (`wam_tpu.obs.start_metrics_server`; pass 0 to
         bind an ephemeral port — read ``fleet.prom_server.server_port``).
+    health : numeric-health monitoring per replica — ``True`` or a
+        `wam_tpu.obs.HealthConfig` (each replica gets its OWN monitor, so
+        quarantine is per-chip). A replica whose batches go non-finite
+        ``quarantine_after`` times in a row is routed around like a death,
+        but recovers after ``recovery_s`` (`AttributionServer` docs).
+        Quarantined replicas remain LAST-RESORT candidates — a request is
+        never failed while any live replica exists.
+    slo : per-bucket service objectives (`wam_tpu.obs.parse_slo` spec or
+        policy dict), tracked per replica; a replica's burn-rate adds a
+        routing penalty (`AttributionServer.slo_penalty_s`) so an
+        objective-violating replica sheds load before it pages.
+    memory_budget : per-replica HBM budget in BYTES — cold-bucket
+        admission control (`wam_tpu.obs.MemoryBudget`); each replica gets
+        its own budget on its own device.
     """
 
     def __init__(
@@ -162,6 +176,9 @@ class FleetServer:
         pipelined: bool = True,
         auto_start: bool = True,
         prom_port: int | None = None,
+        health=None,
+        slo=None,
+        memory_budget=None,
     ):
         if not callable(entry_factory):
             raise TypeError("entry_factory must be callable(replica_id, metrics)")
@@ -205,6 +222,9 @@ class FleetServer:
                 device=dev,
                 replica_id=rid,
                 auto_start=False,
+                health=health,
+                slo=slo,
+                memory=memory_budget,
             )
             self._replicas.append(_Replica(rid, dev, server, m))
 
@@ -276,6 +296,9 @@ class FleetServer:
             "replicas": self.n_replicas,
             "devices": [str(d) for d in self.devices],
             "dead": [r.rid for r in self._replicas if not r.alive],
+            "quarantined": [
+                r.rid for r in self._replicas if r.alive and not r.server.health_ok()
+            ],
             "buckets": [list(b.shape) for b in self.table],
             "max_batch": self.max_batch,
             "labeled": self.labeled,
@@ -358,9 +381,13 @@ class FleetServer:
         """Projected completion estimate for a new item on this replica:
         its whole-queue drain plus one batch of the item's own bucket at
         the replica's OWN per-bucket EMA (an idle-but-slow replica loses
-        to an idle-and-fast one)."""
-        return replica.server.projected_drain_s() + replica.metrics.ema_service_s(
-            bucket.shape
+        to an idle-and-fast one), plus the replica's SLO burn-rate penalty
+        (`AttributionServer.slo_penalty_s` — an objective-violating
+        replica sheds load proportionally to how hard it is burning)."""
+        return (
+            replica.server.projected_drain_s()
+            + replica.metrics.ema_service_s(bucket.shape)
+            + replica.server.slo_penalty_s(bucket.shape)
         )
 
     def _route(self, req: _FleetRequest, raise_errors: bool) -> None:
@@ -398,6 +425,12 @@ class FleetServer:
         else:
             remaining_ms = None
         cands.sort(key=lambda r: self._score(r, req.bucket))  # stable: rid ties
+        ok = {r.rid: r.server.health_ok() for r in cands}
+        if not all(ok.values()):
+            # numeric-health partition: quarantined replicas are routed
+            # around like deaths but stay LAST-RESORT candidates, so a
+            # fully-quarantined fleet still serves rather than failing
+            cands = [r for r in cands if ok[r.rid]] + [r for r in cands if not ok[r.rid]]
         retry_after = None
         for r in cands:
             try:

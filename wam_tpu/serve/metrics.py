@@ -44,7 +44,7 @@ from wam_tpu.results import JsonlWriter
 from wam_tpu.serve.buckets import bucket_key
 
 __all__ = ["ServeMetrics", "FleetMetrics", "percentile_ms", "SCHEMA_VERSION",
-           "write_obs_snapshot"]
+           "write_obs_snapshot", "write_slo_status"]
 
 SCHEMA_VERSION = 2
 
@@ -142,6 +142,9 @@ class ServeMetrics:
         self.batch_rows: list[dict] = []  # one dict per dispatched batch
         self.warmup_s: dict[str, float] = {}  # bucket key -> warmup seconds
         self._ema_service_s: dict[str, float] = {}  # bucket key -> EMA
+        # runtime attaches its SLOTracker so emit() can flush a slo_status
+        # row next to this replica's summary (None = no SLO policy)
+        self.slo = None
         self._t0 = time.perf_counter()
 
     # -- mutators (called from dispatcher / worker threads) -----------------
@@ -308,9 +311,24 @@ class ServeMetrics:
         if config is not None:
             summary["config"] = config
         writer.write(summary)
+        if self.slo is not None:
+            write_slo_status(writer, self.slo)
         if obs_snapshot:
             write_obs_snapshot(writer)
         return summary
+
+
+def write_slo_status(writer: JsonlWriter, tracker) -> dict:
+    """One ``slo_status`` ledger row from a `wam_tpu.obs.SLOTracker`: the
+    per-bucket burn-rate / error-rate / health-rate / p99 snapshot, stamped
+    with the ledger schema version here (the obs package stays stdlib-only
+    and does not know the serve schema). Publishing the row also refreshes
+    the ``wam_tpu_slo_*`` gauges from the SAME floats, so a ledger row and
+    a registry scrape taken together agree exactly."""
+    row = tracker.snapshot_row(publish=True)
+    row["schema_version"] = SCHEMA_VERSION
+    writer.write(row)
+    return row
 
 
 def write_obs_snapshot(writer: JsonlWriter) -> dict:

@@ -54,7 +54,10 @@ from dataclasses import dataclass, field
 import jax
 import numpy as np
 
+from wam_tpu.obs import health as obs_health
+from wam_tpu.obs import memory as obs_memory
 from wam_tpu.obs import sentinel as obs_sentinel
+from wam_tpu.obs import slo as obs_slo
 from wam_tpu.obs import tracing as obs_tracing
 from wam_tpu.pipeline.stager import put_committed
 from wam_tpu.serve.buckets import Bucket, BucketTable, bucket_key, pad_item
@@ -64,6 +67,7 @@ __all__ = [
     "AttributionServer",
     "ServeError",
     "QueueFullError",
+    "MemoryAdmissionError",
     "DeadlineExceededError",
     "ServerClosedError",
 ]
@@ -81,6 +85,23 @@ class QueueFullError(ServeError):
     def __init__(self, retry_after_s: float):
         super().__init__(f"queue full; retry after {retry_after_s:.3f}s")
         self.retry_after_s = retry_after_s
+
+
+class MemoryAdmissionError(QueueFullError):
+    """Cold-bucket admission rejected: warming this bucket's projected HBM
+    watermark would exceed the configured device budget
+    (`wam_tpu.obs.memory.MemoryBudget`). A `QueueFullError` subclass so
+    clients and the fleet treat it as ordinary backpressure — retry after
+    ``retry_after_s`` (by then warm buckets may have drained, or an
+    operator raised the budget)."""
+
+    def __init__(self, retry_after_s: float, bucket: str = ""):
+        ServeError.__init__(
+            self,
+            f"cold bucket {bucket or '?'} over memory budget; "
+            f"retry after {retry_after_s:.3f}s")
+        self.retry_after_s = retry_after_s
+        self.bucket = bucket
 
 
 class DeadlineExceededError(ServeError):
@@ -118,6 +139,9 @@ class _Inflight:
     ys: np.ndarray | None
     t0: float
     out: object
+    # numeric-health vector (device future) riding the same harvest as
+    # ``out`` — None when the health plane is off
+    hvec: object = None
 
 
 _NOT_READY = object()  # non-blocking _take_batch: nothing poppable yet
@@ -161,6 +185,22 @@ class AttributionServer:
         replica passes its own chip (module docstring "Device pinning").
     replica_id : this worker's identity in a fleet ledger (None =
         single-chip); forwarded to a freshly constructed `ServeMetrics`.
+    health : numeric-health monitoring (`wam_tpu.obs.health`): True or a
+        `HealthConfig` builds a per-server `HealthMonitor`; an existing
+        monitor is used as-is; None/False (default) disables. Health-fused
+        entries (``serve_entry(with_health=True)``) carry the stats inside
+        their own graph; other entries get a post-hoc on-device reduction —
+        either way the vector is harvested in the worker's ONE existing
+        ``device_get``, zero extra fetches.
+    slo : SLO objectives (`wam_tpu.obs.slo`): a policy string / map /
+        `SLObjectives` builds a per-server `SLOTracker`; an existing
+        tracker is used as-is; None/"" disables. The tracker is attached
+        to ``metrics.slo`` so `close()` writes the ``slo_status`` ledger
+        row.
+    memory : HBM accounting (`wam_tpu.obs.memory`): a byte budget (int)
+        builds a per-server `MemoryBudget` on this server's device; an
+        existing budget is used as-is; None/0 disables the admission check
+        (watermarks are still captured when a budget object is given).
     """
 
     def __init__(
@@ -183,6 +223,9 @@ class AttributionServer:
         device=None,
         replica_id=None,
         auto_start: bool = True,
+        health=None,
+        slo=None,
+        memory=None,
     ):
         if max_batch < 1:
             raise ValueError("max_batch must be >= 1")
@@ -205,6 +248,32 @@ class AttributionServer:
         self.pipelined = pipelined
         self._device = device
         self.degraded = False
+
+        # health plane (DESIGN.md "Health plane"): all three default off so
+        # direct constructions keep their exact pre-health behavior
+        if isinstance(health, obs_health.HealthMonitor):
+            self._health = health
+        elif health:
+            cfg = health if isinstance(health, obs_health.HealthConfig) else None
+            self._health = obs_health.HealthMonitor(cfg, replica_id=replica_id)
+        else:
+            self._health = None
+        if isinstance(slo, obs_slo.SLOTracker):
+            self._slo = slo
+        elif slo:
+            self._slo = obs_slo.SLOTracker(slo, replica_id=replica_id)
+        else:
+            self._slo = None
+        if self._slo is not None:
+            # the ledger hook: ServeMetrics.emit writes the slo_status row
+            self.metrics.slo = self._slo
+        if isinstance(memory, obs_memory.MemoryBudget):
+            self._memory = memory
+        elif memory:
+            self._memory = obs_memory.MemoryBudget(
+                int(memory), device=device, replica_id=replica_id)
+        else:
+            self._memory = None
 
         self._cond = threading.Condition()
         self._queues: dict[Bucket, list[_Request]] = {b: [] for b in self.table}
@@ -261,8 +330,20 @@ class AttributionServer:
                     bucket=bucket_key(bucket.shape),
                     phase="warmup",
                 ):
-                    self._sync_dispatch(*self._stage_zeros(bucket))
+                    out = self._sync_dispatch(*self._stage_zeros(bucket))
+                    if self._health is not None and not getattr(
+                            self._entry, "wam_health", False):
+                        # non-fused entries compute health via a separate
+                        # batch_stats dispatch; warm its per-shape compile
+                        # here so the served window stays compile-free
+                        jax.block_until_ready(obs_health.batch_stats(out))
                 self.metrics.note_warmup(bucket.shape, time.perf_counter() - t0)
+                if self._memory is not None:
+                    # per-bucket HBM watermark right after the warmup
+                    # dispatch: device peak-bytes where the backend reports
+                    # them, the shape-derived estimate otherwise
+                    self._memory.capture_watermark(
+                        bucket_key(bucket.shape), self._estimate_bytes(bucket))
 
             if len(self.table) == 1:
                 _warm(next(iter(self.table)))
@@ -314,6 +395,13 @@ class AttributionServer:
             "degraded": self.degraded,
             "replica_id": self.replica_id,
             "device": str(self._device) if self._device is not None else None,
+            "health": self._health.describe() if self._health is not None else None,
+            "slo": (
+                {k: vars(v) for k, v in self._slo.policy.items()}
+                if self._slo is not None
+                else None
+            ),
+            "memory": self._memory.describe() if self._memory is not None else None,
         }
 
     # -- client side --------------------------------------------------------
@@ -330,6 +418,13 @@ class AttributionServer:
         x = np.asarray(x, self.dtype)
         bucket = self.table.select(x.shape)  # NoBucketError before any queueing
         self.metrics.note_submit()
+        if self._memory is not None:
+            retry_after = self._memory.admit(
+                bucket_key(bucket.shape), self._estimate_bytes(bucket))
+            if retry_after is not None:
+                self.metrics.note_reject()
+                raise MemoryAdmissionError(
+                    retry_after, bucket=bucket_key(bucket.shape))
         now = time.perf_counter()
         if deadline_ms is None:
             deadline = (now + self.default_deadline_s) if self.default_deadline_s else None
@@ -388,12 +483,32 @@ class AttributionServer:
         with self._cond:
             return self._drain_locked()
 
+    def health_ok(self) -> bool:
+        """Quarantine predicate for the fleet router: True when no health
+        monitor is attached, the replica is healthy, or its quarantine has
+        aged into probation (`obs.health.HealthMonitor.ok`)."""
+        return self._health is None or self._health.ok()
+
+    def slo_penalty_s(self, bucket_shape) -> float:
+        """Burn-rate routing penalty for one bucket (0 without a tracker
+        or at/below burn 1.0) — added to the fleet's load score so a
+        replica burning its error budget sheds load before it dies."""
+        if self._slo is None:
+            return 0.0
+        return self._slo.penalty_s(bucket_key(bucket_shape))
+
     # -- worker side --------------------------------------------------------
 
     def _zeros_batch(self, bucket: Bucket):
         x = np.zeros((self.max_batch,) + bucket.shape, self.dtype)
         y = np.zeros((self.max_batch,), np.int32) if self.labeled else None
         return x, y
+
+    def _estimate_bytes(self, bucket: Bucket) -> int:
+        """Shape-derived device-footprint estimate for one bucket — the
+        memory-admission projection and the watermark fallback."""
+        return obs_memory.estimate_entry_bytes(
+            bucket.shape, self.max_batch, np.dtype(self.dtype).itemsize)
 
     def _stage_zeros(self, bucket: Bucket):
         """Warmup batch, committed to this server's device when pinned so
@@ -429,7 +544,12 @@ class AttributionServer:
             self._entry = self._fallback_factory()
             self.degraded = True
         self.metrics.note_fallback()
-        return jax.device_get(self._entry(xs, ys))
+        out = jax.device_get(self._entry(xs, ys))
+        # a health-fused fallback returns (out, hvec); replay consumers
+        # only want the result tree (the batch already failed health-wise)
+        if getattr(self._entry, "wam_health", False):
+            out = out[0]
+        return out
 
     def _sync_dispatch(self, xs, ys):
         """Dispatch + harvest in one step (warmup and the non-pipelined
@@ -502,6 +622,8 @@ class AttributionServer:
                 )
             if expired:
                 self.metrics.note_expired(len(expired))
+                if self._slo is not None:
+                    self._slo.note_error(bucket_key(bucket.shape), len(expired))
             if not live:
                 self._finish_active(bucket)
                 continue
@@ -545,6 +667,7 @@ class AttributionServer:
                 ys = None
             staged = put_committed((xs, ys), self._device)
         t0 = time.perf_counter()
+        hvec = None
         try:
             with obs_sentinel.label(
                 replica=self.replica_id,
@@ -552,15 +675,28 @@ class AttributionServer:
                 phase="serve",
             ), self.metrics.stages.stage("dispatch"):
                 out = self._call_entry(*staged)
+                if self._health is not None:
+                    if getattr(self._entry, "wam_health", False):
+                        # fused entry: the vector is a leaf of the same
+                        # compiled program
+                        out, hvec = out
+                    else:
+                        # post-hoc on-device reduction (fake/plain entries):
+                        # one extra tiny DISPATCH, still harvested in the
+                        # worker's single existing device_get
+                        hvec = obs_health.batch_stats(out)
         except Exception:
             try:
                 out = self._recover(xs, ys)  # already host-side on success
+                hvec = None
             except Exception as e:
                 for r in live:
                     r.future.set_exception(e)
                 self.metrics.note_failed(n_real)
+                if self._slo is not None:
+                    self._slo.note_error(bucket_key(bucket.shape), n_real)
                 return None
-        return _Inflight(bucket, live, depth, xs, ys, t0, out)
+        return _Inflight(bucket, live, depth, xs, ys, t0, out, hvec)
 
     def _complete(self, batch: _Inflight):
         """Harvest an in-flight batch (block on the device result — where
@@ -568,18 +704,32 @@ class AttributionServer:
         per-bucket service-time EMA feeding retry-after / routing updates
         inside `ServeMetrics.note_batch`."""
         live, n_real = batch.live, len(batch.live)
+        bkey = bucket_key(batch.bucket.shape)
+        healthy = True
         try:
             try:
                 with self.metrics.stages.stage("harvest"):
-                    out = jax.device_get(batch.out)
+                    if batch.hvec is not None:
+                        # the health vector rides the batch's one fetch
+                        out, hvec_host = jax.device_get((batch.out, batch.hvec))
+                    else:
+                        out = jax.device_get(batch.out)
+                        hvec_host = None
             except Exception:
                 try:
                     out = self._recover(batch.xs, batch.ys)
+                    hvec_host = None
                 except Exception as e:
                     for r in live:
                         r.future.set_exception(e)
                     self.metrics.note_failed(n_real)
+                    if self._slo is not None:
+                        self._slo.note_error(bkey, n_real)
                     return
+            if self._health is not None and hvec_host is not None:
+                # recorded BEFORE rows distribute so a sequential client's
+                # next submit observes the updated health_ok() verdict
+                healthy = self._health.note(hvec_host, bucket=bkey)
             service_s = time.perf_counter() - batch.t0
             with self.metrics.stages.stage("distribute"):
                 done = time.perf_counter()
@@ -591,7 +741,6 @@ class AttributionServer:
                 # request's queue wait once its batch pops, so the spans are
                 # recorded from timestamps already in hand — together they
                 # tile submit->done, the trace_report coverage contract
-                bkey = bucket_key(batch.bucket.shape)
                 for r in live:
                     obs_tracing.record_span(
                         "queue_wait", r.t_submit, batch.t0, parent=r.ctx,
@@ -600,6 +749,7 @@ class AttributionServer:
                         "service", batch.t0, done, parent=r.ctx,
                         cat="serve", bucket=bkey, replica=self.replica_id,
                         n_real=n_real)
+            latencies_s = [done - r.t_submit for r in live]
             self.metrics.note_batch(
                 bucket_shape=batch.bucket.shape,
                 n_real=n_real,
@@ -608,7 +758,10 @@ class AttributionServer:
                 queue_depth=batch.depth,
                 service_s=service_s,
                 queue_waits_s=[batch.t0 - r.t_submit for r in live],
-                latencies_s=[done - r.t_submit for r in live],
+                latencies_s=latencies_s,
             )
+            if self._slo is not None:
+                for lat in latencies_s:
+                    self._slo.note(bkey, latency_s=lat, ok=True, healthy=healthy)
         finally:
             self._finish_active(batch.bucket)

@@ -370,7 +370,7 @@ class WaveletAttribution1D(BaseWAM1D):
         return self.integrated_wam(x, y)
 
     def serve_entry(self, donate: bool | None = None, on_trace=None,
-                    aot_key: str | None = None):
+                    aot_key: str | None = None, with_health: bool = False):
         """Batched serving entry ``(x, y) -> (mel_attr, coeff_attr)`` for the
         `wam_tpu.serve` worker: x is (B, W) float32 waveforms (already
         peak-normalized — the list form of `normalize_waveforms` is a host
@@ -379,7 +379,9 @@ class WaveletAttribution1D(BaseWAM1D):
         ``self.grad_coeffs``) that makes it thread-unsafe; the serve runtime
         distributes rows of every leaf. SmoothGrad folds the instance seed in
         at entry-build time. ``mesh=`` is rejected: the serving worker owns
-        exactly one device."""
+        exactly one device. ``with_health=True`` fuses the numeric-health
+        vector over the result pytree into the same graph
+        (`serve.entry.jit_entry`)."""
         if self.mesh is not None:
             raise ValueError(
                 "serve_entry() does not support mesh=; the serve worker owns "
@@ -393,7 +395,8 @@ class WaveletAttribution1D(BaseWAM1D):
         else:
             impl = lambda x, y: self._ig_impl(  # noqa: E731
                 jnp.asarray(x, jnp.float32), y)
-        return jit_entry(impl, donate=donate, on_trace=on_trace, aot_key=aot_key)
+        return jit_entry(impl, donate=donate, on_trace=on_trace,
+                         aot_key=aot_key, with_health=with_health)
 
 
 def _minmax_normalize(a):

@@ -107,14 +107,31 @@ class BaseWAM2D:
         return mosaic
 
     def serve_entry(self, donate: bool | None = None, on_trace=None,
-                    aot_key: str | None = None):
+                    aot_key: str | None = None, with_health: bool = False):
         """Batched serving entry: jitted ``(x, y) -> mosaic (B, S, S)`` with
         no instance-attribute stashing (unlike ``__call__``), safe to call
         from the `wam_tpu.serve` worker thread. ``donate``/``on_trace``/
         ``aot_key`` are forwarded to `serve.entry.jit_entry` (input-buffer
         donation on TPU, jit cache-miss counting, AOT executable cache —
-        the key must identify the model + params)."""
+        the key must identify the model + params). ``with_health=True``
+        fuses the numeric-health vector into the same graph — mosaic
+        saturation/max plus the coefficient-gradient norm and pooled
+        NaN/Inf counts (`WamEngine.attribute_with_health`), zero extra
+        dispatches or fetches."""
         from wam_tpu.serve.entry import jit_entry
+
+        if with_health:
+            from wam_tpu.obs.health import combine_output_grads, health_stats
+
+            def impl(x, y):
+                x = self._to_internal(x)
+                _, grads, gvec = self.engine.attribute_with_health(x, y)
+                m = mosaic2d(grads, self.normalize_coeffs, self._caxis)
+                return m, combine_output_grads(health_stats(m), gvec)
+
+            return jit_entry(impl, donate=donate, on_trace=on_trace,
+                             aot_key=_synth_tagged(aot_key),
+                             with_health="fused")
 
         def impl(x, y):
             x = self._to_internal(x)
@@ -396,14 +413,16 @@ class WaveletAttribution2D(BaseWAM2D):
         return self.integrated_wam(x, y)
 
     def serve_entry(self, donate: bool | None = None, on_trace=None,
-                    aot_key: str | None = None):
+                    aot_key: str | None = None, with_health: bool = False):
         """Batched serving entry ``(x, y) -> mosaic (B, S, S)`` for the
         `wam_tpu.serve` worker: the estimator body without the
         instance-attribute stashing (``self.scales``) that makes ``__call__``
         thread-unsafe. SmoothGrad folds the instance seed in at entry-build
         time, so every batch reuses one noise stream — matching what repeat
         ``__call__`` invocations do. ``mesh=`` is rejected: the serving
-        worker owns exactly one device."""
+        worker owns exactly one device. ``with_health=True`` fuses the
+        numeric-health vector over the mosaic into the same graph
+        (`serve.entry.jit_entry`)."""
         if self.mesh is not None:
             raise ValueError(
                 "serve_entry() does not support mesh=; the serve worker owns "
@@ -416,4 +435,5 @@ class WaveletAttribution2D(BaseWAM2D):
         else:
             impl = self._ig_impl
         return jit_entry(impl, donate=donate, on_trace=on_trace,
-                         aot_key=_synth_tagged(aot_key))
+                         aot_key=_synth_tagged(aot_key),
+                         with_health=with_health)

@@ -389,14 +389,16 @@ class WaveletAttribution3D(BaseWAM3D):
         return visualize_cube(self.grads, self.J)
 
     def serve_entry(self, donate: bool | None = None, on_trace=None,
-                    aot_key: str | None = None):
+                    aot_key: str | None = None, with_health: bool = False):
         """Batched serving entry ``(x, y) -> cube (B, S, S, S)`` for the
         `wam_tpu.serve` worker: x is (B, 1, D, H, W) volumes as fed to
         ``__call__``, y is (B,) int labels (the serve path is labeled-only).
         Same estimator body as ``__call__`` without the ``self.grads`` /
         ``self.input_size`` stashing that makes it thread-unsafe. SmoothGrad
         folds the instance seed in at entry-build time. ``mesh=`` is
-        rejected: the serving worker owns exactly one device."""
+        rejected: the serving worker owns exactly one device.
+        ``with_health=True`` fuses the numeric-health vector over the cube
+        into the same graph (`serve.entry.jit_entry`)."""
         if self.mesh is not None:
             raise ValueError(
                 "serve_entry() does not support mesh=; the serve worker owns "
@@ -411,4 +413,5 @@ class WaveletAttribution3D(BaseWAM3D):
         from wam_tpu.wam2d import _synth_tagged
 
         return jit_entry(impl, donate=donate, on_trace=on_trace,
-                         aot_key=_synth_tagged(aot_key))
+                         aot_key=_synth_tagged(aot_key),
+                         with_health=with_health)
