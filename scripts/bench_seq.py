@@ -1,0 +1,178 @@
+"""Fused-vs-split A/B for the sequence-sharded estimator loops.
+
+Measures `SeqShardedWam.smoothgrad` at a long-context geometry with the
+dispatch knob on both settings (``fused=True``: one jit per sample/chunk;
+``fused=False``: the historical split noisy/dec/grads/accum loop) across a
+sample-chunk ladder, and reports:
+
+- **dispatches/call** — read from the estimator's ``dispatch_count``
+  counter, the structural half of the A/B: the fused column must show
+  ``n_samples + 1`` (sequential) or ``n_chunks + 1`` (chunked), the split
+  column its 3–4× multiple. If the dispatch accounting is wrong the
+  timing comparison is meaningless, so the script prints it next to every
+  number.
+- **median time / throughput** — device-plane (xplane module spans)
+  medians where the backend exposes them (TPU), wall-clock
+  `bench_samples` otherwise. The plane is printed per row and in the JSON
+  summary; CPU wall numbers order candidates honestly but their absolute
+  values carry host state (BASELINE.md round-11 quotes them as such).
+
+Usage:
+    python scripts/bench_seq.py --ndim 1 --devices 8          # CPU A/B
+    python scripts/bench_seq.py --ndim 2 --device tpu         # on-chip
+    python scripts/bench_seq.py --toy                         # verify smoke
+
+Both paths produce BIT-IDENTICAL attributions (pinned in
+tests/test_seq_estimators.py); this script only asks which one the
+schedule should pick — the same question `python -m wam_tpu.tune
+--workload wamseq{1,2}d` persists an answer to.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _force_host_devices(n: int) -> None:
+    """Expose n virtual CPU devices. Must run before the first jax import."""
+    if "jax" in sys.modules:
+        raise RuntimeError("XLA_FLAGS must be set before jax is imported")
+    flags = os.environ.get("XLA_FLAGS", "")
+    os.environ["XLA_FLAGS"] = (
+        f"{flags} --xla_force_host_platform_device_count={n}".strip())
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python scripts/bench_seq.py",
+        description="Fused-vs-split A/B for the sequence-sharded loops.")
+    p.add_argument("--device", default="auto", help="auto | tpu | cpu")
+    p.add_argument("--devices", type=int, default=8,
+                   help="virtual CPU device count (cpu backend only)")
+    p.add_argument("--ndim", type=int, default=1, choices=(1, 2))
+    p.add_argument("--batch", type=int, default=2)
+    p.add_argument("--length", type=int, default=8192,
+                   help="1D sequence length / 2D row count x 32 cols")
+    p.add_argument("--n-samples", type=int, default=8)
+    p.add_argument("--chunks", default="1,2,full",
+                   help="sample_chunk ladder (comma list; 'full' = all)")
+    p.add_argument("--k", type=int, default=3)
+    p.add_argument("--laps", type=int, default=2)
+    p.add_argument("--toy", action="store_true",
+                   help="shrink everything: the verify-skill smoke")
+    p.add_argument("--emit", default=None, help="write the JSON table here")
+    args = p.parse_args(argv)
+
+    if args.toy:
+        args.length, args.n_samples, args.k, args.laps = 1024, 2, 1, 1
+        args.chunks = "1,full"
+
+    # virtual CPU devices must be forced BEFORE anything imports jax
+    # (wam_tpu.config does), or the mesh collapses to one device
+    if args.device == "cpu" and "jax" not in sys.modules:
+        _force_host_devices(args.devices)
+
+    from wam_tpu.config import ensure_usable_backend, select_backend
+
+    select_backend(args.device)
+    if args.device in ("auto", "tpu"):
+        ensure_usable_backend(timeout_s=180.0)
+
+    import jax
+    import jax.numpy as jnp
+
+    from wam_tpu.parallel.mesh import make_mesh
+    from wam_tpu.parallel.seq_estimators import SeqShardedWam
+    from wam_tpu.profiling import median_iqr
+    from wam_tpu.tune.autotuner import measure_candidate
+
+    n_dev = 1
+    while n_dev * 2 <= len(jax.devices()) and n_dev < 8:
+        n_dev *= 2
+    mesh = make_mesh({"data": n_dev}, jax.devices()[:n_dev])
+
+    if args.ndim == 1:
+        from wam_tpu.models.audio import toy_wave_model
+
+        model = toy_wave_model(jax.random.PRNGKey(0))
+        shape = (args.batch, args.length)
+        spec = jax.sharding.PartitionSpec(None, "data")
+        est_kw = dict(ndim=1, wavelet="db2", level=2, mode="symmetric")
+        n_classes = 4
+    else:
+        rows, cols = args.length // 32 or 32, 32
+        w = jax.random.normal(jax.random.PRNGKey(0), (5, 3, rows, cols))
+        model = lambda xx: jnp.einsum("bchw,kchw->bk", xx, w)  # noqa: E731
+        shape = (args.batch, 3, rows, cols)
+        spec = jax.sharding.PartitionSpec(None, None, "data", None)
+        est_kw = dict(ndim=2, wavelet="db2", level=2, mode="reflect")
+        n_classes = 5
+
+    sh = jax.sharding.NamedSharding(mesh, spec)
+    x = jax.device_put(jax.random.normal(jax.random.PRNGKey(1), shape), sh)
+    y = jnp.arange(args.batch, dtype=jnp.int32) % n_classes
+    key = jax.random.PRNGKey(42)
+    chunks = [None if c == "full" else int(c)
+              for c in args.chunks.split(",") if c]
+
+    print(f"# backend={jax.default_backend()} mesh=data:{n_dev} "
+          f"ndim={args.ndim} shape={shape} n={args.n_samples} "
+          f"k={args.k} laps={args.laps}", file=sys.stderr)
+    print(f"{'candidate':<22s} {'disp/call':>9s} {'median':>10s} "
+          f"{'items/s':>9s}  plane", file=sys.stderr)
+
+    rows_out = []
+    for fused in (True, False):
+        for chunk in chunks:
+            sw = SeqShardedWam(mesh, model, fused=fused, **est_kw)
+
+            def run(x, key, sw=sw, chunk=chunk):
+                return sw.smoothgrad(x, y, key, n_samples=args.n_samples,
+                                     stdev_spread=0.25, sample_chunk=chunk)
+
+            jax.block_until_ready(run(x, key))  # warm (compiles)
+            sw.dispatch_count = 0
+            jax.block_until_ready(run(x, key))
+            disp = sw.dispatch_count
+            samples, plane = measure_candidate(run, (x, key),
+                                               k=args.k, laps=args.laps)
+            med, q1, q3, _ = median_iqr(samples)
+            label = (f"chunk={chunk if chunk else 'full'} "
+                     f"{'fused' if fused else 'split'}")
+            row = {"label": label, "fused": fused, "sample_chunk": chunk,
+                   "dispatches_per_call": disp, "median_s": round(med, 6),
+                   "q1_s": round(q1, 6), "q3_s": round(q3, 6),
+                   "items_per_s": round(args.batch / med, 3), "plane": plane}
+            rows_out.append(row)
+            print(f"{label:<22s} {disp:>9d} {med * 1e3:>8.2f}ms "
+                  f"{row['items_per_s']:>9.2f}  [{plane}]", file=sys.stderr)
+
+    best = min(rows_out, key=lambda r: r["median_s"])
+    fused_best = min((r for r in rows_out if r["fused"]),
+                     key=lambda r: r["median_s"])
+    split_best = min((r for r in rows_out if not r["fused"]),
+                     key=lambda r: r["median_s"])
+    out = {
+        "backend": jax.default_backend(),
+        "plane": best["plane"],
+        "mesh_devices": n_dev,
+        "ndim": args.ndim,
+        "shape": list(shape),
+        "n_samples": args.n_samples,
+        "winner": best["label"],
+        "fused_over_split": round(
+            split_best["median_s"] / fused_best["median_s"], 3),
+        "rows": rows_out,
+    }
+    print(json.dumps(out))
+    if args.emit:
+        with open(args.emit, "w") as f:
+            json.dump(out, f, indent=2)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

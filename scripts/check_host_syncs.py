@@ -31,12 +31,16 @@ Two-tier AST scan, no imports of the scanned code:
      spans around the dispatch).
 
 Scope: wam_tpu/{core,evalsuite,serve,pipeline,wavelets,obs} plus the fleet's
-mesh plumbing (wam_tpu/parallel/{mesh,multihost}.py — the files the serve
-fleet's oversize pjit path routes through). The rest of wam_tpu/parallel
-stays out: halo_modes.py computes static shape products with
-`int(np.prod(...))` inside shard_map bodies (legal — shapes are concrete
-under trace) that this scan cannot distinguish from real syncs. The
-wavelet core entered scope with the fused synthesis path: its matrix
+mesh plumbing (wam_tpu/parallel/{mesh,multihost}.py) and the long-context
+path the fleet's sequence-sharded oversize route runs through
+(wam_tpu/parallel/{halo,halo_modes,seq_estimators}.py). halo.py and
+halo_modes.py used to be excluded for their `int(np.prod(...))` static
+shape products inside shard_map bodies (legal — shapes are concrete under
+trace — but indistinguishable from real syncs here); those are
+`math.prod` on shape tuples now, so the exclusion is lifted — the
+one-fused-dispatch estimator loops are exactly where a hidden per-sample
+sync would hurt most.
+The wavelet core entered scope with the fused synthesis path: its matrix
 builders are host-side numpy BY DESIGN (lru_cached, static under jit), so
 the scan's traced-function detection — not a directory exclusion — is
 what keeps them legal. Zero findings is the contract — the verify skill
@@ -53,7 +57,9 @@ import sys
 
 DEFAULT_DIRS = ("wam_tpu/core", "wam_tpu/evalsuite", "wam_tpu/serve",
                 "wam_tpu/pipeline", "wam_tpu/wavelets", "wam_tpu/obs",
-                "wam_tpu/parallel/mesh.py", "wam_tpu/parallel/multihost.py")
+                "wam_tpu/parallel/mesh.py", "wam_tpu/parallel/multihost.py",
+                "wam_tpu/parallel/halo.py", "wam_tpu/parallel/halo_modes.py",
+                "wam_tpu/parallel/seq_estimators.py")
 
 # wall-clock reads that become trace-time constants inside a jitted body
 CLOCK_CALLS = {"time", "perf_counter", "monotonic", "monotonic_ns",

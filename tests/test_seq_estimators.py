@@ -258,14 +258,72 @@ def test_wam3d_class_mesh_smooth_parity(label):
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-5)
 
 
-def test_wam2d_class_mesh_rejects_unsupported():
+def test_wam2d_class_mesh_nhwc_parity():
+    """mesh= + model_layout='nhwc' (gate lifted this PR): the channel-last
+    model is wrapped with an in-graph NCHW→NHWC transpose, so the sharded
+    NCHW pipeline feeds it its native layout. Same mesh + the equivalent
+    NCHW model must produce the same attribution (identical draws)."""
+    _need_devices(8)
     from wam_tpu.wam2d import WaveletAttribution2D
 
-    mesh = make_mesh({"data": len(jax.devices())})
-    with pytest.raises(ValueError, match="model_layout"):
-        WaveletAttribution2D(_pool_model_2d(), mesh=mesh, model_layout="nhwc")
-    with pytest.raises(ValueError, match="dwt_bf16"):
-        WaveletAttribution2D(_pool_model_2d(), mesh=mesh, dwt_bf16=True)
+    mesh = make_mesh({"data": 8})
+    model_nchw = _pool_model_2d()
+    model_nhwc = lambda x: model_nchw(jnp.transpose(x, (0, 3, 1, 2)))
+    kw = dict(wavelet="db2", J=2, mode="reflect", n_samples=3,
+              stdev_spread=0.1, random_seed=11)
+    x = jax.random.normal(jax.random.PRNGKey(2), (2, 3, 64, 32))
+    y = jnp.array([1, 4])
+
+    want = WaveletAttribution2D(model_nchw, mesh=mesh, **kw).smooth_wam(
+        _put_seq(x, mesh, 2), y)
+    got = WaveletAttribution2D(model_nhwc, mesh=mesh, model_layout="nhwc",
+                               **kw).smooth_wam(_put_seq(x, mesh, 2), y)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-5)
+
+
+def test_wam2d_class_mesh_nhwc_ig_parity_vs_single():
+    """mesh= + nhwc against the SINGLE-DEVICE nhwc engine (IG — no noise, so
+    the two implementations are directly comparable): the sharded NCHW
+    pipeline with the transpose-wrapped model must match the nhwc-native
+    engine (`wavelets/nhwc.py`)."""
+    _need_devices(8)
+    from wam_tpu.wam2d import WaveletAttribution2D
+
+    mesh = make_mesh({"data": 8})
+    model_nchw = _pool_model_2d()
+    model_nhwc = lambda x: model_nchw(jnp.transpose(x, (0, 3, 1, 2)))
+    kw = dict(wavelet="haar", J=2, mode="reflect", n_samples=4,
+              method="integratedgrad", model_layout="nhwc")
+    x = jax.random.normal(jax.random.PRNGKey(9), (2, 3, 64, 32))
+    y = jnp.array([0, 2])
+
+    got = WaveletAttribution2D(model_nhwc, mesh=mesh, **kw)(
+        _put_seq(x, mesh, 2), y)
+    want = WaveletAttribution2D(model_nhwc, sample_batch_size=None, **kw)(x, y)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-5)
+
+
+def test_wam2d_class_mesh_dwt_bf16_parity(mode="reflect"):
+    """mesh= + dwt_bf16 (gate lifted this PR): the fused step casts the
+    noisy input to bf16 at the decompose boundary; both the sharded and the
+    single-device analyses then upcast and accumulate f32 (the framework
+    bf16-in / f32-accumulate convention), so parity holds at the normal
+    tolerance — the only bf16 effect is the shared input rounding."""
+    _need_devices(8)
+    from wam_tpu.wam2d import WaveletAttribution2D
+
+    mesh = make_mesh({"data": 8})
+    model = _pool_model_2d()
+    kw = dict(wavelet="db2", J=2, mode=mode, n_samples=3,
+              stdev_spread=0.1, random_seed=11, dwt_bf16=True)
+    x = jax.random.normal(jax.random.PRNGKey(2), (2, 3, 64, 32))
+    y = jnp.array([1, 4])
+
+    got = WaveletAttribution2D(model, mesh=mesh, **kw).smooth_wam(
+        _put_seq(x, mesh, 2), y)
+    want = WaveletAttribution2D(model, stream_noise=True,
+                                sample_batch_size=None, **kw).smooth_wam(x, y)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-5)
 
 
 # ---------------------------------------------------------------------------
@@ -365,10 +423,10 @@ def test_seq_sharded_batch_axis_parity_and_split():
     spec = in_shardings[0].spec
     assert tuple(spec) == ("batch", "data"), spec
 
-    # 2D/3D expansive modes stay gated (batch-concat in their inverses)
-    with pytest.raises(ValueError, match="periodization"):
-        SeqShardedWam(mesh2, model, ndim=2, wavelet="db2", level=2,
-                      mode="symmetric", batch_axis="batch")
+    # the 2D/3D expansive gate is LIFTED (halo_modes threads batch_axis;
+    # tails stay replicated — see test_seq_sharded_batch_axis_expansive_2d)
+    SeqShardedWam(mesh2, model, ndim=2, wavelet="db2", level=2,
+                  mode="symmetric", batch_axis="batch")
 
 
 @pytest.mark.parametrize("wavelet,mode", [("db2", "symmetric"),
@@ -458,3 +516,167 @@ def test_seq_sharded_noise_is_shard_local():
     # the noisy output keeps the sequence sharding
     noisy = sw._noisy(x, jax.random.PRNGKey(0), jnp.int32(0), jnp.float32(0.1))
     assert len(noisy.sharding.device_set) == 8
+
+
+# ---------------------------------------------------------------------------
+# fused one-dispatch steps: bit-exactness vs the split loop, dispatch counts,
+# batch_axis through the 2D/3D expansive paths
+# ---------------------------------------------------------------------------
+
+
+def _seq_case(ndim, wavelet, mode):
+    """Small (model, x, y, level) fixture tuple per modality."""
+    from wam_tpu.models.audio import toy_wave_model
+
+    if ndim == 1:
+        return (toy_wave_model(jax.random.PRNGKey(0)),
+                jax.random.normal(jax.random.PRNGKey(1), (2, 2048)),
+                jnp.array([1, 3]), 2)
+    if ndim == 2:
+        return (_pool_model_2d(),
+                jax.random.normal(jax.random.PRNGKey(1), (2, 3, 64, 32)),
+                jnp.array([1, 4]), 2)
+    return (_pool_model_3d(),
+            jax.random.normal(jax.random.PRNGKey(1), (2, 1, 32, 8, 8)),
+            jnp.array([1, 3]), 1)
+
+
+@pytest.mark.parametrize("ndim,wavelet,mode", [
+    (1, "db3", "symmetric"),
+    (1, "db2", "periodization"),
+    (2, "db2", "reflect"),
+    (2, "haar", "periodization"),
+    (3, "db2", "symmetric"),
+])
+def test_seq_fused_vs_split_bitexact(ndim, wavelet, mode):
+    """The fused one-jit step must be BIT-IDENTICAL to the split loop —
+    same primitives, same summation order; only the jit boundary moves.
+    Covers the sequential loop, the padded chunk path (n=3, chunk=2 → one
+    weight-0 pad slot), and the IG trapezoid, for every modality and both
+    boundary families."""
+    _need_devices(8)
+    from wam_tpu.parallel.seq_estimators import SeqShardedWam
+
+    model, x_host, y, level = _seq_case(ndim, wavelet, mode)
+    mesh = make_mesh({"data": 8})
+    x = _put_seq(x_host, mesh, ndim)
+    key = jax.random.PRNGKey(7)
+    kw = dict(ndim=ndim, wavelet=wavelet, level=level, mode=mode)
+    sw_f = SeqShardedWam(mesh, model, fused=True, **kw)
+    sw_s = SeqShardedWam(mesh, model, fused=False, **kw)
+
+    for chunk in (1, 2):
+        got = sw_f.smoothgrad(x, y, key, n_samples=3, stdev_spread=0.1,
+                              sample_chunk=chunk)
+        want = sw_s.smoothgrad(x, y, key, n_samples=3, stdev_spread=0.1,
+                               sample_chunk=chunk)
+        for a, b in zip(jax.tree_util.tree_leaves(got),
+                        jax.tree_util.tree_leaves(want)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    _, ig_f = sw_f.integrated(x, y, n_steps=3, sample_chunk=2)
+    _, ig_s = sw_s.integrated(x, y, n_steps=3, sample_chunk=2)
+    for a, b in zip(jax.tree_util.tree_leaves(ig_f),
+                    jax.tree_util.tree_leaves(ig_s)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    cs_f, g_f = sw_f.attribute(x, y)
+    cs_s, g_s = sw_s.attribute(x, y)
+    for a, b in zip(jax.tree_util.tree_leaves((cs_f, g_f)),
+                    jax.tree_util.tree_leaves((cs_s, g_s))):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_seq_fused_one_dispatch_per_sample():
+    """The one-dispatch contract, probed via the estimator's dispatch
+    counter: fused smoothgrad launches exactly n_samples + 1 (final scale)
+    dispatches, the chunked loop n_chunks + 1, attribute exactly 1,
+    integrated 1 (dec) + n_steps — while the split path launches ~4× more."""
+    _need_devices(8)
+    from wam_tpu.models.audio import toy_wave_model
+    from wam_tpu.parallel.seq_estimators import SeqShardedWam
+
+    mesh = make_mesh({"data": 8})
+    model = toy_wave_model(jax.random.PRNGKey(0))
+    x = _put_seq(jax.random.normal(jax.random.PRNGKey(1), (2, 2048)), mesh, 1)
+    y = jnp.array([1, 3])
+    key = jax.random.PRNGKey(7)
+    kw = dict(ndim=1, wavelet="db3", level=2, mode="symmetric")
+
+    sw = SeqShardedWam(mesh, model, fused=True, **kw)
+    sw.dispatch_count = 0
+    sw.smoothgrad(x, y, key, n_samples=4, stdev_spread=0.1, sample_chunk=1)
+    assert sw.dispatch_count == 4 + 1, sw.dispatch_count
+
+    sw.dispatch_count = 0
+    sw.smoothgrad(x, y, key, n_samples=4, stdev_spread=0.1, sample_chunk=2)
+    assert sw.dispatch_count == 2 + 1, sw.dispatch_count
+
+    sw.dispatch_count = 0
+    sw.attribute(x, y)
+    assert sw.dispatch_count == 1, sw.dispatch_count
+
+    sw.dispatch_count = 0
+    sw.integrated(x, y, n_steps=4)
+    assert sw.dispatch_count == 1 + 4, sw.dispatch_count
+
+    split = SeqShardedWam(mesh, model, fused=False, **kw)
+    split.dispatch_count = 0
+    split.smoothgrad(x, y, key, n_samples=4, stdev_spread=0.1,
+                     sample_chunk=1)
+    # noisy + dec + grads per sample, accum from the second on, final scale
+    assert split.dispatch_count == 4 * 3 + 3 + 1, split.dispatch_count
+
+
+@pytest.mark.parametrize("ndim", [2, 3])
+def test_seq_sharded_batch_axis_expansive_23d(ndim):
+    """batch_axis through the 2D/3D EXPANSIVE (core+tail) paths — the gate
+    this PR lifts. Values must match the seq-only-mesh estimator, the cores
+    must actually carry the batch sharding, and the O(L) tails stay fully
+    replicated (constraining them batch-sharded miscompiles the synthesis
+    under legacy shard_map — DESIGN.md 'Sequence-sharded fusion')."""
+    _need_devices(8)
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from wam_tpu.parallel.halo_modes import TailedLeaf
+    from wam_tpu.parallel.seq_estimators import SeqShardedWam
+
+    if ndim == 2:
+        model = _pool_model_2d()
+        x_host = jax.random.normal(jax.random.PRNGKey(1), (8, 3, 64, 32))
+        spec2 = P("batch", None, "data", None)
+        level = 2
+    else:
+        model = _pool_model_3d()
+        x_host = jax.random.normal(jax.random.PRNGKey(1), (8, 1, 32, 8, 8))
+        spec2 = P("batch", None, "data", None, None)
+        level = 1
+    y = jnp.arange(8, dtype=jnp.int32) % 4
+    key = jax.random.PRNGKey(9)
+    kw = dict(ndim=ndim, wavelet="db2",
+              mode="reflect" if ndim == 2 else "symmetric", level=level)
+
+    mesh1 = make_mesh({"data": 8})
+    sw1 = SeqShardedWam(mesh1, model, **kw)
+    want = sw1.smoothgrad(_put_seq(x_host, mesh1, ndim), y, key,
+                          n_samples=2, stdev_spread=0.1)
+
+    mesh2 = make_mesh({"batch": 2, "data": 4})
+    sw2 = SeqShardedWam(mesh2, model, batch_axis="batch", **kw)
+    x2 = jax.device_put(x_host, NamedSharding(mesh2, spec2))
+    got = sw2.smoothgrad(x2, y, key, n_samples=2, stdev_spread=0.1)
+    for a, b in zip(jax.tree_util.tree_leaves(got),
+                    jax.tree_util.tree_leaves(want)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+
+    # the batch split must be REAL on the cores, and absent on the tails
+    cs = sw2.dec(x2)
+    for leaf in jax.tree_util.tree_leaves(
+            cs, is_leaf=lambda t: isinstance(t, TailedLeaf)):
+        if not isinstance(leaf, TailedLeaf):
+            continue
+        assert tuple(leaf.core.sharding.spec)[:1] == ("batch",), \
+            leaf.core.sharding.spec
+        if leaf.tail is not None:
+            assert "batch" not in tuple(
+                s for s in leaf.tail.sharding.spec if s), \
+                leaf.tail.sharding.spec

@@ -188,6 +188,27 @@ def test_seq_sharded_resolves_tuned_chunk(sched_cache):
     assert sw._resolve_seq_chunk(2, x, 8) == 2  # explicit passes through
 
 
+def test_seq_sharded_resolves_tuned_fused(sched_cache):
+    """``fused="auto"`` reads the ``seq_fused`` key of the same schedule
+    entry the chunk resolver uses; no entry (or an entry without the key)
+    defaults to the one-jit step."""
+    from wam_tpu.parallel.seq_estimators import SeqShardedWam
+
+    record_schedule("wamseq1d", (2048,), 2,
+                    {"sample_chunk": 2, "seq_fused": False},
+                    dtype="f32", backend=jax.default_backend())
+    x = jnp.zeros((2, 2048))
+    sw = SeqShardedWam.__new__(SeqShardedWam)
+    sw.ndim = 1
+    sw.fused = "auto"
+    assert sw._resolve_fused(x) is False  # the tuned split-loop verdict
+    sw.ndim = 2  # no wamseq2d entry: fused default
+    assert sw._resolve_fused(x) is True
+    sw.fused = True  # explicit wins over the cache
+    sw.ndim = 1
+    assert sw._resolve_fused(x) is True
+
+
 def test_serve_warmup_loads_schedule_cache(sched_cache):
     """`AttributionServer.start()` must load the schedule cache BEFORE the
     bucket warmup compiles, so tuned chunks are visible to the first trace
@@ -354,4 +375,24 @@ def test_autotune_toy_dry_run(sched_cache):
     assert ent["plane"] in ("device", "wall")
     assert len(out["results"]) >= 2
     # a dry run must leave the live schedule untouched
+    assert load_schedule_cache().get(out["key"]) is None
+
+
+def test_autotune_wamseq1d_dry_run(sched_cache):
+    """The seq-sharded preset sweeps sample_chunk × fused-vs-split with
+    explicit knobs and crowns a winner whose entry carries ``seq_fused`` —
+    the key `SeqShardedWam._resolve_fused("auto")` reads back."""
+    from conftest import need_devices
+    from wam_tpu.tune.autotuner import autotune
+    from wam_tpu.tune.workloads import get_workload
+
+    need_devices(2)
+    wl = get_workload("wamseq1d", n_samples=2, length=1024)
+    labels = [c.label() for c in wl.candidates]
+    assert any("fused" in l for l in labels)
+    assert any("split" in l for l in labels)
+    out = autotune(wl, k=1, laps=1, persist=False)
+    assert out["key"].startswith("wamseq1d|1024|b2|f32|")
+    assert out["entry"]["seq_fused"] in (True, False)
+    assert out["entry"]["items_per_s"] > 0
     assert load_schedule_cache().get(out["key"]) is None

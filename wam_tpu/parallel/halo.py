@@ -122,14 +122,13 @@ def sharded_wavedec_per(mesh: Mesh, wavelet: str, level: int, seq_axis: str = "d
     estimator). With ``batch_axis`` the leading axis must divide that mesh
     axis (checked eagerly)."""
 
-    @jax.jit
     @partial(
         shard_map,
         mesh=mesh,
         in_specs=P(batch_axis, seq_axis),
         out_specs=P(batch_axis, seq_axis),
     )
-    def apply(x_local):
+    def run_levels(x_local):
         coeffs = []
         a = x_local
         for _ in range(level):
@@ -138,11 +137,22 @@ def sharded_wavedec_per(mesh: Mesh, wavelet: str, level: int, seq_axis: str = "d
         coeffs.append(a)
         return coeffs[::-1]
 
-    def run(x):
+    @jax.jit
+    def apply(x):
+        # framework-wide bf16-in / f32-accumulate (`wavelets.transform`)
+        if x.dtype == jnp.bfloat16:
+            x = x.astype(jnp.float32)
+        return run_levels(x)
+
+    def check(x):
         _check_batch_divisible(x.shape[0], mesh, batch_axis)
+
+    def run(x):
+        check(x)
         return apply(x)
 
     run._apply = apply  # jitted body, exposed for HLO/sharding audits
+    run._check = check  # eager guards, callable separately by fused callers
     return run
 
 
@@ -180,18 +190,25 @@ def _sharded_wavedec_nd(mesh: Mesh, level: int, seq_axis: str, ndim: int, level_
 
     @jax.jit
     def apply(x):
+        # framework-wide bf16-in / f32-accumulate (`wavelets.transform`)
+        if x.dtype == jnp.bfloat16:
+            x = x.astype(jnp.float32)
         lead = x.shape[:-ndim]
         out = run(x.reshape((-1,) + x.shape[-ndim:]))
         return jax.tree_util.tree_map(lambda a: a.reshape(lead + a.shape[1:]), out)
 
-    def checked(x):
-        import numpy as _np
+    def check(x):
+        import math as _math
 
-        _check_batch_divisible(int(_np.prod(x.shape[:-ndim])) if x.ndim > ndim
+        _check_batch_divisible(_math.prod(x.shape[:-ndim]) if x.ndim > ndim
                                else 1, mesh, batch_axis)
+
+    def checked(x):
+        check(x)
         return apply(x)
 
     checked._apply = apply  # jitted body, exposed for HLO/sharding audits
+    checked._check = check  # eager guards, callable separately by fused callers
     return checked
 
 
@@ -290,15 +307,19 @@ def _sharded_waverec_nd(mesh: Mesh, seq_axis: str, ndim: int, level_fn,
         out = run(flat)
         return out.reshape(lead + out.shape[1:])
 
-    def checked(coeffs):
-        import numpy as _np
+    def check(coeffs):
+        import math as _math
 
         lead = jax.tree_util.tree_leaves(coeffs)[0].shape[:-ndim]
-        _check_batch_divisible(int(_np.prod(lead)) if lead else 1,
+        _check_batch_divisible(_math.prod(lead) if lead else 1,
                                mesh, batch_axis)
+
+    def checked(coeffs):
+        check(coeffs)
         return apply(coeffs)
 
     checked._apply = apply  # jitted body, exposed for HLO/sharding audits
+    checked._check = check  # eager guards, callable separately by fused callers
     return checked
 
 

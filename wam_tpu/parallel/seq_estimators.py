@@ -18,20 +18,23 @@ Design:
   BIT-IDENTICAL to the single-device estimator's ``materialize_noise=False``
   stream (`core.estimators.smoothgrad`, same fold_in keys); the sample
   mean differs only by float summation order.
-- Samples / α-steps are SEQUENTIAL dispatches (a Python loop with an
-  on-device accumulator). For long-context workloads the per-step graph is
-  sequence-sized and device-bound, so the per-dispatch host round trip is
-  amortized; the loop also preserves the mode path's mandatory two-dispatch
-  split (see `halo_modes.sharded_coeff_grads_mode` — fusing decompose and
-  grads into one jit trips an XLA SPMD-partitioner verifier bug on the
-  zero-size tail buffers).
-- The gradient step itself is ONE jit (reconstruct → front → model → VJP),
-  with the engines' mean-of-picked-logits loss (`core.engine.target_loss`),
-  so class-level parity with the single-device estimators is exact.
+- Each sample / α-step / chunk is ONE fused dispatch by default: noise
+  draw → decompose → reconstruct → front → model → VJP → accumulate trace
+  as a single jit (`fused=True`), with the engines' mean-of-picked-logits
+  loss (`core.engine.target_loss`), so class-level parity with the
+  single-device estimators is exact. The historical XLA SPMD-partitioner
+  failure on zero-size tail buffers that forced a decompose→grads split no
+  longer arises — statically-empty tails are OMITTED from the coefficient
+  pytree rather than carried as (B, 0) arrays (see `halo_modes` and
+  tests/test_partitioner_repro.py) — but the split loop is kept behind
+  ``fused=False`` for A/B timing and bit-exactness pinning. Dispatches
+  launched by the estimator loops are counted in ``dispatch_count`` so the
+  one-dispatch contract is testable without profiles.
 """
 
 from __future__ import annotations
 
+import functools
 from typing import Any, Callable
 
 import jax
@@ -41,11 +44,32 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from wam_tpu.core.engine import target_loss
 from wam_tpu.core.estimators import noise_sigma
+from wam_tpu.obs import sentinel as obs_sentinel
 from wam_tpu.parallel import halo
 from wam_tpu.parallel import halo_modes
 from wam_tpu.parallel.halo_modes import gather_coeffs, gather_leaf
 
 __all__ = ["seq_sharded_wam", "SeqShardedWam"]
+
+
+def _sentinel_jit(fn, *, detail: str | None = None, **jit_kwargs):
+    """`jax.jit` with a trace-time report to the compile sentinel
+    (`wam_tpu.obs.sentinel`, entry_kind ``"seq"``). ``dispatch_count``
+    counts launches; the sentinel counts COMPILES — the serve fleet's
+    sequence-sharded oversize route warm-verifies through
+    ``assert_no_retrace``, which only sees jits that self-report. The
+    report is a python side effect of tracing, so cached executions cost
+    nothing. Split-path dec/rec builder jits (`halo`, `halo_modes`) stay
+    silent; the fused path's outer jit inlines them at trace time, so one
+    event per fused graph is the complete compile story there."""
+    name = detail or getattr(fn, "__name__", "seq")
+
+    @functools.wraps(fn)
+    def traced(*args, **kwargs):
+        obs_sentinel.record_trace("seq", detail=name)
+        return fn(*args, **kwargs)
+
+    return jax.jit(traced, **jit_kwargs)
 
 _DEC_PER = {1: halo.sharded_wavedec_per, 2: halo.sharded_wavedec2_per,
             3: halo.sharded_wavedec3_per}
@@ -81,6 +105,20 @@ class SeqShardedWam:
     unbatched signal slips past the sharding constraints (its leading axis
     is read as batch) and mis-shards silently, so the entry points reject
     ``x.ndim <= ndim`` loudly instead.
+
+    ``fused`` (default True) traces each sample / chunk / α-step as ONE jit
+    — draw, decompose, grads and accumulation in a single dispatch.
+    ``fused=False`` keeps the historical split loop (separate noisy / dec /
+    grads / accum dispatches) for A/B timing; ``fused="auto"`` consults the
+    schedule cache (key ``seq_fused``, swept by `wam_tpu.tune`). Both paths
+    produce BIT-IDENTICAL results (same primitives, same summation order —
+    pinned in tests/test_seq_estimators.py). ``dispatch_count`` advances
+    once per jitted computation the entry points launch.
+
+    ``dwt_bf16`` casts the signal to bfloat16 at the decompose boundary
+    (the sharded analysis kernels accumulate in float32 — same convention
+    as the single-device engines' ``dwt_bf16``); everything downstream of
+    the coefficients stays float32.
     """
 
     def __init__(
@@ -97,6 +135,8 @@ class SeqShardedWam:
         front_grads: bool = False,
         post_fn: Callable[[Any], Any] | None = None,
         batch_axis: str | None = None,
+        fused: bool | str = True,
+        dwt_bf16: bool = False,
     ):
         if ndim not in (1, 2, 3):
             raise ValueError(f"ndim must be 1, 2 or 3, got {ndim}")
@@ -104,16 +144,9 @@ class SeqShardedWam:
             raise ValueError("front_grads=True requires front_fn")
         if front_grads and post_fn is not None:
             raise ValueError("front_grads and post_fn are mutually exclusive")
-        if batch_axis is not None and mode != "periodization" and ndim != 1:
-            # the 2D/3D expansive-mode inverses batch several subband
-            # letters through one shard_map call by CONCATENATING along the
-            # leading axis — sharded-batch concat there is unresolved, so
-            # batch_axis covers periodization (all ndim) and the 1D
-            # expansive path
-            raise ValueError(
-                "batch_axis= supports mode='periodization' (any ndim) "
-                "or ndim=1 expansive modes"
-            )
+        if fused not in (True, False, "auto"):
+            raise ValueError(f"fused must be True, False or 'auto'; "
+                             f"got {fused!r}")
         if batch_axis is not None:
             if batch_axis not in mesh.axis_names:
                 raise ValueError(
@@ -130,6 +163,9 @@ class SeqShardedWam:
         self.front_grads = front_grads
         self.post_fn = post_fn
         self.model_fn = model_fn
+        self.fused = fused
+        self.dwt_bf16 = dwt_bf16
+        self.dispatch_count = 0  # jitted dispatches launched by entry points
         self.periodized = mode == "periodization"
         if self.periodized:
             # batch_axis shards the LEADING axis over the remaining mesh —
@@ -139,50 +175,75 @@ class SeqShardedWam:
             rec = _REC_PER[ndim](mesh, wavelet, seq_axis, batch_axis)
             self._rec_signal = rec
             self._gather = lambda tree: tree  # leaves already plain arrays
-        elif ndim == 1 and batch_axis is not None:
-            self.dec = _DEC_MODE[1](mesh, wavelet, level, mode, seq_axis,
-                                    batch_axis)
-            rec = _REC_MODE[1](mesh, wavelet, seq_axis, batch_axis)
-            self._rec_signal = lambda cs: gather_leaf(rec(cs), axis=-1)
-            self._gather = lambda tree: gather_coeffs(tree, ndim=1)
         else:
-            self.dec = _DEC_MODE[ndim](mesh, wavelet, level, mode, seq_axis)
-            rec = _REC_MODE[ndim](mesh, wavelet, seq_axis)
+            # batch_axis note: the expansive paths shard only the CORES over
+            # it — the O(L) tails stay fully replicated (see halo_modes /
+            # DESIGN.md "Sequence-sharded fusion" on the legacy-shard_map
+            # batch-sharded-tail miscompile)
+            self.dec = _DEC_MODE[ndim](mesh, wavelet, level, mode, seq_axis,
+                                       batch_axis)
+            rec = _REC_MODE[ndim](mesh, wavelet, seq_axis, batch_axis)
             self._rec_signal = lambda cs: gather_leaf(rec(cs), axis=-ndim)
             self._gather = lambda tree: gather_coeffs(tree, ndim=ndim)
         # one jitted gradient step per (labelled?, spatial shape); spatial is
         # static so the crop after reconstruction has a fixed slice size
-        self._grads = jax.jit(self._grads_impl, static_argnames=("spatial",))
-        self._grads_ig = jax.jit(
+        self._grads = _sentinel_jit(self._grads_impl,
+                                    static_argnames=("spatial",))
+        self._grads_ig = _sentinel_jit(
             lambda cs, alpha, y, spatial: self._grads_impl(
                 jax.tree_util.tree_map(lambda c: c * alpha, cs), y, spatial
             ),
+            detail="_grads_ig",
             static_argnames=("spatial",),
         )
-        self._noisy = jax.jit(self._noisy_impl)
-        self._noisy_chunk = jax.jit(self._noisy_chunk_impl,
-                                    static_argnames=("g",))
-        self._grads_chunk = jax.jit(self._grads_chunk_impl,
-                                    static_argnames=("spatial", "g"))
-        self._grads_ig_chunk = jax.jit(self._grads_ig_chunk_impl,
-                                       static_argnames=("spatial", "g"))
+        self._noisy = _sentinel_jit(self._noisy_impl)
+        self._noisy_chunk = _sentinel_jit(self._noisy_chunk_impl,
+                                          static_argnames=("g",))
+        self._grads_chunk = _sentinel_jit(self._grads_chunk_impl,
+                                          static_argnames=("spatial", "g"))
+        self._grads_ig_chunk = _sentinel_jit(self._grads_ig_chunk_impl,
+                                             static_argnames=("spatial", "g"))
         # smooth accumulates plain sums (like `estimators.smoothgrad`); the
         # IG accumulator applies the per-element nan_to_num of
         # `estimators.trapezoid`
-        self._accum = jax.jit(
-            lambda acc, g, w: jax.tree_util.tree_map(lambda a, b: a + w * b, acc, g)
+        self._accum = _sentinel_jit(
+            lambda acc, g, w: jax.tree_util.tree_map(lambda a, b: a + w * b, acc, g),
+            detail="_accum",
         )
-        self._accum_nan = jax.jit(
+        self._accum_nan = _sentinel_jit(
             lambda acc, g, w: jax.tree_util.tree_map(
                 lambda a, b: a + w * jnp.nan_to_num(b), acc, g
-            )
+            ),
+            detail="_accum_nan",
         )
-        self._first_nan = jax.jit(
-            lambda g, w: jax.tree_util.tree_map(lambda b: w * jnp.nan_to_num(b), g)
+        self._first_nan = _sentinel_jit(
+            lambda g, w: jax.tree_util.tree_map(lambda b: w * jnp.nan_to_num(b), g),
+            detail="_first_nan",
         )
-        self._scale = jax.jit(
-            lambda tree, s: jax.tree_util.tree_map(lambda a: s * a, tree)
+        self._scale = _sentinel_jit(
+            lambda tree, s: jax.tree_util.tree_map(lambda a: s * a, tree),
+            detail="_scale",
         )
+        # fused one-dispatch steps: draw → decompose → grads (→ accumulate)
+        # in a single jit; the *_acc variants take the running accumulator so
+        # steps after the first stay one dispatch (plain a + b — bit-equal to
+        # the split loop's `a + 1.0 * b` accumulator)
+        self._fused_attr = _sentinel_jit(self._fused_attr_impl,
+                                         static_argnames=("spatial",))
+        self._fused_step = _sentinel_jit(self._fused_step_impl,
+                                         static_argnames=("spatial",))
+        self._fused_step_acc = _sentinel_jit(self._fused_step_acc_impl,
+                                             static_argnames=("spatial",))
+        self._fused_chunk = _sentinel_jit(self._fused_chunk_impl,
+                                          static_argnames=("spatial", "g"))
+        self._fused_chunk_acc = _sentinel_jit(self._fused_chunk_acc_impl,
+                                              static_argnames=("spatial", "g"))
+        self._fused_ig_first = _sentinel_jit(self._fused_ig_first_impl,
+                                             static_argnames=("spatial",))
+        self._fused_ig_step = _sentinel_jit(self._fused_ig_step_impl,
+                                            static_argnames=("spatial",))
+        self._fused_ig_chunk_acc = _sentinel_jit(self._fused_ig_chunk_acc_impl,
+                                                 static_argnames=("spatial", "g"))
 
     # -- pieces ------------------------------------------------------------
 
@@ -202,6 +263,33 @@ class SeqShardedWam:
             chunk = ent["sample_chunk"]
             return None if chunk is None else max(1, int(chunk))
         return 1
+
+    def _resolve_fused(self, x) -> bool:
+        """``fused="auto"``: consult the same schedule cache as
+        `_resolve_seq_chunk` (key ``seq_fused``, swept by `wam_tpu.tune`);
+        no entry → True, the one-jit step."""
+        if self.fused != "auto":
+            return bool(self.fused)
+        from wam_tpu.tune import lookup_schedule
+
+        ent = lookup_schedule(f"wamseq{self.ndim}d", tuple(x.shape[1:]),
+                              x.shape[0])
+        if ent is not None and "seq_fused" in ent:
+            return bool(ent["seq_fused"])
+        return True
+
+    def _call(self, fn, *args, **kwargs):
+        """Launch one jitted computation, counting it — ``dispatch_count``
+        lets tests and benches assert the fused path's one-dispatch-per-
+        sample contract without parsing profiles."""
+        self.dispatch_count += 1
+        return fn(*args, **kwargs)
+
+    def _dec_input(self, sig):
+        """Decompose-boundary cast (trace-level): ``dwt_bf16`` rounds the
+        signal to bfloat16 before analysis; the sharded kernels upcast to
+        float32 internally, so only the input quantization changes."""
+        return sig.astype(jnp.bfloat16) if self.dwt_bf16 else sig
 
     def _reconstruct(self, cs, spatial):
         sig = self._rec_signal(cs)
@@ -353,6 +441,57 @@ class SeqShardedWam:
         cs_flat = jax.tree_util.tree_map(scaled, cs)
         return self._chunk_grads_core(cs_flat, y_flat, w, spatial, g, nan=True)
 
+    # -- fused one-dispatch steps ------------------------------------------
+    # Each wraps the SAME impl pieces the split loop dispatches separately,
+    # so the two paths share every primitive and stay bit-identical; only
+    # the jit boundary moves. `self.dec._apply` is the decomposition's
+    # jitted body (nested jit — inlined into this trace); its eager shape
+    # checks run once per entry point via `self.dec._check`.
+
+    def _fused_attr_impl(self, x, y, spatial):
+        cs = self.dec._apply(self._dec_input(x))
+        return cs, self._grads_impl(cs, y, spatial)
+
+    def _fused_step_impl(self, x, key, i, stdev_spread, y, spatial):
+        noisy = self._noisy_impl(x, key, i, stdev_spread)
+        cs = self.dec._apply(self._dec_input(noisy))
+        return self._grads_impl(cs, y, spatial)
+
+    def _fused_step_acc_impl(self, acc, x, key, i, stdev_spread, y, spatial):
+        g = self._fused_step_impl(x, key, i, stdev_spread, y, spatial)
+        return jax.tree_util.tree_map(lambda a, b: a + b, acc, g)
+
+    def _fused_chunk_impl(self, x, key, i0, stdev_spread, y_flat, w, spatial,
+                          g):
+        noisy = self._noisy_chunk_impl(x, key, i0, stdev_spread, g)
+        cs = self.dec._apply(self._dec_input(noisy))
+        return self._chunk_grads_core(cs, y_flat, w, spatial, g, nan=False)
+
+    def _fused_chunk_acc_impl(self, acc, x, key, i0, stdev_spread, y_flat, w,
+                              spatial, g):
+        part = self._fused_chunk_impl(x, key, i0, stdev_spread, y_flat, w,
+                                      spatial, g)
+        return jax.tree_util.tree_map(lambda a, b: a + b, acc, part)
+
+    def _fused_ig_first_impl(self, cs, alpha, w, y, spatial):
+        g = self._grads_impl(
+            jax.tree_util.tree_map(lambda c: c * alpha, cs), y, spatial
+        )
+        return jax.tree_util.tree_map(lambda b: w * jnp.nan_to_num(b), g)
+
+    def _fused_ig_step_impl(self, acc, cs, alpha, w, y, spatial):
+        g = self._grads_impl(
+            jax.tree_util.tree_map(lambda c: c * alpha, cs), y, spatial
+        )
+        return jax.tree_util.tree_map(
+            lambda a, b: a + w * jnp.nan_to_num(b), acc, g
+        )
+
+    def _fused_ig_chunk_acc_impl(self, acc, cs, alphas, y_flat, w, spatial,
+                                 g):
+        part = self._grads_ig_chunk_impl(cs, alphas, y_flat, w, spatial, g)
+        return jax.tree_util.tree_map(lambda a, b: a + b, acc, part)
+
     # -- gradient core (single pass) ---------------------------------------
 
     def _check_batched(self, x):
@@ -366,11 +505,17 @@ class SeqShardedWam:
 
     def attribute(self, x, y=None):
         """One un-noised pass: (coeffs, grads) like `WamEngine.attribute`,
-        coefficient leaves gathered to plain (sequence-sharded) arrays."""
+        coefficient leaves gathered to plain (sequence-sharded) arrays.
+        Fused: decompose AND grads in one dispatch."""
         self._check_batched(x)
-        coeffs = self.dec(x)
         spatial = tuple(x.shape[-self.ndim:])
-        grads = self._grads(coeffs, y, spatial=spatial)
+        if self._resolve_fused(x):
+            self.dec._check(x)
+            coeffs, grads = self._call(self._fused_attr, x, y,
+                                       spatial=spatial)
+        else:
+            coeffs = self._call(self.dec, self._dec_input(x))
+            grads = self._call(self._grads, coeffs, y, spatial=spatial)
         return self._gather(coeffs), self._finalize(grads)
 
     # -- estimators --------------------------------------------------------
@@ -389,20 +534,35 @@ class SeqShardedWam:
         means ALL samples in one dispatch (the resolvers' full-vmap
         convention). Identical draws and per-sample gradients; only the
         summation order differs. ``"auto"`` consults the round-6 schedule
-        cache (`_resolve_seq_chunk`)."""
+        cache (`_resolve_seq_chunk`).
+
+        Fused (default): ONE dispatch per sample (or per chunk) — draw,
+        decompose, grads and accumulation in a single jit."""
         self._check_batched(x)
+        fused = self._resolve_fused(x)
         sample_chunk = self._resolve_seq_chunk(sample_chunk, x, n_samples)
         if sample_chunk is None:
             sample_chunk = n_samples
         spatial = tuple(x.shape[-self.ndim:])
         spread = jnp.asarray(stdev_spread, x.dtype)
+        if fused:
+            self.dec._check(x)  # eager guards once; the loop skips run()
         acc = None
         if sample_chunk <= 1:
             for i in range(n_samples):
-                noisy = self._noisy(x, key, jnp.asarray(i, jnp.int32), spread)
-                coeffs = self.dec(noisy)
-                g = self._grads(coeffs, y, spatial=spatial)
-                acc = g if acc is None else self._accum(acc, g, 1.0)
+                ii = jnp.asarray(i, jnp.int32)
+                if fused:
+                    acc = (self._call(self._fused_step, x, key, ii, spread,
+                                      y, spatial=spatial)
+                           if acc is None else
+                           self._call(self._fused_step_acc, acc, x, key, ii,
+                                      spread, y, spatial=spatial))
+                else:
+                    noisy = self._call(self._noisy, x, key, ii, spread)
+                    coeffs = self._call(self.dec, self._dec_input(noisy))
+                    g = self._call(self._grads, coeffs, y, spatial=spatial)
+                    acc = (g if acc is None
+                           else self._call(self._accum, acc, g, 1.0))
         else:
             # every chunk runs at the SAME static size g (a remainder chunk
             # is padded with weight-0 samples), so one compiled shape covers
@@ -418,14 +578,24 @@ class SeqShardedWam:
                 n_real = min(g, n_samples - i)
                 w = jnp.asarray([1.0] * n_real + [0.0] * (g - n_real),
                                 x.dtype)
-                noisy = self._noisy_chunk(x, key, jnp.asarray(i, jnp.int32),
-                                          spread, g=g)
-                coeffs = self.dec(noisy)
-                part = self._grads_chunk(coeffs, y_flat, w, spatial=spatial,
-                                         g=g)
-                acc = part if acc is None else self._accum(acc, part, 1.0)
+                ii = jnp.asarray(i, jnp.int32)
+                if fused:
+                    acc = (self._call(self._fused_chunk, x, key, ii, spread,
+                                      y_flat, w, spatial=spatial, g=g)
+                           if acc is None else
+                           self._call(self._fused_chunk_acc, acc, x, key, ii,
+                                      spread, y_flat, w, spatial=spatial,
+                                      g=g))
+                else:
+                    noisy = self._call(self._noisy_chunk, x, key, ii, spread,
+                                       g=g)
+                    coeffs = self._call(self.dec, self._dec_input(noisy))
+                    part = self._call(self._grads_chunk, coeffs, y_flat, w,
+                                      spatial=spatial, g=g)
+                    acc = (part if acc is None
+                           else self._call(self._accum, acc, part, 1.0))
                 i += n_real
-        return self._finalize(self._scale(acc, 1.0 / n_samples))
+        return self._finalize(self._call(self._scale, acc, 1.0 / n_samples))
 
     def integrated(self, x, y, *, n_steps: int, dx: float = 1.0,
                    sample_chunk: int | None | str = 1):
@@ -435,11 +605,15 @@ class SeqShardedWam:
         (gathered coeffs, integral pytree); the caller multiplies by its
         baseline. ``sample_chunk`` batches that many α-steps per dispatch
         (None = all, "auto" = schedule cache), same mechanics as
-        `smoothgrad`'s."""
+        `smoothgrad`'s.
+
+        Fused (default): decompose once, then ONE dispatch per α-step (or
+        per chunk) — grads and trapezoid accumulation in a single jit."""
         self._check_batched(x)
+        fused = self._resolve_fused(x)
         sample_chunk = self._resolve_seq_chunk(sample_chunk, x, n_steps)
         spatial = tuple(x.shape[-self.ndim:])
-        coeffs = self.dec(x)
+        coeffs = self._call(self.dec, self._dec_input(x))
         alphas = jnp.linspace(0.0, 1.0, n_steps, dtype=jnp.float32)
 
         def trap_w(i):
@@ -453,9 +627,21 @@ class SeqShardedWam:
         acc = None
         if sample_chunk <= 1:
             for i in range(n_steps):
-                g = self._grads_ig(coeffs, alphas[i], y, spatial=spatial)
-                acc = (self._first_nan(g, trap_w(i) * dx) if acc is None
-                       else self._accum_nan(acc, g, trap_w(i) * dx))
+                if fused:
+                    acc = (self._call(self._fused_ig_first, coeffs,
+                                      alphas[i], trap_w(i) * dx, y,
+                                      spatial=spatial)
+                           if acc is None else
+                           self._call(self._fused_ig_step, acc, coeffs,
+                                      alphas[i], trap_w(i) * dx, y,
+                                      spatial=spatial))
+                else:
+                    g = self._call(self._grads_ig, coeffs, alphas[i], y,
+                                   spatial=spatial)
+                    acc = (self._call(self._first_nan, g, trap_w(i) * dx)
+                           if acc is None else
+                           self._call(self._accum_nan, acc, g,
+                                      trap_w(i) * dx))
         else:
             n_chunks = -(-n_steps // min(sample_chunk, n_steps))
             g_sz = -(-n_steps // n_chunks)
@@ -473,9 +659,17 @@ class SeqShardedWam:
                     + [0.0] * (g_sz - n_real),
                     jnp.float32,
                 )
-                part = self._grads_ig_chunk(coeffs, a_chunk, y_flat, w,
-                                            spatial=spatial, g=g_sz)
-                acc = part if acc is None else self._accum(acc, part, 1.0)
+                if fused and acc is not None:
+                    # chunk step is already one dispatch; fusing folds the
+                    # accumulator add in too
+                    acc = self._call(self._fused_ig_chunk_acc, acc, coeffs,
+                                     a_chunk, y_flat, w, spatial=spatial,
+                                     g=g_sz)
+                else:
+                    part = self._call(self._grads_ig_chunk, coeffs, a_chunk,
+                                      y_flat, w, spatial=spatial, g=g_sz)
+                    acc = (part if acc is None
+                           else self._call(self._accum, acc, part, 1.0))
                 i += n_real
         return self._gather(coeffs), self._finalize(acc)
 

@@ -30,6 +30,19 @@ routing layer in front:
   oversize result is bit-identical to the single-chip entry on the same
   padded batch (tests/test_fleet.py pins this). AOT keys for this entry
   must be replica-count tagged (`serve.entry.fleet_aot_key`).
+- **Oversize ITEMS (sequence-sharded route)**: a request whose ITEM shape
+  exceeds every configured bucket used to be a hard `NoBucketError` from
+  `attribute_batch`. With a ``seq_factory``, the fleet instead runs the
+  whole batch through a sequence-sharded entry over the fleet mesh
+  (`parallel.seq_estimators.SeqShardedWam` under the hood of a typical
+  factory): the signal's sequence axis shards across chips, so a single
+  long-context item that no chip could bucket still resolves — one fused
+  dispatch per sample (the estimator's one-jit step), obs span
+  ``seq_sharded_batch``, compile-sentinel labels (phase
+  ``"seq_sharded"``; the estimator's jits self-report, so
+  ``assert_no_retrace`` verifies the warm path), and a ``note_batch``
+  ledger row on the shared oversize `ServeMetrics`. Per-item `submit`
+  still raises `NoBucketError` — the route is batch-level and blocking.
 - **Replica death**: a request whose entry raised (anything that is not a
   per-request `ServeError`) marks its replica dead fleet-wide and is
   re-routed to the survivors; items queued behind the failure drain with
@@ -70,7 +83,13 @@ import numpy as np
 from wam_tpu.obs import sentinel as obs_sentinel
 from wam_tpu.obs import tracing as obs_tracing
 from wam_tpu.pipeline.stager import put_committed
-from wam_tpu.serve.buckets import Bucket, BucketTable, bucket_key, pad_item
+from wam_tpu.serve.buckets import (
+    Bucket,
+    BucketTable,
+    NoBucketError,
+    bucket_key,
+    pad_item,
+)
 from wam_tpu.serve.metrics import FleetMetrics, ServeMetrics
 from wam_tpu.serve.runtime import (
     AttributionServer,
@@ -130,6 +149,14 @@ class FleetServer:
     oversize : "pjit" dispatches oversize batches data-parallel over the
         fleet mesh; "fanout" always splits them into routed per-item
         submits (no fleet-wide graph, no extra compile).
+    seq_factory : optional ``seq_factory(mesh) -> entry`` building the
+        sequence-sharded handler for ITEM shapes no bucket admits;
+        ``entry(xs, ys)`` (``ys=None`` on an unlabeled fleet) must accept
+        the whole host batch and return the stacked attribution (e.g. a
+        `WaveletAttribution1D(..., mesh=mesh).smooth_wam` closure). Built
+        LAZILY on the first oversize-item batch — a fleet that never sees
+        one never traces the seq graph. Without it, such batches keep
+        raising `NoBucketError` (module docstring).
     queue_depth : per-replica bound — total fleet admission capacity is
         ``replicas × queue_depth``.
     metrics : a shared `FleetMetrics` (fresh when None); per-replica
@@ -172,6 +199,7 @@ class FleetServer:
         metrics: FleetMetrics | None = None,
         metrics_path: str | None = None,
         oversize: str = "pjit",
+        seq_factory=None,
         dtype=np.float32,
         pipelined: bool = True,
         auto_start: bool = True,
@@ -231,6 +259,8 @@ class FleetServer:
         self._os_entry = None
         self._mesh = None
         self._os_lock = threading.Lock()
+        self._seq_factory = seq_factory
+        self._seq_entry = None  # built lazily on first oversize-item batch
         if oversize == "pjit" and n > 1:
             from wam_tpu.parallel.mesh import replica_mesh
 
@@ -303,6 +333,7 @@ class FleetServer:
             "max_batch": self.max_batch,
             "labeled": self.labeled,
             "oversize": self.oversize,
+            "seq_route": self._seq_factory is not None,
         }
 
     # -- client side --------------------------------------------------------
@@ -361,7 +392,14 @@ class FleetServer:
                 raise ValueError(f"{len(xs)} items but {len(ys)} labels")
         elif ys is not None:
             raise ValueError("unlabeled fleet: attribute_batch() must not carry labels")
-        bucket = self.table.select(xs.shape[1:])
+        try:
+            bucket = self.table.select(xs.shape[1:])
+        except NoBucketError:
+            # item shape exceeds every bucket: sequence-sharded route when
+            # configured (module docstring), the historical rejection if not
+            if self._seq_factory is None:
+                raise
+            return self._dispatch_seq_sharded(xs, ys)
         with self._lock:
             fleet_whole = self._os_entry is not None and all(
                 r.alive for r in self._replicas
@@ -473,6 +511,58 @@ class FleetServer:
             self._route(req, raise_errors=False)
         except Exception as e:  # defensive: a callback must never raise
             req.future.set_exception(e)
+
+    # -- oversize-item sequence-sharded path --------------------------------
+
+    def _dispatch_seq_sharded(self, xs: np.ndarray, ys):
+        """Run a batch whose ITEM shape no bucket admits through the
+        sequence-sharded entry over the fleet mesh. Serialized on
+        ``_os_lock`` for the same reason as `_dispatch_oversize` (the
+        dispatch owns every chip); the entry is built lazily from
+        ``seq_factory`` on first use, so the seq graph only ever compiles
+        in fleets that see long-context traffic. Deadlines do not preempt
+        the dispatch — the route is synchronous and whole-batch. Ledger
+        rows land on the shared oversize `ServeMetrics` with the item
+        shape as the bucket key (no configured bucket names this shape)."""
+        metrics = self.metrics.oversize
+        metrics.note_submit(len(xs))
+        item_shape = tuple(xs.shape[1:])
+        skey = bucket_key(item_shape)
+        with self._os_lock:
+            entry = self._seq_entry
+            if entry is None:
+                mesh = self._mesh
+                if mesh is None:
+                    # oversize="fanout" / single-replica fleets build no
+                    # pjit mesh up front; the seq route needs one either way
+                    from wam_tpu.parallel.mesh import replica_mesh
+
+                    mesh = replica_mesh(self.n_replicas, self.devices)
+                entry = self._seq_entry = self._seq_factory(mesh)
+            t0 = time.perf_counter()
+            # sentinel labels so the seq graph's (expected) first traces
+            # self-identify; the estimator's jits report under kind "seq"
+            with obs_tracing.span(
+                "seq_sharded_batch", cat="fleet", bucket=skey, n_real=len(xs)
+            ), obs_sentinel.label(
+                replica=OVERSIZE_ENTRY_ID, bucket=skey, phase="seq_sharded"
+            ):
+                with metrics.stages.stage("dispatch"):
+                    out = entry(xs, ys if self.labeled else None)
+                with metrics.stages.stage("harvest"):
+                    out = jax.device_get(out)
+            service_s = time.perf_counter() - t0
+            metrics.note_batch(
+                bucket_shape=item_shape,
+                n_real=len(xs),
+                max_batch=len(xs),  # whole batch in one dispatch: fill 1.0
+                pad_waste=0.0,  # no bucket pad — the entry takes exact shapes
+                queue_depth=0,
+                service_s=service_s,
+                queue_waits_s=[0.0] * len(xs),
+                latencies_s=[service_s] * len(xs),
+            )
+        return jax.tree_util.tree_map(np.asarray, out)
 
     # -- oversize data-parallel path ----------------------------------------
 

@@ -25,7 +25,8 @@ def main(argv=None) -> int:
         description="Sweep candidate schedules and persist the winner.",
     )
     p.add_argument("--workload", default="toy",
-                   help="preset name: toy | flagship | mu2d")
+                   help="preset name: toy | flagship | mu2d | fan2d | "
+                        "wamseq1d | wamseq2d")
     p.add_argument("--device", default="auto",
                    help="backend: auto | tpu | cpu")
     p.add_argument("--k", type=int, default=3, help="samples per candidate")

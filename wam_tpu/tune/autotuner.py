@@ -38,6 +38,7 @@ class Candidate:
     layout: str | None = None  # "nhwc" | "nchw" (2D engines)
     fan_cap: int | None = None  # evaluation fan chunk cap (eval workloads)
     fan_chunk: int | None = None  # eval images-per-chunk override (fan engine)
+    seq_fused: bool | None = None  # seq-sharded one-jit step vs split loop
 
     def label(self) -> str:
         parts = [f"chunk={self.sample_chunk if self.sample_chunk else 'full'}"]
@@ -53,13 +54,15 @@ class Candidate:
             parts.append(f"fan={self.fan_cap}")
         if self.fan_chunk is not None:
             parts.append(f"fchunk={self.fan_chunk}")
+        if self.seq_fused is not None:
+            parts.append("fused" if self.seq_fused else "split")
         return " ".join(parts)
 
     def entry(self) -> dict:
         """The knob fields of a schedule-cache entry."""
         out: dict = {"sample_chunk": self.sample_chunk}
         for field in ("stream_noise", "dwt_impl", "synth_impl", "layout",
-                      "fan_cap", "fan_chunk"):
+                      "fan_cap", "fan_chunk", "seq_fused"):
             v = getattr(self, field)
             if v is not None:
                 out[field] = v

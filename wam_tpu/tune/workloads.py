@@ -21,6 +21,13 @@ Presets:
   slowest eval row).
 - ``fan2d`` — the insertion-AUC fan at production geometry, same two axes,
   persisted under the (n_iter+1)-row eval2d key every AUC metric resolves.
+- ``wamseq1d`` / ``wamseq2d`` — the sequence-sharded long-context loops
+  (`parallel.seq_estimators.SeqShardedWam`) over the largest power-of-two
+  device mesh available, sweeping the sample chunk × the fused-vs-split
+  dispatch knob (`Candidate.seq_fused`). Winners persist under the
+  ``wamseq{n}d`` keys that `SeqShardedWam` resolves ``sample_chunk="auto"``
+  and ``fused="auto"`` from — until a sweep runs, those fall back to
+  chunk 1 / fused.
 """
 
 from __future__ import annotations
@@ -258,11 +265,106 @@ def _fan2d_workload(n_images: int = 8, image: int = 224,
                     build=build)
 
 
+def _seq_mesh():
+    """Largest power-of-two ('data',) mesh the backend offers — the seq
+    loops' divisibility checks (sharded axis % 2·shards at every level)
+    want power-of-two shard counts; a lone CPU device still sweeps (the
+    ordering signal is the dispatch structure, which is device-count
+    independent)."""
+    import jax as _jax
+
+    from wam_tpu.parallel.mesh import make_mesh
+
+    n = 1
+    while n * 2 <= len(_jax.devices()) and n < 8:
+        n *= 2
+    return make_mesh({"data": n}, _jax.devices()[:n])
+
+
+def _seq_candidates(chunks=(1, 2, None)) -> list[Candidate]:
+    """The seq sweep space: sample-chunk ladder × fused-vs-split. Explicit
+    values only — `SeqShardedWam` resolves BOTH knobs from the entry this
+    sweep writes, so reading "auto" here would be circular."""
+    return [Candidate(sample_chunk=c, seq_fused=f)
+            for f in (True, False) for c in chunks]
+
+
+def _wamseq1d_workload(n_samples: int = 4, batch: int = 2,
+                       length: int = 2048) -> Workload:
+    """1D long-context SmoothGrad over the sequence-sharded estimator: the
+    signal axis shards over the mesh, each candidate bakes in an explicit
+    (sample_chunk, fused) pair, and the winner persists under the
+    ``wamseq1d`` key `SeqShardedWam._resolve_seq_chunk`/`_resolve_fused`
+    consult."""
+    from wam_tpu.models.audio import toy_wave_model
+    from wam_tpu.parallel.seq_estimators import SeqShardedWam
+
+    mesh = _seq_mesh()
+    model = toy_wave_model(jax.random.PRNGKey(0))
+    sh = jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec(None, "data"))
+    x = jax.device_put(
+        jax.random.normal(jax.random.PRNGKey(1), (batch, length)), sh)
+    y = jnp.arange(batch, dtype=jnp.int32) % 4
+    key = jax.random.PRNGKey(42)
+
+    def build(cand: Candidate):
+        sw = SeqShardedWam(mesh, model, ndim=1, wavelet="db2", level=2,
+                           mode="symmetric", fused=bool(cand.seq_fused))
+
+        def run(x, key):
+            return sw.smoothgrad(x, y, key, n_samples=n_samples,
+                                 stdev_spread=0.25,
+                                 sample_chunk=cand.sample_chunk)
+
+        return run, (x, key)
+
+    return Workload(name="wamseq1d", workload="wamseq1d", shape=(length,),
+                    batch=batch, items=batch, candidates=_seq_candidates(),
+                    build=build)
+
+
+def _wamseq2d_workload(n_samples: int = 4, batch: int = 2,
+                       rows: int = 64, cols: int = 32) -> Workload:
+    """2D row-sharded SmoothGrad, same sweep axes as ``wamseq1d`` — the
+    mesh path the engine classes take for images taller than a chip."""
+    from wam_tpu.parallel.seq_estimators import SeqShardedWam
+
+    mesh = _seq_mesh()
+    w = jax.random.normal(jax.random.PRNGKey(0), (5, 3, rows, cols))
+
+    def model(xx):  # (B, C, H, W) -> (B, 5); row-contraction all-reduces
+        return jnp.einsum("bchw,kchw->bk", xx, w)
+
+    sh = jax.sharding.NamedSharding(
+        mesh, jax.sharding.PartitionSpec(None, None, "data", None))
+    x = jax.device_put(
+        jax.random.normal(jax.random.PRNGKey(1), (batch, 3, rows, cols)), sh)
+    y = jnp.arange(batch, dtype=jnp.int32) % 5
+    key = jax.random.PRNGKey(42)
+
+    def build(cand: Candidate):
+        sw = SeqShardedWam(mesh, model, ndim=2, wavelet="db2", level=2,
+                           mode="reflect", fused=bool(cand.seq_fused))
+
+        def run(x, key):
+            return sw.smoothgrad(x, y, key, n_samples=n_samples,
+                                 stdev_spread=0.25,
+                                 sample_chunk=cand.sample_chunk)
+
+        return run, (x, key)
+
+    return Workload(name="wamseq2d", workload="wamseq2d",
+                    shape=(3, rows, cols), batch=batch, items=batch,
+                    candidates=_seq_candidates(), build=build)
+
+
 WORKLOADS: dict[str, Callable[..., Workload]] = {
     "toy": _toy_workload,
     "flagship": _flagship_workload,
     "mu2d": _mu2d_workload,
     "fan2d": _fan2d_workload,
+    "wamseq1d": _wamseq1d_workload,
+    "wamseq2d": _wamseq2d_workload,
 }
 
 

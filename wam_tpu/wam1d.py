@@ -189,6 +189,7 @@ class WaveletAttribution1D(BaseWAM1D):
         mesh=None,
         seq_axis: str = "data",
         batch_axis: str | None = None,
+        seq_fused: bool | str = "auto",
     ):
         super().__init__(
             model_fn,
@@ -252,6 +253,7 @@ class WaveletAttribution1D(BaseWAM1D):
                 front_fn=seq_front,
                 front_grads=True,
                 batch_axis=batch_axis,
+                fused=seq_fused,
             )
 
     def _resolve_chunk(self, x_shape) -> int | None:

@@ -211,6 +211,7 @@ class WaveletAttribution2D(BaseWAM2D):
         mesh=None,
         seq_axis: str = "data",
         batch_axis: str | None = None,
+        seq_fused: bool | str = "auto",
         donate_inputs: bool | None = None,
     ):
         super().__init__(
@@ -224,19 +225,22 @@ class WaveletAttribution2D(BaseWAM2D):
         )
         # Long-context mode: mesh= shards the image ROW axis over seq_axis
         # end to end (decompose → model → grads → per-sample mosaic); see
-        # parallel.seq_estimators. NCHW-layout, f32-DWT only — the sharded
-        # analysis always accumulates f32 and the layout seam sits outside
-        # the sharded core.
+        # parallel.seq_estimators. The sharded pipeline itself is NCHW (the
+        # DWT shards the trailing spatial axes): model_layout="nhwc" wraps
+        # the model with the NCHW→NHWC transpose INSIDE the sharded graph
+        # (GSPMD carries the row sharding through the transpose, so the
+        # channel-last model still sees its native layout); dwt_bf16 casts
+        # at the decompose boundary exactly like the single-device step.
         if mesh is not None:
-            if model_layout != "nchw":
-                raise ValueError("mesh= requires model_layout='nchw'")
-            if dwt_bf16:
-                raise ValueError("mesh= does not support dwt_bf16")
             from wam_tpu.parallel.seq_estimators import SeqShardedWam
 
+            seq_model = model_fn
+            if model_layout == "nhwc":
+                seq_model = lambda sig: model_fn(  # noqa: E731
+                    jnp.transpose(sig, (0, 2, 3, 1)))
             self._seq = SeqShardedWam(
                 mesh,
-                model_fn,
+                seq_model,
                 ndim=2,
                 wavelet=wavelet,
                 level=J,
@@ -244,6 +248,8 @@ class WaveletAttribution2D(BaseWAM2D):
                 seq_axis=seq_axis,
                 post_fn=lambda g: mosaic2d(g, normalize_coeffs, 1),
                 batch_axis=batch_axis,
+                fused=seq_fused,
+                dwt_bf16=dwt_bf16,
             )
         if mesh is None and batch_axis is not None:
             raise ValueError("batch_axis= requires mesh=")

@@ -215,6 +215,7 @@ class WaveletAttribution3D(BaseWAM3D):
         mesh=None,
         seq_axis: str = "data",
         batch_axis: str | None = None,
+        seq_fused: bool | str = "auto",
     ):
         super().__init__(
             model_fn,
@@ -243,6 +244,7 @@ class WaveletAttribution3D(BaseWAM3D):
                 seq_axis=seq_axis,
                 post_fn=cube3d,
                 batch_axis=batch_axis,
+                fused=seq_fused,
             )
         if mesh is None and batch_axis is not None:
             raise ValueError("batch_axis= requires mesh=")
