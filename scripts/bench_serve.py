@@ -5,8 +5,24 @@ multi-chip `FleetServer` — over a mixed-shape request stream (>= 3 item
 shapes by default, exercising bucket routing and spatial padding), each
 submitting its next request the moment the previous result lands — closed
 loop, so offered load tracks served throughput and the queue depth
-measures coalescing, not generator lag. Backpressure (`QueueFullError`) is
-honored by sleeping the server's ``retry_after_s``.
+measures coalescing, not generator lag. Every client drives its submits
+through a `serve.retry.RetryPolicy`: backpressure (`QueueFullError`) backs
+off honoring the server's ``retry_after_s`` with capped-exponential,
+seeded-jittered waits (rejected clients decorrelate instead of waking in
+lockstep), bounded by ``--retry-attempts`` / ``--retry-budget-s``; the
+summary reports per-point attempt/retry counts.
+
+Chaos mode (``--chaos SPEC``, spec grammar in `wam_tpu.testing.faults`)
+wraps every replica's entry in a deterministic seeded fault stream —
+injected exceptions/OOM (replica death → supervised restart), NaN
+poisoning (quarantine pressure), added latency — and reports
+submitted/resolved/lost/retried counts plus restart and fault tallies.
+``--chaos`` runs gate on ZERO LOST requests (typed errors are tolerated
+and reported; a request that never resolved is a loss) — the fleet
+resilience acceptance check. Example::
+
+    python scripts/bench_serve.py --toy --fake-entry 2 --fleet 4 \
+        --chaos default --emit results/chaos.json
 
 Emits the serve JSONL ledger (one ``serve_batch`` row per dispatched batch
 + per-replica ``serve_summary`` rows + a ``fleet_summary`` row when
@@ -83,14 +99,20 @@ def run_bench(cfg, args, n_fleet: int):
     import numpy as np
 
     from wam_tpu import obs
+    from wam_tpu.config import ServeConfig
     from wam_tpu.obs import sentinel as obs_sentinel
     from wam_tpu.results import JsonlWriter
     from wam_tpu.serve import (
         AttributionServer,
         FleetMetrics,
         FleetServer,
+        NoLiveReplicaError,
         QueueFullError,
+        RetryBudgetExceededError,
+        RetryPolicy,
+        RetryStats,
         ServeMetrics,
+        SupervisorConfig,
     )
     from wam_tpu.tune import resolve_bucket_cap
 
@@ -117,6 +139,13 @@ def run_bench(cfg, args, n_fleet: int):
         cfg.max_batch, bucket_shapes[0], replicas=n_fleet
     )
 
+    chaos_spec = (getattr(args, "chaos", "") or "").strip()
+    schedule = None
+    if chaos_spec and chaos_spec not in ("off", "none"):
+        from wam_tpu.testing import ChaosSchedule
+
+        schedule = ChaosSchedule(chaos_spec, seed=args.seed)
+
     if args.fake_entry is not None:
         entry_factory = lambda rid, m: _FakeEntry(m, args.fake_entry)
     else:
@@ -131,6 +160,14 @@ def run_bench(cfg, args, n_fleet: int):
             sample_batch_size=None,
         )
         entry_factory = lambda rid, m: wam.serve_entry(on_trace=m.note_compile)
+
+    queue_depth = cfg.queue_depth
+    if schedule is not None:
+        entry_factory = schedule.wrap_factory(entry_factory)
+        if queue_depth == ServeConfig.__dataclass_fields__["queue_depth"].default:
+            # chaos default: a shallow queue makes backpressure rejections
+            # (and therefore the retry path) a certainty, not a maybe
+            queue_depth = 4
 
     # health plane (ServeConfig defaults: health on, no HBM cap, no SLO)
     health_cfg = (
@@ -154,7 +191,7 @@ def run_bench(cfg, args, n_fleet: int):
             bucket_shapes,
             max_batch=max_batch,
             max_wait_ms=cfg.max_wait_ms,
-            queue_depth=cfg.queue_depth,
+            queue_depth=queue_depth,
             deadline_ms=cfg.deadline_ms,
             warmup=cfg.warmup,
             compilation_cache=cfg.compilation_cache,
@@ -167,6 +204,14 @@ def run_bench(cfg, args, n_fleet: int):
         )
         fleet_metrics = None
     else:
+        supervise = None
+        if cfg.supervise:
+            supervise = SupervisorConfig(
+                max_restarts=cfg.restart_max,
+                window_s=cfg.restart_window_s,
+                backoff_base_s=cfg.restart_backoff_ms / 1e3,
+                seed=args.seed,
+            )
         fleet_metrics = FleetMetrics()
         server = FleetServer(
             entry_factory,
@@ -174,7 +219,7 @@ def run_bench(cfg, args, n_fleet: int):
             replicas=n_fleet,
             max_batch=max_batch,
             max_wait_ms=cfg.max_wait_ms,
-            queue_depth=cfg.queue_depth,
+            queue_depth=queue_depth,
             deadline_ms=cfg.deadline_ms,
             warmup=cfg.warmup,
             compilation_cache=cfg.compilation_cache,
@@ -186,6 +231,7 @@ def run_bench(cfg, args, n_fleet: int):
             health=health_cfg,
             slo=slo_policy,
             memory_budget=mem_budget,
+            supervise=supervise,
         )
         if server.prom_server is not None:
             print(f"/metrics on port {server.prom_server.server_port}")
@@ -196,6 +242,20 @@ def run_bench(cfg, args, n_fleet: int):
 
     budget = threading.Semaphore(n_requests)
     errors = []
+    # retryable set: backpressure always; under chaos a fleet may briefly
+    # have ZERO live replicas mid-restart — those rejections retry into the
+    # supervisor's recovery instead of counting as request failures
+    retry_on = [QueueFullError]
+    if schedule is not None and n_fleet > 1:
+        retry_on.append(NoLiveReplicaError)
+    policy = RetryPolicy(
+        max_attempts=max(1, cfg.retry_attempts),
+        budget_s=cfg.retry_budget_s or None,
+        retry_on=tuple(retry_on),
+    )
+    retry_stats = RetryStats()
+    counts = {"submitted": 0, "resolved_ok": 0, "resolved_error": 0, "lost": 0}
+    counts_lock = threading.Lock()
 
     def client(cid: int):
         rng = random.Random(args.seed * 997 + cid)
@@ -206,15 +266,30 @@ def run_bench(cfg, args, n_fleet: int):
                  for _ in range(shape[-2])], np.float32,
             )[None].repeat(shape[0], axis=0)
             y = rng.randrange(4)
-            while True:
-                try:
-                    server.attribute(x, y)
-                    break
-                except QueueFullError as e:
-                    threading.Event().wait(e.retry_after_s)
-                except Exception as e:  # deadline/served errors end this request
-                    errors.append(repr(e))
-                    break
+            with counts_lock:
+                counts["submitted"] += 1
+            try:
+                if n_fleet > 1:
+                    server.submit_with_retry(
+                        x, y, policy=policy, stats=retry_stats, rng=rng
+                    ).result()
+                else:
+                    policy.run(
+                        lambda rem: server.submit(x, y),
+                        rng=rng, stats=retry_stats,
+                    )
+                outcome = "resolved_ok"
+            except RetryBudgetExceededError as e:
+                # pending=True means the submit never resolved inside the
+                # budget — a LOST request, the zero-loss gate's currency;
+                # pending=False is a typed exhaustion (resolved error)
+                outcome = "lost" if e.pending else "resolved_error"
+                errors.append(repr(e))
+            except Exception as e:  # deadline/served errors end this request
+                outcome = "resolved_error"
+                errors.append(repr(e))
+            with counts_lock:
+                counts[outcome] += 1
 
     t_load0 = time.perf_counter()
     threads = [threading.Thread(target=client, args=(i,)) for i in range(n_clients)]
@@ -233,19 +308,26 @@ def run_bench(cfg, args, n_fleet: int):
             writer.write({"metric": "compile_event", "schema_version": 2, **ev})
 
     if fleet_metrics is not None:
-        fs = fleet_metrics.fleet_summary()
+        summary = fleet_metrics.fleet_summary()
         # served-window throughput: the sweep curve compares load windows,
         # not process lifetimes (warmup/compile time varies per point)
-        fs["load_window_s"] = load_s
-        fs["attributions_per_s_load"] = fs["completed"] / load_s if load_s > 0 else 0.0
-        fs["post_warm_compiles"] = post_warm_compiles
-        return fs, errors
-    summary = metrics.snapshot()
-    summary["load_window_s"] = load_s
-    summary["attributions_per_s_load"] = (
-        summary["completed"] / load_s if load_s > 0 else 0.0
-    )
+        summary["load_window_s"] = load_s
+        summary["attributions_per_s_load"] = (
+            summary["completed"] / load_s if load_s > 0 else 0.0
+        )
+    else:
+        summary = metrics.snapshot()
+        summary["load_window_s"] = load_s
+        summary["attributions_per_s_load"] = (
+            summary["completed"] / load_s if load_s > 0 else 0.0
+        )
     summary["post_warm_compiles"] = post_warm_compiles
+    summary["client"] = {**counts, **retry_stats.as_dict()}
+    if schedule is not None:
+        summary["chaos"] = {
+            "spec": chaos_spec,
+            "injected": schedule.injected_counts(),
+        }
     return summary, errors
 
 
@@ -385,6 +467,12 @@ def main():
     parser.add_argument("--slo-report", action="store_true",
                         help="print the per-bucket SLO table from the "
                              "ledger's slo_status rows after the run")
+    parser.add_argument("--chaos", type=str, default="", metavar="SPEC",
+                        help="deterministic fault injection: 'default', "
+                             "'nan=0.05,exc=0.02,latency=0.1:20', or "
+                             "per-replica '0:exc=0.5;*:nan=0.1' "
+                             "(wam_tpu.testing.faults grammar); the run "
+                             "gates on zero lost requests")
     from wam_tpu.config import ServeConfig, add_config_args, config_from_args
 
     add_config_args(parser, ServeConfig)
@@ -429,6 +517,20 @@ def main():
                 for r in summary["per_replica"]
             }
             point["deaths"] = len(summary["deaths"])
+            point["restarts"] = summary.get("restarts", 0)
+            point["permanent_dead"] = summary.get("permanent_dead", [])
+        if "client" in summary:
+            c = summary["client"]
+            point.update(
+                submitted=c["submitted"],
+                resolved_ok=c["resolved_ok"],
+                resolved_error=c["resolved_error"],
+                lost=c["lost"],
+                retries=c["retries"],
+                hedges=c["hedges"],
+            )
+        if "chaos" in summary:
+            point["chaos"] = summary["chaos"]
         curve.append(point)
         print(json.dumps(point, indent=2))
 
@@ -457,6 +559,7 @@ def main():
             "oversize": cfg.oversize,
             "requests_per_fleet_unit": args.requests,
             "clients_per_fleet_unit": args.clients,
+            "chaos": args.chaos or None,
             "curve": curve,
         }
         os.makedirs(os.path.dirname(args.emit) or ".", exist_ok=True)
@@ -465,6 +568,18 @@ def main():
         print(f"emitted: {args.emit}")
     if args.slo_report:
         _print_slo_report(cfg.metrics_path or "results/bench_serve.jsonl")
+    if args.chaos and args.chaos not in ("off", "none"):
+        # the chaos gate: typed errors are the fault schedule doing its job;
+        # a LOST request (never resolved inside the retry budget) fails
+        lost = sum(p.get("lost", 0) for p in curve)
+        if any_errors:
+            print(f"chaos: {len(any_errors)} typed request errors "
+                  f"(first: {any_errors[0]})", file=sys.stderr)
+        if lost:
+            print(f"chaos: {lost} LOST request(s) — zero-loss gate failed",
+                  file=sys.stderr)
+            return 1
+        return 0
     if any_errors:
         print(f"{len(any_errors)} request errors, first: {any_errors[0]}",
               file=sys.stderr)

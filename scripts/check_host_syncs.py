@@ -30,10 +30,13 @@ Two-tier AST scan, no imports of the scanned code:
      (obs timing belongs OUTSIDE the traced function, in `obs.tracing`
      spans around the dispatch).
 
-Scope: wam_tpu/{core,evalsuite,serve,pipeline,wavelets,obs} plus the fleet's
-mesh plumbing (wam_tpu/parallel/{mesh,multihost}.py) and the long-context
-path the fleet's sequence-sharded oversize route runs through
-(wam_tpu/parallel/{halo,halo_modes,seq_estimators}.py). halo.py and
+Scope: wam_tpu/{core,evalsuite,serve,pipeline,wavelets,obs,testing} plus
+the fleet's mesh plumbing (wam_tpu/parallel/{mesh,multihost}.py) and the
+long-context path the fleet's sequence-sharded oversize route runs through
+(wam_tpu/parallel/{halo,halo_modes,seq_estimators}.py). serve/ covers the
+resilience layer (serve/supervisor.py, serve/retry.py); wam_tpu/testing is
+in scope because the chaos entries WRAP traced serving entries — a hidden
+sync in the fault layer would skew every latency the chaos bench reports. halo.py and
 halo_modes.py used to be excluded for their `int(np.prod(...))` static
 shape products inside shard_map bodies (legal — shapes are concrete under
 trace — but indistinguishable from real syncs here); those are
@@ -57,6 +60,7 @@ import sys
 
 DEFAULT_DIRS = ("wam_tpu/core", "wam_tpu/evalsuite", "wam_tpu/serve",
                 "wam_tpu/pipeline", "wam_tpu/wavelets", "wam_tpu/obs",
+                "wam_tpu/testing",
                 "wam_tpu/parallel/mesh.py", "wam_tpu/parallel/multihost.py",
                 "wam_tpu/parallel/halo.py", "wam_tpu/parallel/halo_modes.py",
                 "wam_tpu/parallel/seq_estimators.py")

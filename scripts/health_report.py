@@ -46,10 +46,14 @@ def parse_series(key: str) -> tuple[str, dict]:
     return m.group("name"), labels
 
 
-def load_ledger(path: str) -> tuple[dict, list[dict]]:
-    """(last obs_snapshot registry, every slo_status row) from a ledger."""
+def load_ledger(path: str) -> tuple[dict, list[dict], int]:
+    """(last obs_snapshot registry, every slo_status row, corrupt-line
+    count) from a ledger. A line that does not parse — typically the torn
+    final line of a crashed writer — is skipped and counted, never fatal:
+    a crash must not take the post-mortem report down with it."""
     registry: dict = {}
     slo_rows: list[dict] = []
+    corrupt = 0
     with open(path) as f:
         for line in f:
             line = line.strip()
@@ -58,13 +62,17 @@ def load_ledger(path: str) -> tuple[dict, list[dict]]:
             try:
                 row = json.loads(line)
             except ValueError:
+                corrupt += 1
                 continue
             metric = row.get("metric")
             if metric == "obs_snapshot":
                 registry = row.get("registry", {})  # last snapshot wins
             elif metric == "slo_status":
                 slo_rows.append(row)
-    return registry, slo_rows
+    if corrupt:
+        print(f"health-report: skipped {corrupt} corrupt ledger line(s) "
+              f"in {path}", file=sys.stderr)
+    return registry, slo_rows, corrupt
 
 
 def series_table(registry: dict, prefix: str) -> list[dict]:
@@ -104,7 +112,7 @@ def main() -> int:
     args = parser.parse_args()
 
     try:
-        registry, slo_rows = load_ledger(args.ledger)
+        registry, slo_rows, corrupt_lines = load_ledger(args.ledger)
     except OSError as e:
         print(f"cannot read ledger: {e}", file=sys.stderr)
         return 1
@@ -131,6 +139,7 @@ def main() -> int:
     if args.json:
         print(json.dumps({
             "ledger": args.ledger,
+            "ledger_corrupt_lines": corrupt_lines,
             "health": health,
             "memory": memory,
             "slo": latest_slo,

@@ -45,8 +45,11 @@ COMPILE_PHASE_TO_SPAN = {
 
 def load_compile_events(path: str) -> list[dict]:
     """``compile_event`` rows from a serve JSONL ledger (bench_serve writes
-    one per sentinel-recorded trace; non-JSON / other-metric lines skip)."""
+    one per sentinel-recorded trace; other-metric lines skip). A line that
+    does not parse — the torn final line of a crashed writer — is skipped
+    with a counted stderr warning, never fatal."""
     rows = []
+    corrupt = 0
     with open(path) as f:
         for line in f:
             line = line.strip()
@@ -55,9 +58,13 @@ def load_compile_events(path: str) -> list[dict]:
             try:
                 row = json.loads(line)
             except ValueError:
+                corrupt += 1
                 continue
             if row.get("metric") == "compile_event":
                 rows.append(row)
+    if corrupt:
+        print(f"trace-report: skipped {corrupt} corrupt ledger line(s) "
+              f"in {path}", file=sys.stderr)
     return rows
 
 

@@ -1,0 +1,568 @@
+"""Fleet resilience (`serve.supervisor` / `serve.retry` /
+`wam_tpu.testing.faults` / crash-safe ledgers): supervised replica restart
+with the zero-post-warm-compile rejoin invariant, crash-loop escalation to
+permanent-dead, client-side retry/hedging discipline, deterministic chaos
+schedules, the worker-crash guard, torn-ledger tolerance, and quarantine
+hysteresis under flapping.
+
+Same discipline as tests/test_fleet.py: operational tests use fake entries
+with explicit kill/gate handshakes so the states they assert are
+deterministic; the one probabilistic test (chaos zero-loss) runs a SEEDED
+fault schedule, so its fault sequence is fixed across runs. Runs on the
+virtual 8-device CPU mesh the conftest forces."""
+
+import random
+import threading
+import time
+from concurrent.futures import Future
+
+import numpy as np
+import pytest
+
+from conftest import need_devices
+from wam_tpu import obs
+from wam_tpu.serve import (
+    AttributionServer,
+    FleetMetrics,
+    FleetServer,
+    NoLiveReplicaError,
+    QueueFullError,
+    RetryBudgetExceededError,
+    RetryPolicy,
+    RetryStats,
+    ServerClosedError,
+    SupervisorConfig,
+    WorkerCrashedError,
+    jit_entry,
+)
+from wam_tpu.testing import (
+    DEFAULT_CHAOS,
+    ChaosFault,
+    ChaosSchedule,
+    FaultInjector,
+    FaultSpec,
+    parse_chaos,
+)
+
+
+def _registry_total(prefix: str) -> float:
+    from wam_tpu.obs.registry import registry
+
+    return sum(v for k, v in registry.collect().items() if k.startswith(prefix))
+
+
+# -- retry policy -------------------------------------------------------------
+
+
+def test_retry_backoff_honors_retry_after():
+    """The wait before a resubmit never undercuts the server's own
+    projected-drain estimate, and jitter only pushes it UP."""
+    policy = RetryPolicy(backoff_base_s=0.01, backoff_cap_s=1.0, jitter_frac=0.5)
+    rng = random.Random(0)
+    for attempt in (1, 2, 3):
+        assert policy.backoff_s(attempt, rng, retry_after_s=0.5) >= 0.5
+    # without a server estimate: capped exponential
+    assert policy.backoff_s(1, rng) <= 0.01 * 1.5
+    assert policy.backoff_s(30, rng) <= 1.0 * 1.5
+
+
+def test_retry_recovers_after_backpressure():
+    calls = {"n": 0}
+
+    def submit(rem):
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise QueueFullError(0.001)
+        f = Future()
+        f.set_result(42)
+        return f
+
+    stats = RetryStats()
+    policy = RetryPolicy(max_attempts=4, backoff_base_s=0.001,
+                         backoff_cap_s=0.002)
+    assert policy.run(submit, rng=random.Random(0), stats=stats) == 42
+    assert stats.attempts == 3 and stats.retries == 2 and stats.exhausted == 0
+    assert stats.backoff_s_total > 0.0
+
+
+def test_retry_exhaustion_is_typed_not_lost():
+    """Typed exhaustion: the policy gives up with the LAST server error
+    attached and pending=False — the request resolved, it was not lost."""
+
+    def submit(rem):
+        raise QueueFullError(0.001)
+
+    stats = RetryStats()
+    policy = RetryPolicy(max_attempts=3, backoff_base_s=0.001,
+                         backoff_cap_s=0.002)
+    with pytest.raises(RetryBudgetExceededError) as ei:
+        policy.run(submit, rng=random.Random(0), stats=stats)
+    assert ei.value.pending is False
+    assert isinstance(ei.value.last, QueueFullError)
+    assert stats.attempts == 3 and stats.exhausted == 1
+
+
+def test_retry_budget_lapse_with_pending_future_is_lost():
+    """A future still unresolved when the budget lapses is the one outcome
+    the zero-loss chaos gate counts as a LOSS (pending=True, last=None)."""
+    policy = RetryPolicy(max_attempts=3, budget_s=0.05)
+    t0 = time.monotonic()
+    with pytest.raises(RetryBudgetExceededError) as ei:
+        policy.run(lambda rem: Future(), rng=random.Random(0))
+    assert ei.value.pending is True and ei.value.last is None
+    assert time.monotonic() - t0 < 5.0  # budget, not a hang
+
+
+def test_retry_hedge_first_wins():
+    """With the first submit parked past hedge_after_s, the hedge fires,
+    resolves first, and its result wins; the loser is left unconsumed."""
+    submits = []
+
+    def submit(rem):
+        f = Future()
+        if submits:  # the hedge resolves immediately; the original never
+            f.set_result("hedge-won")
+        submits.append(f)
+        return f
+
+    stats = RetryStats()
+    policy = RetryPolicy(hedge_after_s=0.005)
+    out = policy.run(submit, rng=random.Random(0), stats=stats)
+    assert out == "hedge-won"
+    assert stats.hedges == 1 and stats.hedge_wins == 1
+    assert len(submits) == 2
+
+
+# -- chaos layer --------------------------------------------------------------
+
+
+def test_parse_chaos_grammar():
+    assert parse_chaos("default") == {"*": DEFAULT_CHAOS}
+    assert parse_chaos("off") == {"*": FaultSpec()}
+    s = parse_chaos("nan=0.05,exc=0.02,latency=0.1:20")["*"]
+    assert (s.nan_p, s.exc_p, s.latency_p, s.latency_ms) == (0.05, 0.02, 0.1, 20.0)
+    per = parse_chaos("0:exc=0.5;*:nan=0.1")
+    assert per["0"].exc_p == 0.5 and per["*"].nan_p == 0.1
+    with pytest.raises(ValueError):
+        parse_chaos("bogus=1")
+    with pytest.raises(ValueError):
+        FaultSpec(nan_p=0.9, exc_p=0.9)  # probabilities must sum <= 1
+    sched = ChaosSchedule("0:exc=0.5;*:nan=0.1", seed=3)
+    assert sched.spec_for(0).exc_p == 0.5
+    assert sched.spec_for(2).nan_p == 0.1  # '*' covers the rest
+    assert sched.injector(0) is sched.injector(0)  # restart keeps the stream
+
+
+def test_fault_injector_deterministic_streams():
+    """A replica's fault sequence is a pure function of (seed, replica):
+    identical across injector instances (and therefore across restarts and
+    processes), distinct across replicas."""
+    spec = FaultSpec(nan_p=0.3, exc_p=0.2, latency_p=0.2)
+    a = FaultInjector(spec, seed=7, replica=0)
+    b = FaultInjector(spec, seed=7, replica=0)
+    c = FaultInjector(spec, seed=7, replica=1)
+    seq = [a.draw() for _ in range(64)]
+    assert seq == [b.draw() for _ in range(64)]
+    assert seq != [c.draw() for _ in range(64)]
+    assert any(k is not None for k in seq)  # the spec actually fires
+
+
+def test_chaos_entry_faults_and_warmup_exemption():
+    from wam_tpu.obs import sentinel as obs_sentinel
+    from wam_tpu.testing.faults import ChaosEntry
+
+    calls = []
+
+    def inner(xs, ys):
+        calls.append(1)
+        return np.asarray(xs, np.float32) * 1.0
+
+    inj = FaultInjector(FaultSpec(exc_p=1.0), seed=0, replica=0)
+    entry = ChaosEntry(inner, inj)
+    # warmup dispatches pass through clean and consume NO draws
+    with obs_sentinel.label(phase="warmup"):
+        entry(np.ones((2,), np.float32), None)
+    assert len(calls) == 1 and inj.total() == 0
+    with pytest.raises(ChaosFault):
+        entry(np.ones((2,), np.float32), None)
+    assert inj.counts == {"exc": 1}
+    # nan poisoning serves a result, but a non-finite one
+    inj2 = FaultInjector(FaultSpec(nan_p=1.0), seed=0, replica=0)
+    out = ChaosEntry(inner, inj2)(np.ones((4,), np.float32), None)
+    assert not np.isfinite(np.asarray(out)).all()
+    assert inj2.counts == {"nan": 1}
+
+
+# -- crash-safe ledgers -------------------------------------------------------
+
+
+def test_ledger_tolerates_torn_final_line(tmp_path):
+    """A truncated trailing line (torn write from a crashed process) is
+    skipped with a counted warning by every reader; strict mode and the
+    registry corruption counter keep the event observable."""
+    from wam_tpu.results import (
+        JsonlWriter,
+        LedgerCorruptWarning,
+        read_jsonl,
+        read_jsonl_stats,
+    )
+
+    obs.configure(enabled=True)
+    obs.reset()
+    path = str(tmp_path / "ledger.jsonl")
+    w = JsonlWriter(path)
+    w.write({"metric": "serve_batch", "i": 1})
+    w.write({"metric": "serve_batch", "i": 2})
+    with open(path, "a") as f:
+        f.write('{"metric": "serve_batch", "i": 3')  # torn: no close, no \n
+    with pytest.warns(LedgerCorruptWarning):
+        rows = read_jsonl(path)
+    assert [r["i"] for r in rows] == [1, 2]
+    with pytest.warns(LedgerCorruptWarning):
+        rows2, corrupt = read_jsonl_stats(path)
+    assert corrupt == 1 and [r["i"] for r in rows2] == [1, 2]
+    assert _registry_total("wam_tpu_serve_ledger_corrupt_lines_total") == 2.0
+    with pytest.raises(ValueError):
+        read_jsonl(path, strict=True)  # historical behavior preserved
+    with pytest.warns(LedgerCorruptWarning):
+        assert [r["i"] for r in FleetMetrics.load_ledger(path)] == [1, 2]
+
+
+def test_jsonl_writer_concurrent_appends_never_tear(tmp_path):
+    """N threads appending through independent writers to one path: every
+    line on disk parses (single O_APPEND write per complete line)."""
+    from wam_tpu.results import JsonlWriter, read_jsonl_stats
+
+    path = str(tmp_path / "concurrent.jsonl")
+    n_threads, n_rows = 8, 50
+
+    def writer(tid):
+        w = JsonlWriter(path)
+        for i in range(n_rows):
+            w.write({"tid": tid, "i": i, "pad": "x" * 256})
+
+    threads = [threading.Thread(target=writer, args=(t,))
+               for t in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    rows, corrupt = read_jsonl_stats(path)
+    assert corrupt == 0 and len(rows) == n_threads * n_rows
+    seen = {(r["tid"], r["i"]) for r in rows}
+    assert len(seen) == n_threads * n_rows  # no interleaved/duplicated lines
+
+
+def test_note_restart_rows_and_counter_roundtrip():
+    obs.configure(enabled=True)
+    obs.reset()
+    fm = FleetMetrics()
+    fm.note_restart(1, "restarting", attempt=1, backoff_s=0.05, reason="boom")
+    row = fm.note_restart(1, "alive", attempt=1)
+    assert row["metric"] == "replica_restart" and row["schema_version"] == 2
+    fm.note_restart(2, "permanent_dead", attempt=3, reason="crash loop")
+    s = fm.fleet_summary()
+    assert s["restarts"] == 1 and s["permanent_dead"] == ["2"]
+    assert _registry_total("wam_tpu_serve_restarts_total") == 1.0
+
+
+# -- quarantine hysteresis ----------------------------------------------------
+
+
+def test_health_flapping_escalates_recovery_windows():
+    """A flapping replica (poisoned burst, one clean probe, poisoned again)
+    converges: each re-quarantine doubles the probation window up to the
+    cap, so quarantine<->probation transitions are bounded logarithmically
+    instead of oscillating forever. `reset_escalation` forgives."""
+    from wam_tpu.obs.health import HealthConfig, HealthMonitor
+
+    obs.configure(enabled=True)
+    obs.reset()
+    import jax
+
+    from wam_tpu.obs.health import batch_stats
+
+    bad = jax.device_get(batch_stats(np.array([np.nan], np.float32)))
+    good = jax.device_get(batch_stats(np.array([1.0], np.float32)))
+    cfg = HealthConfig(quarantine_after=1, recovery_s=10.0,
+                       backoff_factor=2.0, max_recovery_s=40.0, clear_after=1)
+    m = HealthMonitor(cfg, replica_id=0)
+
+    t = 0.0
+    expected = [10.0, 20.0, 40.0, 40.0]  # doubles, then the cap holds
+    for arm, window in enumerate(expected, start=1):
+        assert m.note(bad, now=t) is False
+        d = m.describe()
+        assert d["quarantine_arms"] == arm
+        assert d["recovery_window_s"] == pytest.approx(window)
+        assert not m.ok(now=t + window - 0.01)  # still quarantined
+        assert m.ok(now=t + window)  # probation opens exactly at the window
+        t += window
+        assert m.note(good, now=t) is True  # one healthy probe clears
+        assert not m.quarantined
+        assert m.ok(now=t)
+        t += 1.0
+    m.reset_escalation()
+    assert m.describe()["recovery_window_s"] == pytest.approx(10.0)
+
+
+def test_health_bad_probe_rearms_without_escalating():
+    """A bad probe DURING quarantine restarts the clock but is not a new
+    quarantine: a long poisoned burst is one arm, not N."""
+    from wam_tpu.obs.health import HealthConfig, HealthMonitor
+
+    obs.configure(enabled=True)
+    obs.reset()
+    import jax
+
+    from wam_tpu.obs.health import batch_stats
+
+    bad = jax.device_get(batch_stats(np.array([np.inf], np.float32)))
+    m = HealthMonitor(HealthConfig(quarantine_after=1, recovery_s=10.0),
+                      replica_id=1)
+    m.note(bad, now=0.0)
+    m.note(bad, now=5.0)  # re-arm: clock restarts at 5.0
+    d = m.describe()
+    assert d["quarantine_arms"] == 1
+    assert not m.ok(now=14.9)
+    assert m.ok(now=15.0)
+
+
+# -- worker crash guard -------------------------------------------------------
+
+
+@pytest.mark.filterwarnings(
+    "ignore::pytest.PytestUnhandledThreadExceptionWarning")
+def test_worker_crash_fails_pending_futures():
+    """A worker-loop crash outside the guarded entry paths (simulated with
+    a BaseException from the entry) must fail BOTH the popped in-flight
+    request and everything still queued with `WorkerCrashedError` — never
+    leave a future hanging — and close the server to new intake."""
+    entered = threading.Event()
+    release = threading.Event()
+
+    class _Bomb:
+        def __call__(self, xs, ys):
+            entered.set()
+            assert release.wait(timeout=10), "test gate never released"
+            raise KeyboardInterrupt("simulated worker-loop bug")
+
+    server = AttributionServer(_Bomb(), [(4,)], max_batch=1, max_wait_ms=0.0,
+                               queue_depth=8, warmup=False)
+    x = np.zeros((4,), np.float32)
+    f1 = server.submit(x, 0)  # dispatched (popped off the queue)
+    assert entered.wait(timeout=10)
+    f2 = server.submit(x, 1)  # queued behind the crash
+    release.set()
+    with pytest.raises(WorkerCrashedError):
+        f1.result(timeout=10)
+    with pytest.raises(WorkerCrashedError):
+        f2.result(timeout=10)
+    with pytest.raises(ServerClosedError):  # intake is closed, typed
+        server.submit(x, 0)
+    # join the crashed worker so its (deliberate) re-raise lands inside
+    # this test's filterwarnings scope, not a later test's
+    server._worker.join(timeout=10)
+    server.close()
+
+
+# -- supervised restart -------------------------------------------------------
+
+
+def test_restart_rejoins_warm_with_ledger_roundtrip(tmp_path):
+    """The tentpole invariant: kill each replica of a 4-replica fleet in
+    turn under load — every request resolves (drain/re-route), every
+    replica is restarted by the supervisor, the restarted replicas rejoin
+    at ZERO post-warm compiles (rehydrated through the process-level jit
+    cache, sentinel-verified), and the ``replica_restart`` ledger rows
+    round-trip against ``wam_tpu_serve_restarts_total``."""
+    need_devices(4)
+    obs.configure(enabled=True)
+    obs.reset()
+    from wam_tpu.obs import sentinel as obs_sentinel
+
+    kills = {rid: threading.Event() for rid in range(4)}
+    jits: dict = {}
+
+    class _Killable:
+        def __init__(self, inner, rid):
+            self._inner = inner
+            self._rid = rid
+
+        def __call__(self, xs, ys):
+            if kills[self._rid].is_set():
+                kills[self._rid].clear()  # one death per arm
+                raise RuntimeError(f"injected chip loss on {self._rid}")
+            return self._inner(xs, ys)
+
+    def factory(rid, m):
+        # the process-level cache IS the warm state a restart rehydrates:
+        # the rebuilt server re-warms through the same jitted entry, so the
+        # rejoin costs zero traces
+        if rid not in jits:
+            jits[rid] = jit_entry(lambda xs, ys: xs * 2.0,
+                                  on_trace=m.note_compile)
+        return _Killable(jits[rid], rid)
+
+    path = str(tmp_path / "fleet.jsonl")
+    fleet = FleetServer(
+        factory, [(4,)], replicas=4, max_batch=1, max_wait_ms=0.0,
+        warmup=True, metrics_path=path, oversize="fanout",
+        supervise=SupervisorConfig(max_restarts=8, window_s=60.0,
+                                   backoff_base_s=0.001, jitter_frac=0.0,
+                                   seed=0),
+    )
+    x = np.ones((4,), np.float32)
+    try:
+        assert fleet.describe()["supervised"] is True
+        with obs_sentinel.assert_no_retrace():
+            for rid in range(4):
+                kills[rid].set()
+                deadline = time.monotonic() + 30
+                # concurrent bursts spread over the fleet (each replica's
+                # projected drain grows as it takes work), so the doomed
+                # replica is hit within a few rounds
+                while kills[rid].is_set():
+                    futs = [fleet.submit(x, i % 4) for i in range(8)]
+                    for f in futs:
+                        np.testing.assert_array_equal(
+                            f.result(timeout=10), x * 2.0)
+                    assert time.monotonic() < deadline, \
+                        f"replica {rid} never took its kill"
+                deadline = time.monotonic() + 30
+                while not fleet._replicas[rid].alive:
+                    assert time.monotonic() < deadline, \
+                        f"replica {rid} never restarted"
+                    time.sleep(0.005)
+            # the restarted fleet serves, still compile-free
+            for i in range(8):
+                np.testing.assert_array_equal(fleet.attribute(x, i % 4),
+                                              x * 2.0)
+    finally:
+        for e in kills.values():
+            e.clear()
+        fleet.close()
+
+    rows = FleetMetrics.load_ledger(path)
+    restarts = [r for r in rows if r.get("metric") == "replica_restart"]
+    alive = [r for r in restarts if r["transition"] == "alive"]
+    assert {r["replica_id"] for r in alive} == {0, 1, 2, 3}
+    assert all(r["schema_version"] == 2 for r in restarts)
+    assert all(r["attempt"] >= 1 for r in restarts)
+    # ledger rows and the registry counter tell the same story
+    assert _registry_total("wam_tpu_serve_restarts_total") == len(alive) == 4
+    fleet_rows = [r for r in rows if r.get("metric") == "fleet_summary"]
+    assert fleet_rows and fleet_rows[0]["restarts"] == 4
+    assert fleet_rows[0]["permanent_dead"] == []
+
+
+def test_crash_loop_escalates_to_permanent_dead():
+    """A replica that dies again right after restarting crash-loops: once
+    ``max_restarts`` completed restarts land inside the window, the next
+    death escalates to permanent-dead (ledger row + no more restart
+    threads) and the fleet serves on the survivors."""
+    need_devices(2)
+
+    def factory(rid, m):
+        if rid == 0:
+            def dying(xs, ys):
+                raise RuntimeError("replica 0 is cursed")
+
+            return dying
+
+        def survivor(xs, ys):
+            # slow enough that its projected drain under a concurrent
+            # burst exceeds the dead replica's never-served EMA seed, so
+            # the router keeps offering replica 0 its next death
+            time.sleep(0.02)
+            return np.asarray(xs) * 2.0
+
+        return survivor
+
+    fleet = FleetServer(
+        factory, [(4,)], replicas=2, max_batch=1, max_wait_ms=0.0,
+        warmup=False, oversize="fanout",
+        supervise=SupervisorConfig(max_restarts=1, window_s=60.0,
+                                   backoff_base_s=0.001, jitter_frac=0.0,
+                                   seed=1),
+    )
+    x = np.ones((4,), np.float32)
+    try:
+        deadline = time.monotonic() + 20
+        while not fleet._supervisor.permanently_dead(0):
+            # every request resolves via the survivor regardless
+            futs = [fleet.submit(x, 0) for _ in range(6)]
+            for f in futs:
+                np.testing.assert_array_equal(f.result(timeout=10), x * 2.0)
+            assert time.monotonic() < deadline, "never escalated"
+            time.sleep(0.002)
+        while True:  # the permanent_dead row lands just after the flag
+            transitions = [r["transition"] for r in fleet.metrics.restarts
+                           if r["replica_id"] == 0]
+            if "permanent_dead" in transitions:
+                break
+            assert time.monotonic() < deadline
+            time.sleep(0.002)
+        assert "restarting" in transitions and "alive" in transitions
+        assert transitions[-1] == "permanent_dead"
+        assert fleet.describe()["supervision"]["permanent_dead"] == [0]
+        np.testing.assert_array_equal(fleet.attribute(x, 1), x * 2.0)
+    finally:
+        fleet.close()
+
+
+def test_chaos_fleet_zero_loss_with_supervision():
+    """The acceptance property at test scale: a supervised 4-replica fleet
+    under a seeded chaos schedule (injected deaths + latency) with
+    retrying clients loses ZERO requests — every submit resolves OK —
+    while restarts actually happen."""
+    need_devices(4)
+    obs.configure(enabled=True)
+    obs.reset()
+    sched = ChaosSchedule("exc=0.15,latency=0.1:2", seed=11)
+    factory = sched.wrap_factory(
+        lambda rid, m: (lambda xs, ys: np.asarray(xs) * 2.0))
+    fleet = FleetServer(
+        factory, [(4,)], replicas=4, max_batch=1, max_wait_ms=0.0,
+        queue_depth=2, warmup=False, oversize="fanout",
+        supervise=SupervisorConfig(max_restarts=50, window_s=60.0,
+                                   backoff_base_s=0.001, jitter_frac=0.0,
+                                   seed=11),
+    )
+    policy = RetryPolicy(max_attempts=8, budget_s=20.0, backoff_base_s=0.002,
+                         backoff_cap_s=0.05,
+                         retry_on=(QueueFullError, NoLiveReplicaError))
+    stats = RetryStats()
+    x = np.ones((4,), np.float32)
+    ok = {"n": 0}
+    errs: list = []
+    lock = threading.Lock()
+
+    def client(cid):
+        rng = random.Random(cid)
+        for i in range(12):
+            try:
+                out = fleet.submit_with_retry(
+                    x, i % 4, policy=policy, stats=stats, rng=rng,
+                ).result(timeout=30)
+                np.testing.assert_array_equal(out, x * 2.0)
+                with lock:
+                    ok["n"] += 1
+            except Exception as e:  # noqa: BLE001 - tallied, asserted below
+                with lock:
+                    errs.append(repr(e))
+
+    threads = [threading.Thread(target=client, args=(c,)) for c in range(4)]
+    try:
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+    finally:
+        fleet.close()
+    assert not errs, f"lost/failed requests under chaos: {errs[:3]}"
+    assert ok["n"] == 48
+    assert sched.injected_total() > 0  # the schedule actually fired
+    summary = fleet.metrics.fleet_summary()
+    assert summary["restarts"] > 0  # deaths happened AND were recovered
+    assert summary["permanent_dead"] == []

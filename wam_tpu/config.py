@@ -205,6 +205,13 @@ class ServeConfig:
     # per-bucket SLOs, e.g. "p99_ms=50,error_rate=0.01,health_rate=0.999"
     # optionally bucket-prefixed: "3x224x224: p99_ms=30; *: p99_ms=80"
     slo: str = ""
+    # -- resilience (serve.supervisor / serve.retry) ------------------------
+    supervise: bool = True  # restart dead replicas (fleets only)
+    restart_max: int = 3  # completed restarts in restart_window_s -> permanent
+    restart_window_s: float = 60.0
+    restart_backoff_ms: float = 50.0  # base restart backoff (exp, jittered)
+    retry_attempts: int = 4  # client-side submit attempts (bench_serve)
+    retry_budget_s: float = 30.0  # total per-request retry budget; 0 = none
 
     def bucket_shapes(self) -> list[tuple[int, ...]]:
         if not self.buckets:

@@ -242,13 +242,24 @@ def publish_stats(vec, *, source: str, replica=None, bucket=None) -> bool:
 class HealthConfig:
     """Quarantine policy knobs (`ServeConfig.health_*` surfaces them on the
     CLI). ``quarantine_after`` consecutive non-finite batches mark the
-    replica degraded; after ``recovery_s`` it accepts probe traffic again
-    and one healthy batch clears the state (a bad probe re-arms it)."""
+    replica degraded; after the recovery window it accepts probe traffic
+    again and ``clear_after`` consecutive healthy batches clear the state
+    (a bad probe re-arms it). The recovery window ESCALATES on every
+    re-quarantine — ``recovery_s × backoff_factor^(arms-1)``, capped at
+    ``max_recovery_s`` — and the escalation survives clears: a replica
+    flapping between poisoned and healthy bursts would otherwise oscillate
+    quarantine↔probation at a constant period forever, while escalating
+    windows bound the transition count logarithmically (the hysteresis the
+    chaos tests pin). Operators can forgive a fixed replica with
+    `HealthMonitor.reset_escalation`."""
 
     enabled: bool = True
     quarantine_after: int = 3
     recovery_s: float = 30.0
     sat_threshold: float = SAT_THRESHOLD
+    clear_after: int = 1
+    backoff_factor: float = 2.0
+    max_recovery_s: float = 300.0
 
 
 class HealthMonitor:
@@ -266,9 +277,18 @@ class HealthMonitor:
         self._rl = _label(replica_id)
         self._lock = threading.Lock()
         self._consecutive = 0
+        self._consecutive_ok = 0
         self._quarantined_at: float | None = None
+        self._arms = 0  # quarantine entries ever; NOT reset on clear
         self.checks = 0
         self.nonfinite_batches = 0
+
+    def _recovery_window_locked(self) -> float:
+        """Current probation delay: the configured window escalated by how
+        many times this replica has been quarantined (caller holds lock)."""
+        c = self.config
+        return min(c.max_recovery_s,
+                   c.recovery_s * c.backoff_factor ** max(0, self._arms - 1))
 
     def note(self, vec, *, bucket=None, now: float | None = None) -> bool:
         """Record one batch's health vector; returns whether it was finite."""
@@ -279,13 +299,19 @@ class HealthMonitor:
             self.checks += 1
             if finite:
                 self._consecutive = 0
-                self._quarantined_at = None
+                self._consecutive_ok += 1
+                if self._consecutive_ok >= self.config.clear_after:
+                    self._quarantined_at = None
             else:
                 self.nonfinite_batches += 1
+                self._consecutive_ok = 0
                 self._consecutive += 1
                 if self._consecutive >= self.config.quarantine_after:
                     # (re-)arm: a bad probe during probation restarts the
-                    # recovery clock
+                    # recovery clock; only the None->armed transition
+                    # escalates (a long bad burst is one quarantine, not N)
+                    if self._quarantined_at is None:
+                        self._arms += 1
                     self._quarantined_at = now
             _g_consecutive.set(self._consecutive, replica=self._rl)
             _g_quarantined.set(0.0 if self._quarantined_at is None else 1.0,
@@ -307,7 +333,13 @@ class HealthMonitor:
             if self._quarantined_at is None:
                 return True
             now = time.perf_counter() if now is None else now
-            return (now - self._quarantined_at) >= self.config.recovery_s
+            return (now - self._quarantined_at) >= self._recovery_window_locked()
+
+    def reset_escalation(self) -> None:
+        """Operator forgiveness: drop the escalated recovery window back to
+        the configured base (e.g. after the poisoning cause was fixed)."""
+        with self._lock:
+            self._arms = min(self._arms, 1)
 
     def describe(self) -> dict:
         with self._lock:
@@ -316,4 +348,6 @@ class HealthMonitor:
                 "nonfinite_batches": self.nonfinite_batches,
                 "consecutive_nonfinite": self._consecutive,
                 "quarantined": self._quarantined_at is not None,
+                "quarantine_arms": self._arms,
+                "recovery_window_s": self._recovery_window_locked(),
             }

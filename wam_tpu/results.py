@@ -12,10 +12,17 @@ import dataclasses
 import json
 import os
 import time
+import warnings
 from dataclasses import dataclass, field
 from typing import Any
 
-__all__ = ["MetricRecord", "JsonlWriter", "CsvWriter", "read_jsonl"]
+__all__ = ["MetricRecord", "JsonlWriter", "CsvWriter", "read_jsonl",
+           "read_jsonl_stats", "LedgerCorruptWarning"]
+
+
+class LedgerCorruptWarning(UserWarning):
+    """A JSONL ledger carried unparsable line(s) — typically a torn final
+    write from a crashed process. Readers skip them (counted)."""
 
 
 @dataclass
@@ -31,8 +38,13 @@ class MetricRecord:
 
 
 class JsonlWriter:
-    """Append-only JSONL sink; each `write` is flushed so an interrupted
-    sweep keeps every finished row."""
+    """Append-only JSONL sink. Each `write` is ONE ``os.write`` of a
+    complete line on an ``O_APPEND`` fd: on POSIX the kernel serializes
+    appends per write call, so concurrent writers (N replica ledgers into
+    one fleet file) never interleave mid-line and a row is either wholly
+    present or wholly absent. A process killed mid-syscall can still leave
+    a torn final line — that is the reader's half of the contract
+    (`read_jsonl` skips it with a counted `LedgerCorruptWarning`)."""
 
     def __init__(self, path: str):
         self.path = path
@@ -40,9 +52,12 @@ class JsonlWriter:
 
     def write(self, record: MetricRecord | dict) -> None:
         row = record.to_dict() if isinstance(record, MetricRecord) else record
-        with open(self.path, "a") as f:
-            f.write(json.dumps(row) + "\n")
-            f.flush()
+        data = (json.dumps(row) + "\n").encode("utf-8")
+        fd = os.open(self.path, os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o644)
+        try:
+            os.write(fd, data)
+        finally:
+            os.close(fd)
 
     def done_keys(self, key: str = "metric") -> set:
         """Keys already written — skip these on resume."""
@@ -51,14 +66,50 @@ class JsonlWriter:
         return {row.get(key) for row in read_jsonl(self.path)}
 
 
-def read_jsonl(path: str) -> list[dict]:
-    out = []
+def read_jsonl(path: str, *, strict: bool = False) -> list[dict]:
+    """Parse a JSONL ledger, tolerating corrupt lines (a torn trailing
+    write from a crashed process): bad lines are skipped with one
+    `LedgerCorruptWarning` per call and counted into the
+    ``wam_tpu_serve_ledger_corrupt_lines_total`` registry counter.
+    ``strict=True`` restores the historical raise-on-bad-line behavior."""
+    rows, corrupt = read_jsonl_stats(path, strict=strict)
+    return rows
+
+
+def read_jsonl_stats(path: str, *, strict: bool = False) -> tuple[list[dict], int]:
+    """`read_jsonl` plus the skipped-line count (ledger readers that report
+    corruption — health_report / trace_report — use the local equivalent of
+    this; library callers get the count without re-reading)."""
+    out, corrupt = [], 0
     with open(path) as f:
         for line in f:
             line = line.strip()
-            if line:
+            if not line:
+                continue
+            try:
                 out.append(json.loads(line))
-    return out
+            except ValueError:
+                if strict:
+                    raise
+                corrupt += 1
+    if corrupt:
+        warnings.warn(
+            f"{path}: skipped {corrupt} corrupt JSONL line(s) "
+            "(torn write from an interrupted process?)",
+            LedgerCorruptWarning, stacklevel=2)
+        _note_corrupt_lines(corrupt)
+    return out, corrupt
+
+
+def _note_corrupt_lines(n: int) -> None:
+    # obs is stdlib-only at import time, so this lazy import cannot cycle
+    # back into results; mutations no-op when the obs layer is disabled
+    from wam_tpu.obs.registry import registry as _registry
+
+    _registry.counter(
+        "wam_tpu_serve_ledger_corrupt_lines_total",
+        "corrupt JSONL ledger lines skipped by tolerant readers",
+    ).inc(n)
 
 
 class CsvWriter:

@@ -22,6 +22,7 @@ from wam_tpu.serve.buckets import Bucket, BucketTable, NoBucketError, bucket_key
 from wam_tpu.serve.entry import fleet_aot_key, jit_entry
 from wam_tpu.serve.fleet import OVERSIZE_ENTRY_ID, FleetServer, NoLiveReplicaError
 from wam_tpu.serve.metrics import SCHEMA_VERSION, FleetMetrics, ServeMetrics, percentile_ms
+from wam_tpu.serve.retry import RetryBudgetExceededError, RetryPolicy, RetryStats
 from wam_tpu.serve.runtime import (
     AttributionServer,
     DeadlineExceededError,
@@ -29,7 +30,9 @@ from wam_tpu.serve.runtime import (
     QueueFullError,
     ServeError,
     ServerClosedError,
+    WorkerCrashedError,
 )
+from wam_tpu.serve.supervisor import ReplicaSupervisor, SupervisorConfig
 
 __all__ = [
     "AttributionServer",
@@ -43,6 +46,12 @@ __all__ = [
     "MemoryAdmissionError",
     "DeadlineExceededError",
     "ServerClosedError",
+    "WorkerCrashedError",
+    "RetryBudgetExceededError",
+    "RetryPolicy",
+    "RetryStats",
+    "ReplicaSupervisor",
+    "SupervisorConfig",
     "ServeMetrics",
     "FleetMetrics",
     "SCHEMA_VERSION",

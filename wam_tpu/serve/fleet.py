@@ -180,6 +180,12 @@ class FleetServer:
     memory_budget : per-replica HBM budget in BYTES — cold-bucket
         admission control (`wam_tpu.obs.MemoryBudget`); each replica gets
         its own budget on its own device.
+    supervise : replica supervision (`serve.supervisor.ReplicaSupervisor`):
+        ``True`` or a `SupervisorConfig` restarts dead replicas with
+        backoff + jitter and escalates crash loops to permanent-dead;
+        None/False (default) keeps the historical permanent-on-first-death
+        semantics. In-flight/queued work re-routes to survivors either way
+        — supervision only changes whether the replica comes BACK.
     """
 
     def __init__(
@@ -207,6 +213,7 @@ class FleetServer:
         health=None,
         slo=None,
         memory_budget=None,
+        supervise=None,
     ):
         if not callable(entry_factory):
             raise TypeError("entry_factory must be callable(replica_id, metrics)")
@@ -230,31 +237,39 @@ class FleetServer:
         self._closed = False
         self._started = False
 
+        # everything _make_server needs to (re)build one replica server —
+        # the restart path constructs from the same recipe as first start
+        self._entry_factory = entry_factory
+        self._server_kw = dict(
+            max_batch=max_batch,
+            max_wait_ms=max_wait_ms,
+            queue_depth=queue_depth,
+            deadline_ms=0.0,  # the fleet applies its default at admission
+            labeled=labeled,
+            warmup=warmup,
+            compilation_cache=compilation_cache,
+            metrics_path=None,  # the fleet emits one merged ledger
+            dtype=dtype,
+            pipelined=pipelined,
+            auto_start=False,
+            health=health,
+            slo=slo,
+            memory=memory_budget,
+        )
+
         self._replicas: list[_Replica] = []
         for rid, dev in enumerate(self.devices):
             m = self.metrics.replica(rid)
-            server = AttributionServer(
-                entry_factory(rid, m),
-                self.table,
-                max_batch=max_batch,
-                max_wait_ms=max_wait_ms,
-                queue_depth=queue_depth,
-                deadline_ms=0.0,  # the fleet applies its default at admission
-                labeled=labeled,
-                warmup=warmup,
-                compilation_cache=compilation_cache,
-                metrics=m,
-                metrics_path=None,  # the fleet emits one merged ledger
-                dtype=dtype,
-                pipelined=pipelined,
-                device=dev,
-                replica_id=rid,
-                auto_start=False,
-                health=health,
-                slo=slo,
-                memory=memory_budget,
-            )
-            self._replicas.append(_Replica(rid, dev, server, m))
+            self._replicas.append(_Replica(rid, dev, self._make_server(rid, m), m))
+
+        # replica supervision (serve.supervisor): None/False = historical
+        # permanent-on-first-death; True or a SupervisorConfig opts in
+        self._supervisor = None
+        if supervise:
+            from wam_tpu.serve.supervisor import ReplicaSupervisor, SupervisorConfig
+
+            cfg = supervise if isinstance(supervise, SupervisorConfig) else None
+            self._supervisor = ReplicaSupervisor(self, cfg)
 
         self._os_entry = None
         self._mesh = None
@@ -275,6 +290,46 @@ class FleetServer:
             self.start()
 
     # -- lifecycle ----------------------------------------------------------
+
+    def _make_server(self, rid, metrics) -> AttributionServer:
+        """Build one replica's `AttributionServer` from the fleet recipe —
+        first construction and supervisor restarts share this, so a
+        restarted replica is configured identically (same entry factory,
+        same accumulating `ServeMetrics`, same device pin)."""
+        return AttributionServer(
+            self._entry_factory(rid, metrics),
+            self.table,
+            metrics=metrics,
+            device=self.devices[rid],
+            replica_id=rid,
+            **self._server_kw,
+        )
+
+    def _rebuild_replica(self, rid) -> None:
+        """Supervisor restart procedure: close the dead server (drains any
+        request that raced in — each fails with `ServerClosedError` and
+        re-routes), rebuild + warm a fresh one (`start()` re-runs the
+        parallel bucket warmup; the process-level jit/AOT caches make it a
+        rehydration, not a recompile), then swap it live under the fleet
+        lock."""
+        replica = self._replicas[rid]
+        try:
+            replica.server.close(emit_metrics=False)
+        except Exception:
+            pass  # the old server may be arbitrarily broken; the fresh
+            # one replaces it regardless
+        server = self._make_server(rid, replica.metrics)
+        server.start()
+        with self._lock:
+            if self._closed:
+                closing = True
+            else:
+                closing = False
+                replica.server = server
+                replica.alive = True
+        if closing:
+            server.close(emit_metrics=False)
+            raise ServerClosedError("fleet closed during replica rebuild")
 
     def start(self) -> "FleetServer":
         """Start (and warm) every replica concurrently. Idempotent."""
@@ -298,6 +353,8 @@ class FleetServer:
             if self._closed:
                 return
             self._closed = True
+        if self._supervisor is not None:
+            self._supervisor.close()
         for r in self._replicas:
             r.server.close(emit_metrics=False)
         if emit_metrics and self.metrics_path:
@@ -334,6 +391,11 @@ class FleetServer:
             "labeled": self.labeled,
             "oversize": self.oversize,
             "seq_route": self._seq_factory is not None,
+            "supervised": self._supervisor is not None,
+            "supervision": (
+                self._supervisor.describe() if self._supervisor is not None
+                else None
+            ),
         }
 
     # -- client side --------------------------------------------------------
@@ -376,6 +438,38 @@ class FleetServer:
     def attribute(self, x, y=None, deadline_ms: float | None = None):
         """Blocking convenience wrapper: submit + wait."""
         return self.submit(x, y, deadline_ms=deadline_ms).result()
+
+    def submit_with_retry(self, x, y=None, *, policy=None, stats=None,
+                          rng=None, deadline_ms: float | None = None) -> Future:
+        """`submit` driven by a `serve.retry.RetryPolicy`: backpressure
+        rejections back off (honoring ``retry_after_s``, capped + jittered)
+        and resubmit within the policy's attempt/budget limits; optional
+        hedging races a second submit against a slow first one. Returns a
+        future resolving to the result or a typed `ServeError`
+        (`RetryBudgetExceededError` once the policy gives up) — one daemon
+        driver thread per call, sized for closed-loop client counts."""
+        from wam_tpu.serve.retry import RetryPolicy
+
+        policy = policy if policy is not None else RetryPolicy()
+        outer: Future = Future()
+
+        def _submit(remaining_s):
+            per_attempt = deadline_ms
+            if remaining_s is not None:
+                rem_ms = remaining_s * 1e3
+                per_attempt = (rem_ms if per_attempt is None
+                               else min(per_attempt, rem_ms))
+            return self.submit(x, y, deadline_ms=per_attempt)
+
+        def _drive():
+            try:
+                outer.set_result(policy.run(_submit, rng=rng, stats=stats))
+            except BaseException as e:  # noqa: BLE001 - future carries it
+                outer.set_exception(e)
+
+        threading.Thread(target=_drive, daemon=True,
+                         name="wam-retry-driver").start()
+        return outer
 
     def attribute_batch(self, xs, ys=None, deadline_ms: float | None = None):
         """Attribute a whole batch. ``len(xs) <= max_batch`` fans out as
@@ -491,14 +585,30 @@ class FleetServer:
     def _harvest(self, inner: Future, replica: _Replica, req: _FleetRequest) -> None:
         """Future callback (runs on the replica's worker thread): forward
         success and per-request errors; treat anything else as a chip loss
-        — mark the replica dead and re-route to survivors."""
+        — mark the replica dead, notify the supervisor (when supervised),
+        and re-route to survivors."""
         exc = inner.exception()
         if exc is None:
             req.future.set_result(inner.result())
             return
+        if isinstance(exc, ServerClosedError):
+            # the REPLICA closed under this request (supervisor restart in
+            # progress, or its worker crashed mid-queue): a liveness event,
+            # not a client semantic — re-route instead of forwarding
+            with self._lock:
+                fleet_closed = self._closed
+            if not fleet_closed:
+                req.tried.add(replica.rid)
+                try:
+                    self._route(req, raise_errors=False)
+                except Exception as e:  # defensive: a callback must never raise
+                    req.future.set_exception(e)
+                return
+            req.future.set_exception(exc)
+            return
         if isinstance(exc, ServeError):
-            # deadline / backpressure / closed: per-request semantics, not
-            # a device loss — the client decides what to do
+            # deadline / backpressure: per-request semantics, not a device
+            # loss — the client decides what to do
             req.future.set_exception(exc)
             return
         with self._lock:
@@ -506,6 +616,8 @@ class FleetServer:
             replica.alive = False
         if was_alive:
             self.metrics.note_replica_death(replica.rid, repr(exc))
+            if self._supervisor is not None:
+                self._supervisor.notify_death(replica.rid, repr(exc))
         req.tried.add(replica.rid)
         try:
             self._route(req, raise_errors=False)

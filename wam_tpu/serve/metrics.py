@@ -99,6 +99,10 @@ _g_warmup = _obs_registry.gauge(
     labels=("replica", "bucket"))
 _c_deaths = _obs_registry.counter(
     "wam_tpu_fleet_replica_deaths_total", "replicas marked dead fleet-wide")
+_c_restarts = _obs_registry.counter(
+    "wam_tpu_serve_restarts_total",
+    "completed replica restarts (supervisor 'alive' transitions)",
+    labels=("replica",))
 _g_fleet_compiles = _obs_registry.gauge(
     "wam_tpu_fleet_compile_count",
     "compile_count per replica as of the last fleet_summary()",
@@ -355,6 +359,7 @@ class FleetMetrics:
         self._lock = threading.Lock()
         self._replicas: dict = {}  # replica_id -> ServeMetrics
         self.deaths: list[dict] = []
+        self.restarts: list[dict] = []  # replica_restart transition rows
         self.oversize = ServeMetrics(replica_id="fleet")
         self._t0 = time.perf_counter()
 
@@ -372,6 +377,37 @@ class FleetMetrics:
             )
         _c_deaths.inc()
 
+    def note_restart(self, replica_id, transition: str, *, attempt: int = 0,
+                     backoff_s: float = 0.0, reason: str = "") -> dict:
+        """One supervisor lifecycle transition (``restarting`` → ``alive`` /
+        ``restart_failed`` / ``permanent_dead``) as a v2 ``replica_restart``
+        ledger row. Completed restarts (``alive``) also count into
+        ``wam_tpu_serve_restarts_total`` so ledger and registry round-trip
+        (tests/test_resilience.py pins the equality)."""
+        row = {
+            "metric": "replica_restart",
+            "schema_version": SCHEMA_VERSION,
+            "replica_id": replica_id,
+            "transition": transition,
+            "attempt": attempt,
+            "backoff_s": backoff_s,
+            "reason": reason,
+            "timestamp": time.time(),
+        }
+        with self._lock:
+            self.restarts.append(row)
+        if transition == "alive":
+            _c_restarts.inc(replica=_rlabel(replica_id))
+        return row
+
+    @staticmethod
+    def load_ledger(path: str) -> list[dict]:
+        """Tolerant ledger merge-read: every parseable row, corrupt lines
+        skipped with a counted warning (`results.read_jsonl`)."""
+        from wam_tpu.results import read_jsonl
+
+        return read_jsonl(path)
+
     def fleet_summary(self) -> dict:
         """The aggregate row: fleet throughput is completed requests (replica
         + oversize) over the fleet's window; latencies pool every replica's
@@ -380,6 +416,7 @@ class FleetMetrics:
         with self._lock:
             replicas = dict(self._replicas)
             deaths = list(self.deaths)
+            restarts = list(self.restarts)
             t0 = self._t0
         window_s = time.perf_counter() - t0
         per_replica = []
@@ -421,6 +458,10 @@ class FleetMetrics:
             "schema_version": SCHEMA_VERSION,
             "replicas": len(per_replica),
             "deaths": deaths,
+            "restarts": sum(1 for r in restarts if r["transition"] == "alive"),
+            "permanent_dead": sorted(
+                {str(r["replica_id"]) for r in restarts
+                 if r["transition"] == "permanent_dead"}),
             "window_s": window_s,
             "submitted": submitted,
             "completed": completed,
@@ -453,6 +494,10 @@ class FleetMetrics:
         if self.oversize.batch_rows:
             self.oversize.emit(writer, config={"oversize": True},
                                obs_snapshot=False)
+        with self._lock:
+            restart_rows = list(self.restarts)
+        for row in restart_rows:
+            writer.write(row)
         summary = self.fleet_summary()
         if config is not None:
             summary["config"] = config

@@ -70,6 +70,7 @@ __all__ = [
     "MemoryAdmissionError",
     "DeadlineExceededError",
     "ServerClosedError",
+    "WorkerCrashedError",
 ]
 
 
@@ -110,6 +111,14 @@ class DeadlineExceededError(ServeError):
 
 class ServerClosedError(ServeError):
     """`submit` after `close()` (or during drain)."""
+
+
+class WorkerCrashedError(ServerClosedError):
+    """The device-owner worker thread itself died (an exception OUTSIDE
+    the per-batch entry/recover path). Queued futures are failed with this
+    instead of hanging forever; a `ServerClosedError` subclass so the
+    fleet treats it as a liveness event and re-routes rather than
+    forwarding it to the client."""
 
 
 @dataclass
@@ -277,6 +286,9 @@ class AttributionServer:
 
         self._cond = threading.Condition()
         self._queues: dict[Bucket, list[_Request]] = {b: [] for b in self.table}
+        # popped-but-unresolved requests: the crash guard's reach into
+        # batches already taken off the queues (see _fail_pending)
+        self._popped: list[_Request] = []
         # popped-but-unfinished batches per bucket: the in-flight half of the
         # projected drain time (queued items alone would read an actively
         # serving replica as idle)
@@ -449,6 +461,9 @@ class AttributionServer:
         with self._cond:
             if self._closed or not self._started:
                 raise ServerClosedError("server is not accepting requests")
+            if self._worker is not None and not self._worker.is_alive():
+                raise WorkerCrashedError(
+                    "serve worker is not running; the server cannot serve")
             if self._pending >= self.queue_depth:
                 self.metrics.note_reject()
                 raise QueueFullError(retry_after_s=self._drain_locked())
@@ -591,12 +606,46 @@ class AttributionServer:
                     del q[: self.max_batch]
                     self._pending -= len(take)
                     self._active[bucket] += 1  # in flight until _finish_active
+                    # only the worker thread mutates _popped; resolved
+                    # entries age out here (at most ~2 batches stay live)
+                    self._popped = [r for r in self._popped
+                                    if not r.future.done()]
+                    self._popped.extend(take)
                     return bucket, take, self._pending + len(take)
                 if not block:
                     return _NOT_READY
                 self._cond.wait(self.max_wait_s - head_wait)
 
     def _worker_loop(self):
+        try:
+            self._worker_loop_inner()
+        except BaseException as e:  # noqa: BLE001 - crash guard (see below)
+            # The loop body only reaches here through a bug outside the
+            # guarded entry/recover paths (or an injected stager fault) —
+            # without this guard every queued future would hang forever.
+            self._fail_pending(WorkerCrashedError(
+                f"serve worker crashed: {e!r}"))
+            raise
+
+    def _fail_pending(self, exc: Exception) -> None:
+        """Stop intake and fail every unresolved request with ``exc`` —
+        both the queued ones (the crashed worker can never pop them) and
+        the popped-but-unresolved ones the crash stranded mid-batch."""
+        with self._cond:
+            self._closed = True
+            reqs = [r for q in self._queues.values() for r in q]
+            for q in self._queues.values():
+                q.clear()
+            self._pending = 0
+            reqs += [r for r in self._popped if not r.future.done()]
+            self._popped = []
+            self._cond.notify_all()
+        for r in reqs:
+            r.future.set_exception(exc)
+        if reqs:
+            self.metrics.note_failed(len(reqs))
+
+    def _worker_loop_inner(self):
         inflight: _Inflight | None = None
         while True:
             # Only block on the queue when nothing is in flight; otherwise

@@ -1,0 +1,26 @@
+"""`wam_tpu.testing` — deterministic fault injection for resilience tests
+and the chaos bench (`scripts/bench_serve.py --chaos`). Production code
+never imports this package; the serve stack is exercised through its
+public factories (entry_factory wrapping), not patched internals — except
+the stager latency hook, which is an explicit context manager.
+"""
+
+from wam_tpu.testing.faults import (
+    DEFAULT_CHAOS,
+    ChaosFault,
+    ChaosSchedule,
+    FaultInjector,
+    FaultSpec,
+    parse_chaos,
+    stager_chaos,
+)
+
+__all__ = [
+    "ChaosFault",
+    "ChaosSchedule",
+    "DEFAULT_CHAOS",
+    "FaultInjector",
+    "FaultSpec",
+    "parse_chaos",
+    "stager_chaos",
+]
