@@ -45,6 +45,21 @@ Fleet modes:
 - ``--toy`` shrinks the workload (one small bucket, few requests) — the
   verify-skill smoke.
 
+Cold-start modes (`wam_tpu.registry`):
+- ``--registry BUNDLE`` (a `ServeConfig` field) hydrates the bundle's
+  compiled executables + schedules before warmup; with ``--aot-keys`` the
+  toy entries are AOT-keyed so the warmup consults (and the bundle seeds)
+  the executable cache. AOT keys are OPT-IN because a warm user AOT cache
+  would silently zero ``compile_count`` on plain runs.
+- ``--cold-ab [BUNDLE]`` measures what a bundle buys a COLD process: it
+  (by default) warms a seed subprocess under throwaway cache dirs,
+  publishes them as a bundle, then runs two fresh cold-cache subprocess
+  arms — baseline vs ``--registry`` — and reports time-to-first-response
+  + the ``post_warm_compiles`` sentinel delta for each. Gates on the
+  hydrated arm serving at ``compile_count == 0`` (the registry acceptance
+  criterion). Pass an existing BUNDLE to skip the seed+publish step.
+
+
 Runs end-to-end on CPU with the toy model — the same path
 tests/test_serve.py and tests/test_fleet.py smoke — and on TPU with
 ``--device tpu`` (donated input buffers, compilation cache).
@@ -159,7 +174,22 @@ def run_bench(cfg, args, n_fleet: int):
             n_samples=n_samples,
             sample_batch_size=None,
         )
-        entry_factory = lambda rid, m: wam.serve_entry(on_trace=m.note_compile)
+        if getattr(args, "aot_keys", False) or cfg.registry:
+            # AOT-keyed entries: warmup consults (or a registry bundle
+            # seeds) the executable cache instead of tracing. Safe to key
+            # on the bench config alone — the toy model inits from a fixed
+            # seed, so its closed-over params are process-stable (the
+            # aot.py keying contract); cached_entry adds shape + backend.
+            from wam_tpu.serve import OVERSIZE_ENTRY_ID, fleet_aot_key
+
+            base_key = f"bench_serve|toy2d|J2|n{n_samples}|mb{max_batch}"
+
+            def entry_factory(rid, m, _wam=wam, _base=base_key):
+                key = (fleet_aot_key(_base, n_fleet)
+                       if rid == OVERSIZE_ENTRY_ID else _base)
+                return _wam.serve_entry(on_trace=m.note_compile, aot_key=key)
+        else:
+            entry_factory = lambda rid, m: wam.serve_entry(on_trace=m.note_compile)
 
     queue_depth = cfg.queue_depth
     if schedule is not None:
@@ -182,6 +212,10 @@ def run_bench(cfg, args, n_fleet: int):
     slo_policy = cfg.slo or None
 
     metrics_path = cfg.metrics_path or "results/bench_serve.jsonl"
+    registry = cfg.registry or None
+    # cold-start clock starts BEFORE server build: hydration + warmup
+    # compiles are exactly what time-to-first-response must include
+    t_build0 = time.perf_counter()
     if n_fleet == 1:
         # single-chip serving stays the plain server — the fleet layer must
         # cost nothing when you don't ask for it
@@ -201,6 +235,7 @@ def run_bench(cfg, args, n_fleet: int):
             health=health_cfg,
             slo=slo_policy,
             memory=mem_budget,
+            registry=registry,
         )
         fleet_metrics = None
     else:
@@ -232,6 +267,7 @@ def run_bench(cfg, args, n_fleet: int):
             slo=slo_policy,
             memory_budget=mem_budget,
             supervise=supervise,
+            registry=registry,
         )
         if server.prom_server is not None:
             print(f"/metrics on port {server.prom_server.server_port}")
@@ -256,6 +292,7 @@ def run_bench(cfg, args, n_fleet: int):
     retry_stats = RetryStats()
     counts = {"submitted": 0, "resolved_ok": 0, "resolved_error": 0, "lost": 0}
     counts_lock = threading.Lock()
+    first_response = {"t": None}  # perf_counter of the first resolved_ok
 
     def client(cid: int):
         rng = random.Random(args.seed * 997 + cid)
@@ -290,6 +327,8 @@ def run_bench(cfg, args, n_fleet: int):
                 errors.append(repr(e))
             with counts_lock:
                 counts[outcome] += 1
+                if outcome == "resolved_ok" and first_response["t"] is None:
+                    first_response["t"] = time.perf_counter()
 
     t_load0 = time.perf_counter()
     threads = [threading.Thread(target=client, args=(i,)) for i in range(n_clients)]
@@ -302,10 +341,16 @@ def run_bench(cfg, args, n_fleet: int):
 
     post_warm_compiles = obs_sentinel.trace_count() - warm_traces
     events = obs_sentinel.compile_events()
-    if events:
+    aot_rows = obs_sentinel.aot_events()
+    if events or aot_rows:
         writer = JsonlWriter(metrics_path)
         for ev in events:
             writer.write({"metric": "compile_event", "schema_version": 2, **ev})
+        # AOT consult attribution (hit / miss / export / registry_hit /
+        # registry_miss): the ledger says WHY each bucket did or did not
+        # compile, not just how many compiles happened
+        for ev in aot_rows:
+            writer.write({"metric": "aot_event", "schema_version": 2, **ev})
 
     if fleet_metrics is not None:
         summary = fleet_metrics.fleet_summary()
@@ -322,6 +367,17 @@ def run_bench(cfg, args, n_fleet: int):
             summary["completed"] / load_s if load_s > 0 else 0.0
         )
     summary["post_warm_compiles"] = post_warm_compiles
+    summary["ttfr_s"] = (
+        first_response["t"] - t_build0 if first_response["t"] is not None
+        else None
+    )
+    if getattr(server, "registry_report", None) is not None:
+        summary["registry"] = server.registry_report.row()
+    summary["aot_events"] = {
+        ev: obs_sentinel.aot_event_count(ev)
+        for ev in ("hit", "miss", "export", "registry_hit", "registry_miss")
+        if obs_sentinel.aot_event_count(ev)
+    }
     summary["client"] = {**counts, **retry_stats.as_dict()}
     if schedule is not None:
         summary["chaos"] = {
@@ -329,6 +385,124 @@ def run_bench(cfg, args, n_fleet: int):
             "injected": schedule.injected_counts(),
         }
     return summary, errors
+
+
+def _bench_arm(label: str, tmp: str, extra_args: list, env_caches: dict,
+               seed: int) -> dict:
+    """Run one bench arm in a FRESH subprocess with its own cache dirs
+    (the only honest way to measure a cold start — this process has warm
+    jit caches). Returns the arm's single sweep point."""
+    import subprocess
+
+    emit = os.path.join(tmp, f"{label}.json")
+    env = dict(os.environ)
+    env.pop("WAM_TPU_NO_AOT_CACHE", None)
+    env.pop("WAM_TPU_NO_REGISTRY", None)
+    for var, path in env_caches.items():
+        os.makedirs(os.path.dirname(path) or path, exist_ok=True)
+        env[var] = path
+    cmd = [
+        sys.executable, os.path.abspath(__file__),
+        "--toy", "--device", "cpu", "--aot-keys",
+        "--seed", str(seed), "--emit", emit,
+        "--metrics-path", os.path.join(tmp, f"{label}.jsonl"),
+    ] + extra_args
+    proc = subprocess.run(cmd, capture_output=True, text=True, timeout=600,
+                          env=env)
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"cold-ab arm {label!r} failed (rc={proc.returncode}):\n"
+            f"{proc.stdout[-2000:]}\n{proc.stderr[-2000:]}")
+    with open(emit) as f:
+        return json.load(f)["curve"][0]
+
+
+def _cold_start_ab(cfg, args) -> int:
+    """--cold-ab: the registry acceptance measurement. Seed (warm a toy
+    subprocess under throwaway caches), publish those caches as a bundle
+    (skipped when an existing BUNDLE was given), then run two COLD-cache
+    subprocess arms — no-registry baseline vs --registry-hydrated — and
+    compare time-to-first-response + compile counts. Gate: the hydrated
+    arm serves at ``compile_count == 0`` and ``post_warm_compiles == 0``."""
+    import tempfile
+
+    tmp = tempfile.mkdtemp(prefix="wam_cold_ab_")
+    bundle = args.cold_ab
+    if bundle:
+        print(f"cold-ab: using existing bundle {bundle}")
+    else:
+        seed_caches = {
+            "WAM_TPU_AOT_CACHE": os.path.join(tmp, "seed", "aot"),
+            "WAM_TPU_SCHEDULE_CACHE": os.path.join(tmp, "seed",
+                                                   "schedules.json"),
+            "WAM_TPU_CACHE_DIR": os.path.join(tmp, "seed", "xla"),
+        }
+        print("cold-ab: warming seed caches in a fresh subprocess...")
+        seed_point = _bench_arm("seed", tmp, [], seed_caches, args.seed)
+        print(f"cold-ab: seed arm compiled {seed_point['compile_count']} "
+              f"graph(s), ttfr {seed_point['ttfr_s']:.2f}s")
+        from wam_tpu.registry import publish_bundle
+
+        bundle = os.path.join(tmp, "bundle")
+        manifest = publish_bundle(
+            bundle,
+            aot_dir=seed_caches["WAM_TPU_AOT_CACHE"],
+            schedule_path=seed_caches["WAM_TPU_SCHEDULE_CACHE"],
+            xla_dir=seed_caches["WAM_TPU_CACHE_DIR"],
+            source={"bench": "bench_serve --cold-ab", "seed": args.seed},
+        )
+        n_aot = sum(1 for a in manifest["artifacts"] if a["kind"] == "aot")
+        print(f"cold-ab: published {len(manifest['artifacts'])} artifact(s) "
+              f"({n_aot} aot) -> {bundle}")
+        if n_aot == 0:
+            print("cold-ab: seed run exported no AOT artifacts — nothing "
+                  "to A/B", file=sys.stderr)
+            return 1
+
+    arms = {}
+    for label, extra in (("baseline", []),
+                         ("hydrated", ["--registry", bundle])):
+        cold_caches = {
+            "WAM_TPU_AOT_CACHE": os.path.join(tmp, label, "aot"),
+            "WAM_TPU_SCHEDULE_CACHE": os.path.join(tmp, label,
+                                                   "schedules.json"),
+            "WAM_TPU_CACHE_DIR": os.path.join(tmp, label, "xla"),
+        }
+        arms[label] = _bench_arm(label, tmp, extra, cold_caches, args.seed)
+
+    base, hyd = arms["baseline"], arms["hydrated"]
+    result = {
+        "bench": "bench_serve_cold_ab",
+        "device": "cpu",
+        "bundle": bundle,
+        "cold_start": [
+            {"arm": label,
+             "ttfr_s": round(p["ttfr_s"], 3) if p["ttfr_s"] else p["ttfr_s"],
+             "compile_count": p["compile_count"],
+             "post_warm_compiles": p["post_warm_compiles"],
+             "aot_events": p.get("aot_events", {}),
+             "registry": p.get("registry")}
+            for label, p in arms.items()
+        ],
+        "ttfr_speedup": (round(base["ttfr_s"] / hyd["ttfr_s"], 3)
+                         if base["ttfr_s"] and hyd["ttfr_s"] else None),
+    }
+    print(json.dumps(result, indent=2))
+    if args.emit:
+        os.makedirs(os.path.dirname(args.emit) or ".", exist_ok=True)
+        with open(args.emit, "w") as f:
+            json.dump(result, f, indent=2)
+        print(f"emitted: {args.emit}")
+    if hyd["compile_count"] != 0 or hyd["post_warm_compiles"] != 0:
+        print(f"cold-ab GATE FAILED: hydrated arm compiled "
+              f"(compile_count={hyd['compile_count']}, "
+              f"post_warm_compiles={hyd['post_warm_compiles']})",
+              file=sys.stderr)
+        return 1
+    print(f"cold-ab gate passed: hydrated cold start served at "
+          f"compile_count == 0 "
+          f"(ttfr {base['ttfr_s']:.2f}s -> {hyd['ttfr_s']:.2f}s)")
+    return 0
 
 
 def _obs_overhead_bench(cfg, args, sweep):
@@ -473,6 +647,18 @@ def main():
                              "per-replica '0:exc=0.5;*:nan=0.1' "
                              "(wam_tpu.testing.faults grammar); the run "
                              "gates on zero lost requests")
+    parser.add_argument("--aot-keys", action="store_true",
+                        help="AOT-key the toy serving entries so warmup "
+                             "consults the executable cache (implied by "
+                             "--registry; opt-in because a warm user AOT "
+                             "cache zeroes compile_count)")
+    parser.add_argument("--cold-ab", nargs="?", const="", default=None,
+                        metavar="BUNDLE",
+                        help="cold-start A/B in fresh subprocesses: "
+                             "baseline vs --registry-hydrated cold caches "
+                             "(seed+publish a toy bundle first unless an "
+                             "existing BUNDLE is given); gates on the "
+                             "hydrated arm at compile_count == 0")
     from wam_tpu.config import ServeConfig, add_config_args, config_from_args
 
     add_config_args(parser, ServeConfig)
@@ -494,6 +680,8 @@ def main():
 
     if args.obs_bench:
         return _obs_overhead_bench(cfg, args, sweep)
+    if args.cold_ab is not None:
+        return _cold_start_ab(cfg, args)
 
     obs.configure(enabled=args.obs == "on")
 
@@ -510,7 +698,15 @@ def main():
             "latency_p99_ms": summary["latency_p99_ms"],
             "compile_count": summary["compile_count"],
             "post_warm_compiles": summary["post_warm_compiles"],
+            "ttfr_s": summary["ttfr_s"],
         }
+        if summary.get("aot_events"):
+            point["aot_events"] = summary["aot_events"]
+        if "registry" in summary:
+            point["registry"] = {
+                k: summary["registry"][k]
+                for k in ("bundle", "status", "hydrated", "schedules_added")
+            }
         if "per_replica" in summary:
             point["utilization"] = {
                 str(r["replica_id"]): round(r["utilization"], 4)

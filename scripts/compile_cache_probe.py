@@ -10,6 +10,14 @@ measure what the disk cache actually buys a cold process
 
 Usage: python scripts/compile_cache_probe.py [--methods lrp,guided,gradcam]
        [--cache-dir DIR] [--clear]
+
+Registry mode: ``--registry BUNDLE`` skips the compile probes and instead
+reports the bundle's per-artifact hydratability on THIS host — outcome
+"ok"/"present" vs "digest_mismatch"/"fetch_error" vs the wholesale causes
+("stale_schema"/"version_mismatch"/"platform_mismatch") — and exits 1
+when zero artifacts are hydratable (the CI smoke gate for published
+bundles). Diagnostic only: nothing is written, and the
+`WAM_TPU_NO_REGISTRY` kill switch is deliberately ignored.
 """
 
 import argparse
@@ -30,11 +38,17 @@ def main():
                     help="wipe the cache dir first (gives the cold number)")
     ap.add_argument("--image", type=int, default=224)
     ap.add_argument("--batch", type=int, default=8)
+    ap.add_argument("--registry", default=None, metavar="BUNDLE",
+                    help="probe a compile-artifact bundle instead of "
+                         "running compile probes (exit 1 when nothing "
+                         "in it is hydratable here)")
     args = ap.parse_args()
 
     from wam_tpu.config import enable_compilation_cache, ensure_usable_backend
 
     ensure_usable_backend(timeout_s=180.0)
+    if args.registry is not None:
+        return probe_registry(args.registry)
     cache_dir = enable_compilation_cache(args.cache_dir)
     if args.clear and os.path.isdir(cache_dir):
         shutil.rmtree(cache_dir)
@@ -73,5 +87,28 @@ def main():
               flush=True)
 
 
+def probe_registry(bundle: str) -> int:
+    """Per-artifact hit/miss/stale breakdown for one bundle, non-writing
+    (`RegistryClient.probe`). One JSON document; exit 1 on zero hydratable
+    artifacts."""
+    from wam_tpu.registry import RegistryClient
+
+    report = RegistryClient(bundle).probe()
+    by_outcome: dict = {}
+    for row in report["artifacts"]:
+        k = f"{row['kind']}:{row['outcome']}"
+        by_outcome[k] = by_outcome.get(k, 0) + 1
+    print(json.dumps({
+        "bundle": report["bundle"],
+        "status": report["status"],
+        "hydratable": report["hydratable"],
+        "total": len(report["artifacts"]),
+        "by_outcome": by_outcome,
+        "schedules": report["schedules"],
+        "artifacts": report["artifacts"],
+    }, indent=1), flush=True)
+    return 0 if report["hydratable"] > 0 else 1
+
+
 if __name__ == "__main__":
-    main()
+    sys.exit(main() or 0)

@@ -1,0 +1,517 @@
+"""Compile-artifact registry (`wam_tpu.registry`): publish → hydrate
+round-trips, the silent-miss ladder (torn manifest → stale schema →
+platform fingerprint → per-artifact digest), the `WAM_TPU_NO_REGISTRY`
+kill switch, schedule-snapshot merge semantics (local wins), the CLI
+exit-code gates, and the serve-stack wiring — a cold-cache server and a
+supervised fleet restart both warming from a bundle at ZERO compiles,
+sentinel-verified.
+
+Every test isolates the three cache layers through their env overrides
+(`WAM_TPU_AOT_CACHE` / `WAM_TPU_SCHEDULE_CACHE` / `WAM_TPU_CACHE_DIR`) so
+nothing touches ~/.cache. Runs on the virtual 8-device CPU mesh the
+conftest forces."""
+
+import json
+import os
+import shutil
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from conftest import need_devices
+from wam_tpu import obs
+from wam_tpu.obs import sentinel
+from wam_tpu.pipeline import aot as aot_cache
+from wam_tpu.registry import (
+    REGISTRY_SCHEMA_VERSION,
+    RegistryClient,
+    publish_bundle,
+    resolve_client,
+)
+from wam_tpu.registry import __main__ as registry_cli
+from wam_tpu.tune.cache import SCHEDULE_CACHE_VERSION, ScheduleCache
+
+_ARGS = (jnp.arange(4, dtype=jnp.float32),)
+
+
+def _seed_aot(key, cache_dir):
+    """Export one real executable under ``key`` (the publisher side)."""
+    fn = aot_cache.cached_jit(lambda x: x * 2.0 + 1.0, _ARGS, key,
+                              cache_dir=str(cache_dir))
+    jax.block_until_ready(fn(*_ARGS))
+    payload, header = aot_cache.read_aot_payload(key, str(cache_dir))
+    assert payload is not None and header["origin"] == "exported"
+    return payload
+
+
+def _aot_seq0():
+    rows = sentinel.aot_events()
+    return rows[-1]["seq"] if rows else 0
+
+
+def _edit_manifest(bundle, mutate):
+    path = os.path.join(str(bundle), "manifest.json")
+    with open(path) as f:
+        doc = json.load(f)
+    mutate(doc)
+    with open(path, "w") as f:
+        json.dump(doc, f)
+
+
+# -- publish → hydrate round-trip ---------------------------------------------
+
+
+def test_publish_hydrate_roundtrip(tmp_path):
+    """A bundle published from one machine's caches seeds another's: the
+    AOT payload lands byte-identical under origin "registry", the XLA
+    cache file copies in by name, and a later consult serves the
+    executable with ZERO traces, attributed as a registry_hit."""
+    pub, tgt = tmp_path / "pub", tmp_path / "tgt"
+    payload = _seed_aot("rt-key", pub)
+    xla_pub, xla_tgt = tmp_path / "xla_pub", tmp_path / "xla_tgt"
+    os.makedirs(xla_pub / "shard")
+    (xla_pub / "shard" / "mod.bin").write_bytes(b"fake-xla-executable")
+
+    manifest = publish_bundle(str(tmp_path / "bundle"), aot_dir=str(pub),
+                              xla_dir=str(xla_pub),
+                              schedule_path=str(tmp_path / "none.json"))
+    kinds = sorted(a["kind"] for a in manifest["artifacts"])
+    assert kinds == ["aot", "xla"]
+    assert all(len(a["sha256"]) == 64 for a in manifest["artifacts"])
+
+    report = RegistryClient(str(tmp_path / "bundle")).hydrate(
+        aot_dir=str(tgt), schedule_path=str(tmp_path / "sched.json"),
+        xla_dir=str(xla_tgt))
+    assert report.status == "hydrated"
+    assert report.count("aot", "hydrated") == 1
+    assert report.count("xla", "hydrated") == 1
+    assert report.hydrated == 2
+    got, header = aot_cache.read_aot_payload("rt-key", str(tgt))
+    assert got == payload  # pure serialization round-trips bit-exact
+    assert header["origin"] == "registry"
+    assert (xla_tgt / "shard" / "mod.bin").read_bytes() == b"fake-xla-executable"
+
+    seq0 = _aot_seq0()
+    with sentinel.assert_no_retrace():
+        fn = aot_cache.cached_jit(lambda x: x * 2.0 + 1.0, _ARGS, "rt-key",
+                                  cache_dir=str(tgt))
+        out = np.asarray(fn(*_ARGS))
+    np.testing.assert_allclose(out, np.arange(4) * 2.0 + 1.0)
+    events = [(e["aot_event"], e["key"])
+              for e in sentinel.aot_events(since_seq=seq0)]
+    assert ("registry_hit", "rt-key") in events
+
+    # ledger row shape: the serve close path writes exactly this dict
+    row = report.row()
+    assert row["metric"] == "registry_hydration"
+    assert row["schema_version"] == 2
+    assert row["hydrated"] == 2
+
+
+def test_hydrate_is_idempotent_local_wins(tmp_path):
+    """Re-hydrating over a warm cache rewrites nothing — valid local
+    entries count as "present" (the supervisor-restart path calls hydrate
+    on every rebuild, so it must be free when the disk is already warm)."""
+    pub = tmp_path / "pub"
+    _seed_aot("idem-key", pub)
+    bundle = str(tmp_path / "bundle")
+    publish_bundle(bundle, aot_dir=str(pub), include_xla=False)
+
+    tgt = tmp_path / "tgt"
+    kw = dict(aot_dir=str(tgt), schedule_path=str(tmp_path / "s.json"))
+    assert RegistryClient(bundle).hydrate(**kw).count("aot", "hydrated") == 1
+    entry_path = aot_cache.aot_entry_path("idem-key", str(tgt))
+    mtime = os.path.getmtime(entry_path)
+    again = RegistryClient(bundle).hydrate(**kw)
+    assert again.count("aot", "present") == 1
+    assert again.count("aot", "hydrated") == 0
+    assert os.path.getmtime(entry_path) == mtime
+
+
+# -- the silent-miss ladder ---------------------------------------------------
+
+
+def test_corrupt_artifact_is_per_artifact_miss(tmp_path):
+    """One flipped payload loses ONE artifact (digest_mismatch + a
+    registry_miss sentinel event); the rest of the bundle still hydrates."""
+    pub = tmp_path / "pub"
+    _seed_aot("good-key", pub)
+    _seed_aot("bad-key", pub)
+    bundle = str(tmp_path / "bundle")
+    manifest = publish_bundle(bundle, aot_dir=str(pub), include_xla=False)
+    bad = next(a for a in manifest["artifacts"] if a["key"] == "bad-key")
+    with open(os.path.join(bundle, bad["file"]), "wb") as f:
+        f.write(b"bitrot")
+
+    seq0 = _aot_seq0()
+    report = RegistryClient(bundle).hydrate(
+        aot_dir=str(tmp_path / "tgt"),
+        schedule_path=str(tmp_path / "s.json"))
+    assert report.status == "hydrated"  # partial hydration is still a win
+    assert report.count("aot", "hydrated") == 1
+    assert report.count("aot", "digest_mismatch") == 1
+    events = [(e["aot_event"], e["key"])
+              for e in sentinel.aot_events(since_seq=seq0)]
+    assert ("registry_miss", "bad-key") in events
+    payload, _ = aot_cache.read_aot_payload("bad-key", str(tmp_path / "tgt"))
+    assert payload is None  # the corrupt artifact was never seeded
+
+
+def test_manifest_digest_tamper_rejected(tmp_path):
+    """A manifest whose recorded sha256 disagrees with the (intact)
+    payload is equally a per-artifact miss — the digest binds both ways."""
+    pub = tmp_path / "pub"
+    _seed_aot("tamper-key", pub)
+    bundle = str(tmp_path / "bundle")
+    publish_bundle(bundle, aot_dir=str(pub), include_xla=False)
+    _edit_manifest(bundle, lambda d: d["artifacts"][0].update(
+        sha256="0" * 64))
+    report = RegistryClient(bundle).hydrate(
+        aot_dir=str(tmp_path / "tgt"),
+        schedule_path=str(tmp_path / "s.json"))
+    assert report.count("aot", "digest_mismatch") == 1
+    assert report.hydrated == 0
+
+
+def test_torn_manifest_is_empty_bundle(tmp_path):
+    """Half a JSON document (a torn publish) reads as no bundle at all."""
+    bundle = tmp_path / "bundle"
+    os.makedirs(bundle)
+    (bundle / "manifest.json").write_text('{"registry_schema_version": 1, "art')
+    tgt = tmp_path / "tgt"
+    report = RegistryClient(str(bundle)).hydrate(
+        aot_dir=str(tgt), schedule_path=str(tmp_path / "s.json"))
+    assert report.status == "no_manifest"
+    assert report.hydrated == 0
+    assert not os.path.exists(tgt)  # zero writes
+    # absent bundle directory: same terminal status, still no error
+    gone = RegistryClient(str(tmp_path / "never-published")).hydrate(
+        aot_dir=str(tgt), schedule_path=str(tmp_path / "s.json"))
+    assert gone.status == "no_manifest"
+
+
+def test_stale_schema_and_foreign_platform_skip_wholesale(tmp_path):
+    """A manifest from a future registry schema, a different backend, or a
+    different AOT cache schema is ignored WHOLESALE — and `probe` stamps
+    the wholesale cause on every artifact row (hydratable == 0, the CI
+    gate)."""
+    pub = tmp_path / "pub"
+    _seed_aot("whole-key", pub)
+    cases = [
+        ("stale_schema",
+         lambda d: d.update(registry_schema_version=REGISTRY_SCHEMA_VERSION + 1)),
+        ("platform_mismatch",
+         lambda d: d["platform"].update(backend="tpu")),
+        ("version_mismatch",
+         lambda d: d["platform"].update(aot_cache_version=999)),
+    ]
+    for status, mutate in cases:
+        bundle = str(tmp_path / f"bundle-{status}")
+        publish_bundle(bundle, aot_dir=str(pub), include_xla=False)
+        _edit_manifest(bundle, mutate)
+        tgt = tmp_path / f"tgt-{status}"
+        report = RegistryClient(bundle).hydrate(
+            aot_dir=str(tgt), schedule_path=str(tmp_path / "s.json"))
+        assert report.status == status
+        assert not os.path.exists(tgt)
+        probe = RegistryClient(bundle).probe(aot_dir=str(tgt))
+        assert probe["status"] == status
+        assert probe["hydratable"] == 0
+        assert [r["outcome"] for r in probe["artifacts"]] == [status]
+
+
+def test_kill_switch_disables_hydrate_not_probe(tmp_path, monkeypatch):
+    """WAM_TPU_NO_REGISTRY=1: hydrate is a zero-IO no-op; `probe` (a
+    diagnostic) deliberately keeps working."""
+    pub = tmp_path / "pub"
+    _seed_aot("kill-key", pub)
+    bundle = str(tmp_path / "bundle")
+    publish_bundle(bundle, aot_dir=str(pub), include_xla=False)
+    monkeypatch.setenv("WAM_TPU_NO_REGISTRY", "1")
+    tgt = tmp_path / "tgt"
+    report = RegistryClient(bundle).hydrate(
+        aot_dir=str(tgt), schedule_path=str(tmp_path / "s.json"))
+    assert report.status == "disabled"
+    assert not os.path.exists(tgt)
+    probe = RegistryClient(bundle).probe(aot_dir=str(tgt))
+    assert probe["hydratable"] == 1
+    monkeypatch.setenv("WAM_TPU_NO_REGISTRY", "0")  # "0" means enabled
+    assert RegistryClient(bundle).hydrate(
+        aot_dir=str(tgt),
+        schedule_path=str(tmp_path / "s.json")).status == "hydrated"
+
+
+def test_resolve_client_normalizes_the_serve_param(tmp_path):
+    assert resolve_client(None) is None
+    assert resolve_client("") is None
+    client = RegistryClient(str(tmp_path))
+    assert resolve_client(client) is client
+    made = resolve_client(str(tmp_path / "b"))
+    assert isinstance(made, RegistryClient)
+    assert made.bundle == str(tmp_path / "b")
+
+
+# -- schedule snapshot --------------------------------------------------------
+
+
+def test_schedule_snapshot_merges_under_local(tmp_path):
+    """Bundle schedules fill gaps only: a locally-tuned entry for the same
+    key survives hydration untouched (local reflects THIS machine), and a
+    stale-version snapshot is ignored wholesale."""
+    pub_sched = tmp_path / "pub.json"
+    cache = ScheduleCache(path=str(pub_sched))
+    cache.put("wamtest|published|only", {"sample_chunk": 64})
+    cache.put("wamtest|shared|key", {"sample_chunk": 999})
+    cache.save()
+    bundle = str(tmp_path / "bundle")
+    publish_bundle(bundle, aot_dir=str(tmp_path / "no-aot"),
+                   schedule_path=str(pub_sched), include_xla=False)
+
+    local_sched = tmp_path / "local.json"
+    local = ScheduleCache(path=str(local_sched))
+    local.put("wamtest|shared|key", {"sample_chunk": 8})  # locally tuned
+    local.save()
+    report = RegistryClient(bundle).hydrate(
+        aot_dir=str(tmp_path / "tgt"), schedule_path=str(local_sched))
+    assert report.schedules_status == "merged"
+    assert report.schedules_added == 1  # only the gap
+    merged = ScheduleCache(path=str(local_sched))
+    assert merged.get("wamtest|shared|key") == {"sample_chunk": 8}
+    assert merged.get("wamtest|published|only") == {"sample_chunk": 64}
+
+    # stale snapshot version: ignored wholesale, nothing added
+    _edit_manifest(bundle, lambda d: d["schedules"].update(
+        version=SCHEDULE_CACHE_VERSION + 1))
+    again = RegistryClient(bundle).hydrate(
+        aot_dir=str(tmp_path / "tgt2"), schedule_path=str(local_sched))
+    assert again.schedules_status == "stale"
+    assert again.schedules_added == 0
+
+
+# -- CLI ----------------------------------------------------------------------
+
+
+def test_cli_publish_inspect_hydrate_exit_codes(tmp_path, capsys):
+    """`python -m wam_tpu.registry`: publish exits 1 on an empty bundle,
+    inspect exits 1 when nothing is hydratable (the CI smoke gates), and
+    each subcommand prints one JSON document."""
+    pub = tmp_path / "pub"
+    _seed_aot("cli-key", pub)
+    bundle = str(tmp_path / "bundle")
+    rc = registry_cli.main(["publish", "--out", bundle,
+                            "--aot-dir", str(pub), "--no-xla",
+                            "--schedule-cache", str(tmp_path / "s.json")])
+    assert rc == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["aot"] == 1
+    assert doc["platform"]["backend"] == jax.default_backend()
+
+    rc = registry_cli.main(["publish", "--out", str(tmp_path / "empty"),
+                            "--aot-dir", str(tmp_path / "no-cache"),
+                            "--no-xla", "--no-schedules"])
+    assert rc == 1  # nothing to publish
+    capsys.readouterr()
+
+    tgt = tmp_path / "tgt"
+    assert registry_cli.main(["inspect", bundle,
+                              "--aot-dir", str(tgt)]) == 0
+    assert json.loads(capsys.readouterr().out)["hydratable"] == 1
+    assert registry_cli.main(["inspect", str(tmp_path / "nowhere"),
+                              "--aot-dir", str(tgt)]) == 1
+    capsys.readouterr()
+
+    rc = registry_cli.main(["hydrate", bundle, "--aot-dir", str(tgt),
+                            "--schedule-cache", str(tmp_path / "s2.json"),
+                            "--xla-dir", str(tmp_path / "xla")])
+    assert rc == 0
+    row = json.loads(capsys.readouterr().out)
+    assert row["metric"] == "registry_hydration"
+    assert row["hydrated"] == 1
+    assert aot_cache.read_aot_payload("cli-key", str(tgt))[0] is not None
+
+
+def test_cli_from_prewarm_filters_keys(tmp_path, capsys):
+    """`publish --from-prewarm` snapshots exactly the keys the prewarm
+    manifest says it warmed; a legacy manifest without a ``warmed`` block
+    contributes nothing (and alone falls back to the full-cache walk)."""
+    pub = tmp_path / "pub"
+    _seed_aot("warmed-key", pub)
+    _seed_aot("other-key", pub)
+    warm = tmp_path / "warm.json"
+    warm.write_text(json.dumps({
+        "config": "toy", "warmed": {
+            "bucket_keys": ["wam2d|toy"], "aot_keys": ["warmed-key"],
+            "schedule_version": SCHEDULE_CACHE_VERSION,
+        }}))
+    legacy = tmp_path / "legacy.json"
+    legacy.write_text(json.dumps({"config": "toy", "aot": "exported"}))
+
+    keys, sources = registry_cli._prewarm_keys([str(warm), str(legacy)])
+    assert keys == ["warmed-key"]
+    assert len(sources) == 1 and sources[0]["bucket_keys"] == ["wam2d|toy"]
+    assert registry_cli._prewarm_keys([str(legacy)]) == (None, [])
+
+    bundle = str(tmp_path / "bundle")
+    rc = registry_cli.main(["publish", "--out", bundle, "--aot-dir",
+                            str(pub), "--no-xla", "--no-schedules",
+                            "--from-prewarm", str(warm), str(legacy)])
+    assert rc == 0
+    capsys.readouterr()
+    from wam_tpu.registry import load_manifest
+
+    manifest = load_manifest(bundle)
+    assert [a["key"] for a in manifest["artifacts"]] == ["warmed-key"]
+    assert manifest["source"]["prewarm"][0]["prewarm_manifest"] == str(warm)
+
+
+# -- serve wiring -------------------------------------------------------------
+
+
+def _toy_wam2d():
+    from wam_tpu.models.toy import toy_conv_model
+    from wam_tpu.wam2d import BaseWAM2D
+
+    toy = toy_conv_model(jax.random.PRNGKey(0), ndim=2)
+    return BaseWAM2D(lambda x: toy(x.mean(axis=1)), J=2)
+
+
+def test_server_cold_cache_warms_from_bundle(tmp_path, monkeypatch):
+    """The acceptance invariant at the `AttributionServer` level: a server
+    whose AOT cache dir is EMPTY but which is handed ``registry=`` warms
+    up and serves with zero entry traces, bit-identical to the publisher —
+    and its close path lands the ``registry_hydration`` ledger row."""
+    from wam_tpu.serve import AttributionServer
+
+    pub = tmp_path / "pub-aot"
+    monkeypatch.setenv("WAM_TPU_AOT_CACHE", str(pub))
+    monkeypatch.setenv("WAM_TPU_SCHEDULE_CACHE", str(tmp_path / "s.json"))
+    wam = _toy_wam2d()
+    x = np.asarray(jax.random.normal(jax.random.PRNGKey(1), (1, 16, 16)))
+    ref = np.asarray(wam(x[None], np.asarray([2])))[0]
+
+    cold = []
+    server = AttributionServer(
+        wam.serve_entry(on_trace=lambda: cold.append(1), aot_key="reg-serve"),
+        [(1, 16, 16)], max_batch=2,
+    )
+    server.close()
+    assert cold == [1]  # publisher warmup exported the executable
+
+    bundle = str(tmp_path / "bundle")
+    publish_bundle(bundle, aot_dir=str(pub), include_xla=False,
+                   schedule_path=str(tmp_path / "s.json"))
+    monkeypatch.setenv("WAM_TPU_AOT_CACHE", str(tmp_path / "cold-aot"))
+
+    warm = []
+    ledger = str(tmp_path / "serve.jsonl")
+    server = AttributionServer(
+        wam.serve_entry(on_trace=lambda: warm.append(1), aot_key="reg-serve"),
+        [(1, 16, 16)], max_batch=2, metrics_path=ledger, registry=bundle,
+    )
+    try:
+        assert server.registry_report.status == "hydrated"
+        assert server.registry_report.hydrated >= 1
+        assert server.describe()["registry"] == bundle
+        got = server.attribute(x, 2)
+    finally:
+        server.close()
+    assert warm == []  # the bundle, not a compile, paid the warmup
+    np.testing.assert_allclose(got, ref, atol=1e-6)
+    rows = [json.loads(line) for line in open(ledger)]
+    hyd = [r for r in rows if r.get("metric") == "registry_hydration"]
+    assert len(hyd) == 1
+    assert hyd[0]["status"] == "hydrated"
+    assert hyd[0]["schema_version"] == 2
+
+    # a server pointed at garbage still comes up — silent fallback
+    monkeypatch.setenv("WAM_TPU_AOT_CACHE", str(tmp_path / "cold2-aot"))
+    fb = []
+    server = AttributionServer(
+        wam.serve_entry(on_trace=lambda: fb.append(1), aot_key="reg-serve"),
+        [(1, 16, 16)], max_batch=2, registry=str(tmp_path / "not-a-bundle"),
+    )
+    server.close()
+    assert server.registry_report.status == "no_manifest"
+    assert fb == [1]  # compiled, exactly as if no bundle had been offered
+
+
+def test_fleet_restart_rehydrates_from_bundle(tmp_path, monkeypatch):
+    """Supervised-restart wiring: a fleet started with ``registry=`` warms
+    from the bundle at zero traces, and when a replica dies AND the local
+    AOT cache has been wiped underneath it, `_rebuild_replica`'s
+    re-hydration re-seeds the cache so the restarted replica STILL rejoins
+    at zero post-warm compiles — all under `assert_no_retrace`."""
+    need_devices(2)
+    from wam_tpu.serve import FleetServer, SupervisorConfig, jit_entry
+
+    obs.configure(enabled=True)
+    obs.reset()
+    aot_dir = tmp_path / "aot"
+    monkeypatch.setenv("WAM_TPU_AOT_CACHE", str(aot_dir))
+    monkeypatch.setenv("WAM_TPU_SCHEDULE_CACHE", str(tmp_path / "s.json"))
+
+    kills = {rid: threading.Event() for rid in range(2)}
+
+    def factory(rid, m):
+        # deliberately NO process-level jit cache: every (re)build makes a
+        # fresh entry, so a warm rejoin can only come from the AOT cache —
+        # which, after the rmtree below, only the bundle can refill
+        inner = jit_entry(lambda xs, ys: xs * 2.0, on_trace=m.note_compile,
+                          aot_key="reg-fleet")
+
+        def entry(xs, ys):
+            if kills[rid].is_set():
+                kills[rid].clear()  # one death per arm
+                raise RuntimeError(f"injected chip loss on {rid}")
+            return inner(xs, ys)
+
+        return entry
+
+    seed = FleetServer(factory, [(4,)], replicas=2, max_batch=1,
+                       max_wait_ms=0.0, warmup=True, oversize="fanout")
+    seed.close()
+    bundle = str(tmp_path / "bundle")
+    publish_bundle(bundle, aot_dir=str(aot_dir), include_xla=False,
+                   schedule_path=str(tmp_path / "s.json"))
+    shutil.rmtree(aot_dir)  # the fresh-host stand-in: cold local caches
+
+    sentinel.clear_events()
+    x = np.ones((4,), np.float32)
+    with sentinel.assert_no_retrace():
+        fleet = FleetServer(
+            factory, [(4,)], replicas=2, max_batch=1, max_wait_ms=0.0,
+            warmup=True, oversize="fanout", registry=bundle,
+            supervise=SupervisorConfig(max_restarts=8, window_s=60.0,
+                                       backoff_base_s=0.001,
+                                       jitter_frac=0.0, seed=0),
+        )
+        try:
+            first_report = fleet.registry_report
+            assert first_report.status == "hydrated"
+            assert fleet.describe()["registry"] == bundle
+            # wipe the hydrated cache: the upcoming rebuild must re-hydrate
+            # from the bundle, not find the files the start() hydrate left
+            shutil.rmtree(aot_dir)
+            kills[0].set()
+            deadline = time.monotonic() + 30
+            while kills[0].is_set():
+                futs = [fleet.submit(x, i % 2) for i in range(4)]
+                for f in futs:
+                    np.testing.assert_array_equal(f.result(timeout=10),
+                                                  x * 2.0)
+                assert time.monotonic() < deadline, "kill never reached r0"
+            while fleet.registry_report is first_report:
+                assert time.monotonic() < deadline, "rebuild never rehydrated"
+                time.sleep(0.01)
+            for f in [fleet.submit(x, i % 2) for i in range(4)]:
+                np.testing.assert_array_equal(f.result(timeout=10), x * 2.0)
+        finally:
+            fleet.close()
+    assert fleet.registry_report.count("aot", "hydrated") >= 1
+    events = [e["aot_event"] for e in sentinel.aot_events()]
+    assert "registry_hit" in events
+    assert "miss" not in events and "export" not in events

@@ -212,6 +212,8 @@ class ServeConfig:
     restart_backoff_ms: float = 50.0  # base restart backoff (exp, jittered)
     retry_attempts: int = 4  # client-side submit attempts (bench_serve)
     retry_budget_s: float = 30.0  # total per-request retry budget; 0 = none
+    # -- cold start (wam_tpu.registry) --------------------------------------
+    registry: str = ""  # compile-artifact bundle to hydrate before warmup
 
     def bucket_shapes(self) -> list[tuple[int, ...]]:
         if not self.buckets:

@@ -33,12 +33,14 @@ from collections import deque
 from wam_tpu.obs.registry import registry
 
 __all__ = ["RetraceError", "label", "record_trace", "record_aot",
-           "trace_count", "aot_event_count", "compile_events",
+           "trace_count", "aot_event_count", "compile_events", "aot_events",
            "assert_no_retrace", "clear_events"]
 
 _lock = threading.Lock()
 _events: deque = deque(maxlen=1024)
+_aot_log: deque = deque(maxlen=1024)
 _trace_count = 0
+_aot_seq = 0
 _aot_counts: dict[str, int] = {}
 _tls = threading.local()
 
@@ -133,11 +135,32 @@ def record_trace(entry_kind: str, detail: str = "", **labels) -> dict:
     return event
 
 
-def record_aot(event: str, key: str = "") -> None:
-    """Record an AOT executable cache event: "hit", "miss", or "export"."""
+def record_aot(event: str, key: str = "") -> dict:
+    """Record an AOT executable cache event: "hit", "miss", "export", or —
+    with the compile-artifact registry — "registry_hit" (an executable
+    seeded from a bundle skipped this compile) / "registry_miss" (a bundle
+    artifact failed verification and could not be seeded). Each event also
+    lands as a structured row (ambient `label(...)` attribution, own seq
+    stream — AOT events never trip `assert_no_retrace`) so the serve
+    ledgers can attribute every consult to its origin."""
+    global _aot_seq
+    merged = _current_labels()
+    row = {
+        "event": "aot_event",
+        "aot_event": event,
+        "key": key,
+        "bucket": merged.get("bucket"),
+        "replica": merged.get("replica"),
+        "phase": merged.get("phase"),
+        "t": time.time(),
+    }
     with _lock:
         _aot_counts[event] = _aot_counts.get(event, 0) + 1
+        _aot_seq += 1
+        row["seq"] = _aot_seq
+        _aot_log.append(row)
     _aot_events.inc(event=event)
+    return row
 
 
 def trace_count() -> int:
@@ -157,6 +180,14 @@ def compile_events(since_seq: int = 0) -> list[dict]:
     the event ring — 1024 events dwarfs any real compile volume)."""
     with _lock:
         return [dict(e) for e in _events if e["seq"] > since_seq]
+
+
+def aot_events(since_seq: int = 0) -> list[dict]:
+    """Structured aot_event rows (hit / miss / export / registry_hit /
+    registry_miss) with ``seq > since_seq`` — a separate seq stream from
+    `compile_events` so consuming one does not skip the other."""
+    with _lock:
+        return [dict(e) for e in _aot_log if e["seq"] > since_seq]
 
 
 class assert_no_retrace:
@@ -183,8 +214,10 @@ class assert_no_retrace:
 def clear_events() -> None:
     """Forget all compile/AOT events and zero the trace count (the
     registry counters are reset separately via `registry.reset()`)."""
-    global _trace_count
+    global _trace_count, _aot_seq
     with _lock:
         _events.clear()
+        _aot_log.clear()
         _trace_count = 0
+        _aot_seq = 0
         _aot_counts.clear()

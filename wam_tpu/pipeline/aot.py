@@ -76,6 +76,10 @@ __all__ = [
     "aot_entry_path",
     "save_aot",
     "load_aot",
+    "load_aot_meta",
+    "list_aot_entries",
+    "read_aot_payload",
+    "seed_aot_payload",
     "aval_signature",
     "cached_jit",
     "cached_entry",
@@ -101,18 +105,14 @@ def aot_entry_path(key: str, cache_dir: str | None = None) -> str:
     return os.path.join(cache_dir or default_aot_dir(), f"{digest}.aot")
 
 
-def save_aot(key: str, exported, cache_dir: str | None = None) -> str | None:
-    """Serialize an `jax.export.Exported` under ``key``. Atomic (tmp +
-    rename); returns the path, or None when serialization fails (some
-    programs — custom calls, shard_map on older jax — do not export)."""
-    try:
-        payload = bytes(exported.serialize())
-    except Exception as e:
-        _warn_once(key, f"serialize failed: {e}")
-        return None
-    header = json.dumps(
-        {"version": AOT_CACHE_VERSION, "key": key, "jax": jax.__version__}
-    ).encode()
+def _write_entry(key: str, payload: bytes, cache_dir: str | None,
+                 origin: str, jax_version: str | None = None) -> str | None:
+    """Atomic header+payload write shared by `save_aot` (origin
+    "exported") and `seed_aot_payload` (origin "registry")."""
+    header = json.dumps({
+        "version": AOT_CACHE_VERSION, "key": key,
+        "jax": jax_version or jax.__version__, "origin": origin,
+    }).encode()
     path = aot_entry_path(key, cache_dir)
     os.makedirs(os.path.dirname(path), exist_ok=True)
     fd, tmp = tempfile.mkstemp(dir=os.path.dirname(path), suffix=".tmp")
@@ -129,28 +129,113 @@ def save_aot(key: str, exported, cache_dir: str | None = None) -> str | None:
     return path
 
 
-def load_aot(key: str, cache_dir: str | None = None):
-    """Deserialize the entry for ``key``, or None on miss. Version
-    mismatch, key (hash) collision, wrong platform, and corrupt payloads
-    are all treated as misses — never an error on the consult path."""
-    path = aot_entry_path(key, cache_dir)
+def save_aot(key: str, exported, cache_dir: str | None = None) -> str | None:
+    """Serialize an `jax.export.Exported` under ``key``. Atomic (tmp +
+    rename); returns the path, or None when serialization fails (some
+    programs — custom calls, shard_map on older jax — do not export)."""
+    try:
+        payload = bytes(exported.serialize())
+    except Exception as e:
+        _warn_once(key, f"serialize failed: {e}")
+        return None
+    return _write_entry(key, payload, cache_dir, origin="exported")
+
+
+def seed_aot_payload(key: str, payload: bytes, cache_dir: str | None = None,
+                     *, origin: str = "registry",
+                     jax_version: str | None = None) -> str | None:
+    """Install an already-serialized executable under ``key`` WITHOUT
+    deserializing it (the registry hydration path: the payload is the
+    publisher's `Exported.serialize()` bytes, digest-verified by the
+    caller). The header's ``origin`` marks where the entry came from so
+    later consults attribute their hit to the registry; ``jax_version``
+    records the PUBLISHER's jax (informational — the consult path's
+    platform check is what actually gates use)."""
+    return _write_entry(key, bytes(payload), cache_dir, origin=origin,
+                        jax_version=jax_version)
+
+
+def _read_entry(path: str, key: str | None = None):
+    """(header, payload) for one cache file, or (None, None) on any
+    corruption / version / key mismatch — the tolerant-read core of every
+    consult."""
     try:
         with open(path, "rb") as f:
             raw = f.read()
         header_line, _, payload = raw.partition(b"\n")
         header = json.loads(header_line)
-        if header.get("version") != AOT_CACHE_VERSION or header.get("key") != key:
-            return None
-        if jax_export is None:
-            return None
+    except (OSError, ValueError):
+        return None, None
+    if not isinstance(header, dict) or header.get("version") != AOT_CACHE_VERSION:
+        return None, None
+    if key is not None and header.get("key") != key:
+        return None, None
+    return header, payload
+
+
+def read_aot_payload(key: str, cache_dir: str | None = None):
+    """(serialized payload bytes, header dict) for ``key`` without
+    deserializing — the registry publish path reads executables this way
+    so a bundle stores pure `jax.export` serializations (the local JSON
+    header is a cache implementation detail, not part of the artifact).
+    (None, None) on miss/stale/corrupt."""
+    header, payload = _read_entry(aot_entry_path(key, cache_dir), key)
+    if header is None:
+        return None, None
+    return payload, header
+
+
+def list_aot_entries(cache_dir: str | None = None) -> list[dict]:
+    """Every valid current-version entry in the cache directory as
+    ``{"key", "path", "origin", "jax"}`` rows (header-only parse — cheap).
+    Stale/corrupt/torn files are silently skipped, mirroring the consult
+    path's miss semantics; a missing directory is an empty cache."""
+    root = cache_dir or default_aot_dir()
+    rows: list[dict] = []
+    try:
+        names = sorted(os.listdir(root))
+    except OSError:
+        return rows
+    for name in names:
+        if not name.endswith(".aot"):
+            continue
+        path = os.path.join(root, name)
+        header, _ = _read_entry(path)
+        if header is None or not isinstance(header.get("key"), str):
+            continue
+        rows.append({
+            "key": header["key"],
+            "path": path,
+            "origin": header.get("origin", "exported"),
+            "jax": header.get("jax"),
+        })
+    return rows
+
+
+def load_aot_meta(key: str, cache_dir: str | None = None):
+    """(exported, header) for ``key``, or (None, None) on miss. Version
+    mismatch, key (hash) collision, wrong platform, and corrupt payloads
+    are all treated as misses — never an error on the consult path. The
+    header carries ``origin`` ("exported" locally, "registry" when the
+    entry was hydrated from a bundle) so the compile sentinel can
+    attribute the hit."""
+    header, payload = _read_entry(aot_entry_path(key, cache_dir), key)
+    if header is None or jax_export is None:
+        return None, None
+    try:
         exported = jax_export.deserialize(bytearray(payload))
-    except FileNotFoundError:
-        return None
     except Exception:
-        return None
+        return None, None
     platforms = tuple(getattr(exported, "platforms", ()) or ())
     if platforms and jax.default_backend() not in platforms:
-        return None
+        return None, None
+    return exported, header
+
+
+def load_aot(key: str, cache_dir: str | None = None):
+    """Deserialize the entry for ``key``, or None on miss (see
+    `load_aot_meta` for the miss semantics)."""
+    exported, _ = load_aot_meta(key, cache_dir)
     return exported
 
 
@@ -217,7 +302,7 @@ def cached_jit(
     plain = jax.jit(probed, donate_argnums=donate_argnums)
     if _disabled():
         return plain
-    exported = load_aot(key, cache_dir)
+    exported, header = load_aot_meta(key, cache_dir)
     if exported is None:
         sentinel.record_aot("miss", key)
         specs = [_specs_like(a) for a in example_args]
@@ -230,6 +315,10 @@ def cached_jit(
             return plain
         if save_aot(key, exported, cache_dir) is not None:
             sentinel.record_aot("export", key)
+    elif header is not None and header.get("origin") == "registry":
+        # the executable was seeded by a registry bundle, not exported by
+        # an earlier local process — attribute the skipped compile to it
+        sentinel.record_aot("registry_hit", key)
     else:
         sentinel.record_aot("hit", key)
     call = exported.call

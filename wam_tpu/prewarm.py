@@ -50,6 +50,11 @@ def main(argv=None) -> int:
     p.add_argument("--no-aot", action="store_true",
                    help="skip the AOT executable cache (XLA + schedule "
                         "caches are still warmed)")
+    p.add_argument("--manifest", default=None, metavar="PATH",
+                   help="also write the JSON summary (including the "
+                        "machine-readable 'warmed' block) to this file — "
+                        "the handoff `python -m wam_tpu.registry publish "
+                        "--from-prewarm` consumes")
     args = p.parse_args(argv)
 
     from wam_tpu.config import (
@@ -104,7 +109,7 @@ def main(argv=None) -> int:
     # (process-stable closed-over params — the aot.py keying contract).
     from wam_tpu.pipeline import aot as aot_cache
 
-    runner, aot_status = fn, "disabled"
+    runner, aot_status, aot_key = fn, "disabled", None
     if not args.no_aot and not aot_cache._disabled():
         aot_key = "|".join((
             "prewarm",
@@ -127,7 +132,13 @@ def main(argv=None) -> int:
     device_sync(runner(*wargs))  # compile (or cache-deserialize) + one run
     warm_s = time.perf_counter() - t0
 
-    print(json.dumps({
+    # machine-readable manifest of exactly what this run warmed — the
+    # `registry publish --from-prewarm` handoff, so publish snapshots the
+    # keys this run touched instead of re-walking the cache blind
+    from wam_tpu.registry.bundle import platform_fingerprint
+    from wam_tpu.tune.cache import SCHEDULE_CACHE_VERSION
+
+    summary = {
         "config": wl.name,
         "backend": jax.default_backend(),
         "batch": wl.batch,
@@ -140,7 +151,19 @@ def main(argv=None) -> int:
         "aot": aot_status,
         "aot_cache_dir": aot_cache.default_aot_dir(),
         "warm_s": round(warm_s, 3),
-    }))
+        "warmed": {
+            "bucket_keys": [
+                schedule_key(wl.workload, wl.shape, wl.batch, wl.dtype)],
+            "aot_keys": [aot_key] if aot_key is not None else [],
+            "schedule_version": SCHEDULE_CACHE_VERSION,
+            "platform": platform_fingerprint(),
+        },
+    }
+    line = json.dumps(summary)
+    print(line)
+    if args.manifest:
+        with open(args.manifest, "w") as f:
+            f.write(line + "\n")
     return 0
 
 

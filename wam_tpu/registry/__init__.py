@@ -1,0 +1,43 @@
+"""Versioned compile-artifact registry — publish/hydrate bundles for
+zero-compile cold start.
+
+The cold-start stack, bottom to top: the XLA persistent compilation
+cache absorbs recompiles of identical modules; the AOT cache
+(`pipeline/aot.py`) skips the Python trace for keyed executables; the
+schedule cache (`tune/cache.py`) remembers the tuned knobs those
+executables were compiled under. All three are PER-MACHINE — a new host
+or a wiped cache pays 20–40 s per bucket graph again. This package makes
+the warm state portable: `publish_bundle` snapshots the three caches
+into one content-addressed, version-headed bundle directory, and
+`RegistryClient.hydrate` verifies and seeds them on any compatible host,
+so `FleetServer.start(registry=...)` serves its first request at
+``compile_count == 0``.
+
+CLI: ``python -m wam_tpu.registry {publish,inspect,hydrate}``.
+"""
+
+from wam_tpu.registry.bundle import (
+    REGISTRY_SCHEMA_VERSION,
+    load_manifest,
+    platform_fingerprint,
+    publish_bundle,
+)
+from wam_tpu.registry.client import (
+    HydrationReport,
+    RegistryClient,
+    local_fetcher,
+    registry_disabled,
+    resolve_client,
+)
+
+__all__ = [
+    "REGISTRY_SCHEMA_VERSION",
+    "platform_fingerprint",
+    "publish_bundle",
+    "load_manifest",
+    "HydrationReport",
+    "RegistryClient",
+    "local_fetcher",
+    "registry_disabled",
+    "resolve_client",
+]

@@ -1,0 +1,148 @@
+"""CLI for the compile-artifact registry.
+
+    # snapshot a prewarmed machine's caches into a bundle
+    python -m wam_tpu.prewarm --workloads wam2d_s --manifest warm.json
+    python -m wam_tpu.registry publish --out bundle/ --from-prewarm warm.json
+
+    # what's in it / would it hydrate here?
+    python -m wam_tpu.registry inspect bundle/
+
+    # seed this machine's caches (servers do this via registry=)
+    python -m wam_tpu.registry hydrate bundle/
+
+Each subcommand prints ONE JSON document to stdout, the repo's
+script-output convention. `inspect` exits 1 when zero artifacts are
+hydratable (the CI smoke gate); `publish` exits 1 when the bundle came
+out empty.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def _add_cache_flags(p: argparse.ArgumentParser) -> None:
+    p.add_argument("--aot-dir", default=None,
+                   help="AOT cache dir (default: $WAM_TPU_AOT_CACHE or "
+                        "~/.cache/wam_tpu/aot)")
+    p.add_argument("--schedule-cache", default=None,
+                   help="user schedule cache path (default: "
+                        "$WAM_TPU_SCHEDULE_CACHE or "
+                        "~/.cache/wam_tpu/schedules.json)")
+    p.add_argument("--xla-dir", default=None,
+                   help="persistent XLA compilation cache dir (default: "
+                        "$WAM_TPU_CACHE_DIR or ~/.cache/wam_tpu/xla)")
+
+
+def _prewarm_keys(paths: list[str]) -> tuple[list[str] | None, list[dict]]:
+    """AOT keys + source descriptors from prewarm --manifest JSON files.
+    A manifest without a ``warmed`` block contributes nothing (old
+    prewarm output) — publish then falls back to walking the whole cache."""
+    keys: list[str] = []
+    sources: list[dict] = []
+    saw_warmed = False
+    for path in paths:
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except (OSError, ValueError) as e:
+            print(f"warning: unreadable prewarm manifest {path}: {e}",
+                  file=sys.stderr)
+            continue
+        warmed = doc.get("warmed") if isinstance(doc, dict) else None
+        if not isinstance(warmed, dict):
+            continue
+        saw_warmed = True
+        keys.extend(k for k in warmed.get("aot_keys", ()) if isinstance(k, str))
+        sources.append({
+            "prewarm_manifest": path,
+            "bucket_keys": warmed.get("bucket_keys"),
+            "schedule_version": warmed.get("schedule_version"),
+        })
+    return (sorted(set(keys)) if saw_warmed else None), sources
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="python -m wam_tpu.registry",
+                                 description=__doc__.splitlines()[0])
+    ap.add_argument("--device", default=None, choices=["tpu", "axon", "cpu"],
+                    help="pin the JAX platform before any backend use "
+                         "(the platform fingerprint records the backend)")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    pub = sub.add_parser("publish", help="snapshot local caches → bundle")
+    pub.add_argument("--out", required=True, help="bundle output directory")
+    _add_cache_flags(pub)
+    pub.add_argument("--no-xla", action="store_true",
+                     help="skip the XLA compilation-cache files")
+    pub.add_argument("--no-schedules", action="store_true",
+                     help="skip the tuned-schedule snapshot")
+    pub.add_argument("--from-prewarm", nargs="+", default=None,
+                     metavar="JSON",
+                     help="prewarm --manifest files: publish exactly the "
+                          "AOT keys they warmed instead of walking blind")
+
+    ins = sub.add_parser("inspect",
+                         help="per-artifact hydratability breakdown "
+                              "(exit 1 when nothing is hydratable)")
+    ins.add_argument("bundle")
+    _add_cache_flags(ins)
+
+    hyd = sub.add_parser("hydrate", help="seed local caches from a bundle")
+    hyd.add_argument("bundle")
+    _add_cache_flags(hyd)
+
+    args = ap.parse_args(argv)
+
+    from wam_tpu.config import select_backend
+
+    select_backend(args.device)
+
+    if args.cmd == "publish":
+        from wam_tpu.registry.bundle import publish_bundle
+
+        keys, sources = (None, [])
+        if args.from_prewarm:
+            keys, sources = _prewarm_keys(args.from_prewarm)
+        manifest = publish_bundle(
+            args.out,
+            aot_dir=args.aot_dir,
+            schedule_path=args.schedule_cache,
+            xla_dir=args.xla_dir,
+            keys=keys,
+            include_xla=not args.no_xla,
+            include_schedules=not args.no_schedules,
+            source={"prewarm": sources} if sources else None,
+        )
+        arts = manifest["artifacts"]
+        out = {
+            "bundle": args.out,
+            "artifacts": len(arts),
+            "aot": sum(1 for a in arts if a["kind"] == "aot"),
+            "xla": sum(1 for a in arts if a["kind"] == "xla"),
+            "schedules": len((manifest.get("schedules") or {})
+                             .get("schedules") or {}),
+            "platform": manifest["platform"],
+        }
+        print(json.dumps(out, indent=1))
+        return 0 if arts else 1
+
+    from wam_tpu.registry.client import RegistryClient
+
+    client = RegistryClient(args.bundle)
+    if args.cmd == "inspect":
+        report = client.probe(aot_dir=args.aot_dir, xla_dir=args.xla_dir)
+        print(json.dumps(report, indent=1))
+        return 0 if report["hydratable"] > 0 else 1
+
+    report = client.hydrate(aot_dir=args.aot_dir,
+                            schedule_path=args.schedule_cache,
+                            xla_dir=args.xla_dir)
+    print(json.dumps(report.row(), indent=1))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

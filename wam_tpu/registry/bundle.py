@@ -1,0 +1,242 @@
+"""Bundle format + publish side of the compile-artifact registry.
+
+A **bundle** is one directory (local path today; `client.RegistryClient`
+takes a fetcher callable so a remote store slots in without touching this
+format):
+
+    bundle/
+      manifest.json            # everything below, written atomically
+      artifacts/<sha256-32>.bin  # content-addressed artifact payloads
+
+The manifest is version-headed (`REGISTRY_SCHEMA_VERSION` — a reader that
+does not speak the schema ignores the bundle WHOLESALE, the
+`tune/cache.py` stale-file rule) and carries:
+
+- a **platform fingerprint**: backend + jax version + the AOT/schedule
+  cache schema versions the artifacts were produced under. A backend or
+  cache-version mismatch makes the whole bundle a silent miss on hydrate
+  (an exported executable bakes its lowering platforms in; seeding a TPU
+  export into a CPU host's cache would just miss again at consult time,
+  so the gate saves the copies, not correctness).
+- **aot artifacts**: the `jax.export`-serialized executables from the
+  local AOT cache (`pipeline/aot.py`), stored WITHOUT their local JSON
+  header — a bundle artifact is the pure serialization, digested as such;
+  hydration re-heads it with ``origin: "registry"`` so later consults
+  attribute their skipped compile to the bundle.
+- **xla artifacts**: the persistent XLA compilation-cache files
+  (`config.enable_compilation_cache`). The AOT layer removes the Python
+  trace; the deserialized module still XLA-compiles once per process
+  unless this cache is warm too — shipping both is what makes cold start
+  actually zero-compile, not just zero-retrace.
+- a **tuned-schedule snapshot**: the MERGED schedule table (repo-pinned
+  `tune/default_schedules.json` layer + user cache) with its own schema
+  version, so a hydrated host resolves the same chunk/stream/synth knobs
+  the publisher compiled under — an AOT key embeds the schedule, so a
+  missing schedule entry would change the key and miss the executable.
+- per-artifact **sha256 digests** — hydration verifies every payload
+  before seeding; a flipped bit is one artifact's miss, never an error.
+
+Publish walks a prewarmed cache (`python -m wam_tpu.prewarm` or an
+AOT-keyed serve warmup), optionally filtered to the keys a prewarm
+manifest says it warmed. All IO is tolerant on the read side and atomic
+on the write side, mirroring the caches it snapshots.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import tempfile
+import time
+
+__all__ = [
+    "REGISTRY_SCHEMA_VERSION",
+    "platform_fingerprint",
+    "fingerprint_mismatch",
+    "default_xla_dir",
+    "publish_bundle",
+    "load_manifest",
+    "write_manifest",
+]
+
+REGISTRY_SCHEMA_VERSION = 1
+
+# manifest-relative directory for content-addressed payloads
+_ARTIFACT_DIR = "artifacts"
+
+
+def platform_fingerprint() -> dict:
+    """What the artifacts in a bundle were produced under. ``backend`` and
+    the two cache schema versions are the hydrate gates; ``jax`` is
+    recorded for diagnostics only (a cross-version deserialize that fails
+    is already a per-artifact miss on the consult path)."""
+    import jax
+
+    from wam_tpu.pipeline.aot import AOT_CACHE_VERSION
+    from wam_tpu.tune.cache import SCHEDULE_CACHE_VERSION
+
+    return {
+        "backend": jax.default_backend(),
+        "jax": jax.__version__,
+        "aot_cache_version": AOT_CACHE_VERSION,
+        "schedule_cache_version": SCHEDULE_CACHE_VERSION,
+    }
+
+
+def fingerprint_mismatch(platform: dict) -> str | None:
+    """Why a manifest's platform fingerprint cannot hydrate HERE:
+    "platform" (backend differs) or "version" (AOT cache schema differs),
+    None when compatible. The schedule version gates only the schedule
+    snapshot (`client`), not the executables."""
+    import jax
+
+    from wam_tpu.pipeline.aot import AOT_CACHE_VERSION
+
+    if not isinstance(platform, dict):
+        return "version"
+    if platform.get("aot_cache_version") != AOT_CACHE_VERSION:
+        return "version"
+    if platform.get("backend") != jax.default_backend():
+        return "platform"
+    return None
+
+
+def default_xla_dir() -> str:
+    """The persistent XLA compilation cache directory
+    (`config.enable_compilation_cache`'s default resolution)."""
+    return os.environ.get(
+        "WAM_TPU_CACHE_DIR", os.path.expanduser("~/.cache/wam_tpu/xla")
+    )
+
+
+def _sha256(data: bytes) -> str:
+    return hashlib.sha256(data).hexdigest()
+
+
+def _store_payload(out_dir: str, payload: bytes) -> tuple[str, str]:
+    """Write one content-addressed payload (atomic, dedup by digest);
+    returns (manifest-relative file, sha256)."""
+    digest = _sha256(payload)
+    rel = f"{_ARTIFACT_DIR}/{digest[:32]}.bin"
+    path = os.path.join(out_dir, rel)
+    if not os.path.exists(path):
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        fd, tmp = tempfile.mkstemp(dir=os.path.dirname(path), suffix=".tmp")
+        with os.fdopen(fd, "wb") as f:
+            f.write(payload)
+        os.replace(tmp, path)
+    return rel, digest
+
+
+def write_manifest(out_dir: str, manifest: dict) -> str:
+    """Atomic manifest write (tmp + rename) — a torn publish leaves either
+    the previous manifest or none, never half a JSON document."""
+    os.makedirs(out_dir, exist_ok=True)
+    path = os.path.join(out_dir, "manifest.json")
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(manifest, f, indent=1, sort_keys=True)
+    os.replace(tmp, path)
+    return path
+
+
+def load_manifest(bundle: str, fetcher=None) -> dict | None:
+    """Tolerant manifest read: None on a missing, torn, or non-JSON
+    manifest (the hydrate side treats that as an empty bundle, mirroring
+    the AOT cache's corrupt-file miss). ``fetcher(relpath) -> bytes`` maps
+    bundle-relative names to content; default is the local directory."""
+    if fetcher is None:
+        from wam_tpu.registry.client import local_fetcher
+
+        fetcher = local_fetcher(bundle)
+    try:
+        data = json.loads(fetcher("manifest.json").decode("utf-8"))
+    except Exception:
+        return None
+    return data if isinstance(data, dict) else None
+
+
+def _xla_files(xla_dir: str) -> list[tuple[str, str]]:
+    """(relative key, absolute path) for every file in the XLA cache dir
+    (recursive — the cache may shard into subdirectories)."""
+    out: list[tuple[str, str]] = []
+    for dirpath, _, names in os.walk(xla_dir):
+        for name in sorted(names):
+            path = os.path.join(dirpath, name)
+            out.append((os.path.relpath(path, xla_dir), path))
+    return sorted(out)
+
+
+def publish_bundle(
+    out_dir: str,
+    *,
+    aot_dir: str | None = None,
+    schedule_path: str | None = None,
+    xla_dir: str | None = None,
+    keys=None,
+    include_xla: bool = True,
+    include_schedules: bool = True,
+    source: dict | None = None,
+) -> dict:
+    """Walk the local caches and emit a bundle directory; returns the
+    manifest. ``keys`` filters the AOT walk to an explicit key set (the
+    prewarm-manifest handoff — `python -m wam_tpu.prewarm --manifest`);
+    None publishes every valid entry. Stale/corrupt local cache files are
+    skipped silently — publish never fails on what the consult path would
+    have ignored anyway."""
+    from wam_tpu.pipeline.aot import list_aot_entries, read_aot_payload
+    from wam_tpu.tune.cache import SCHEDULE_CACHE_VERSION, ScheduleCache
+
+    keyset = set(keys) if keys is not None else None
+    artifacts: list[dict] = []
+    for entry in list_aot_entries(aot_dir):
+        if keyset is not None and entry["key"] not in keyset:
+            continue
+        payload, header = read_aot_payload(entry["key"], aot_dir)
+        if payload is None:
+            continue
+        rel, digest = _store_payload(out_dir, payload)
+        artifacts.append({
+            "kind": "aot",
+            "key": entry["key"],
+            "file": rel,
+            "sha256": digest,
+            "bytes": len(payload),
+            "jax": header.get("jax"),
+        })
+    if include_xla:
+        xla_root = xla_dir or default_xla_dir()
+        if os.path.isdir(xla_root):
+            for rel_key, path in _xla_files(xla_root):
+                try:
+                    with open(path, "rb") as f:
+                        payload = f.read()
+                except OSError:
+                    continue
+                rel, digest = _store_payload(out_dir, payload)
+                artifacts.append({
+                    "kind": "xla",
+                    "key": rel_key,
+                    "file": rel,
+                    "sha256": digest,
+                    "bytes": len(payload),
+                })
+    schedules = None
+    if include_schedules:
+        cache = ScheduleCache(path=schedule_path)
+        schedules = {
+            "version": SCHEDULE_CACHE_VERSION,
+            "schedules": dict(cache.entries),
+        }
+    manifest = {
+        "registry_schema_version": REGISTRY_SCHEMA_VERSION,
+        "created_unix": time.time(),
+        "platform": platform_fingerprint(),
+        "artifacts": artifacts,
+        "schedules": schedules,
+    }
+    if source:
+        manifest["source"] = source
+    write_manifest(out_dir, manifest)
+    return manifest

@@ -1,0 +1,360 @@
+"""Hydrate side of the compile-artifact registry.
+
+`RegistryClient` turns a published bundle (`bundle.publish_bundle`) into
+warm local caches: verified `jax.export` payloads seeded into the AOT
+cache (`pipeline.aot.seed_aot_payload`, header origin "registry" so later
+consults attribute the skipped compile), XLA compilation-cache files
+copied in by name, and the tuned-schedule snapshot merged under local
+entries. The serve stack calls `hydrate()` before any compile fallback —
+`AttributionServer.start()`, `FleetServer.start(registry=)`, and
+`ReplicaSupervisor` restarts via `_rebuild_replica` — so a fresh process
+with a cold ``~/.cache/wam_tpu`` serves its first request at
+``compile_count == 0``.
+
+Miss semantics mirror the caches this layer feeds (the rule the whole
+persistence stack shares): **any mismatch is a silent per-artifact miss,
+never an error**. A torn manifest is an empty bundle; a stale registry
+schema or foreign platform fingerprint skips the bundle wholesale; a
+digest mismatch skips that one artifact (and records a ``registry_miss``
+AOT event); whatever could not hydrate simply compiles, exactly as if no
+bundle had been offered. ``WAM_TPU_NO_REGISTRY=1`` is the kill switch —
+no bundle IO at all.
+
+Bundles are fetched through a ``fetcher(relpath) -> bytes`` callable
+(default: the local bundle directory), the seam where remote backends
+(GCS, HTTP) slot in without touching hydrate logic or bundle format.
+
+Every hydration emits a `HydrationReport` — one v2 ledger row
+(``metric: "registry_hydration"``) written by the serve close path, plus
+`wam_tpu_registry_*` counters on the obs registry.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import tempfile
+import time
+
+from wam_tpu.obs.registry import registry as _obs_registry
+from wam_tpu.registry.bundle import (
+    REGISTRY_SCHEMA_VERSION,
+    default_xla_dir,
+    fingerprint_mismatch,
+    load_manifest,
+)
+
+__all__ = [
+    "registry_disabled",
+    "local_fetcher",
+    "HydrationReport",
+    "RegistryClient",
+    "resolve_client",
+]
+
+_hydrations = _obs_registry.counter(
+    "wam_tpu_registry_hydrations_total",
+    "registry bundle hydration attempts by terminal status",
+    labels=("status",))
+_artifacts = _obs_registry.counter(
+    "wam_tpu_registry_artifacts_total",
+    "per-artifact hydration outcomes", labels=("kind", "outcome"))
+_schedules = _obs_registry.counter(
+    "wam_tpu_registry_schedules_total",
+    "schedule-snapshot merge outcomes", labels=("outcome",))
+
+# wholesale statuses: nothing in the bundle is touched
+_WHOLESALE = ("disabled", "no_manifest", "stale_schema",
+              "version_mismatch", "platform_mismatch")
+
+
+def registry_disabled() -> bool:
+    """`WAM_TPU_NO_REGISTRY=1` — the registry analogue of
+    `WAM_TPU_NO_AOT_CACHE`: hydrate becomes a no-op reporting status
+    "disabled", with zero bundle IO."""
+    return os.environ.get("WAM_TPU_NO_REGISTRY", "") not in ("", "0")
+
+
+def local_fetcher(bundle_dir: str):
+    """``fetcher(relpath) -> bytes`` over a local bundle directory. Raises
+    OSError on a missing file — the caller's tolerant-read wrappers turn
+    that into the appropriate miss."""
+
+    def fetch(relpath: str) -> bytes:
+        with open(os.path.join(bundle_dir, relpath), "rb") as f:
+            return f.read()
+
+    return fetch
+
+
+class HydrationReport:
+    """What one `RegistryClient.hydrate` did: terminal ``status`` (one of
+    the wholesale statuses above, or "hydrated"/"empty" when the bundle
+    was actually walked), per-(kind, outcome) artifact ``counts``, and the
+    number of schedule entries merged. `row()` is the v2 serve-ledger
+    form."""
+
+    def __init__(self, bundle: str, status: str,
+                 counts: dict | None = None, schedules_added: int = 0,
+                 schedules_status: str = "none", duration_s: float = 0.0):
+        self.bundle = bundle
+        self.status = status
+        self.counts = dict(counts or {})
+        self.schedules_added = schedules_added
+        self.schedules_status = schedules_status
+        self.duration_s = duration_s
+
+    def count(self, kind: str, outcome: str) -> int:
+        return self.counts.get(f"{kind}:{outcome}", 0)
+
+    @property
+    def hydrated(self) -> int:
+        return sum(n for k, n in self.counts.items()
+                   if k.endswith(":hydrated"))
+
+    def row(self) -> dict:
+        from wam_tpu.serve.metrics import SCHEMA_VERSION
+
+        return {
+            "metric": "registry_hydration",
+            "schema_version": SCHEMA_VERSION,
+            "bundle": self.bundle,
+            "status": self.status,
+            "artifacts": dict(self.counts),
+            "hydrated": self.hydrated,
+            "schedules_added": self.schedules_added,
+            "schedules_status": self.schedules_status,
+            "duration_s": self.duration_s,
+            "t": time.time(),
+        }
+
+    def __repr__(self):
+        return (f"HydrationReport(bundle={self.bundle!r}, "
+                f"status={self.status!r}, hydrated={self.hydrated}, "
+                f"schedules_added={self.schedules_added})")
+
+
+class RegistryClient:
+    """Probe / hydrate one bundle. ``bundle`` is a local directory path
+    today; pass ``fetcher`` to read the same layout from anywhere."""
+
+    def __init__(self, bundle: str, fetcher=None):
+        self.bundle = str(bundle)
+        self.fetcher = fetcher or local_fetcher(self.bundle)
+        self._manifest: dict | None = None
+        self._loaded = False
+
+    def manifest(self) -> dict | None:
+        """Cached tolerant manifest read — None on missing/torn/non-JSON."""
+        if not self._loaded:
+            self._manifest = load_manifest(self.bundle, self.fetcher)
+            self._loaded = True
+        return self._manifest
+
+    # -- classification ---------------------------------------------------
+
+    def _wholesale_status(self, manifest) -> str | None:
+        """The reason the WHOLE bundle cannot hydrate here, or None."""
+        if manifest is None:
+            return "no_manifest"
+        if manifest.get("registry_schema_version") != REGISTRY_SCHEMA_VERSION:
+            return "stale_schema"
+        cause = fingerprint_mismatch(manifest.get("platform"))
+        if cause == "version":
+            return "version_mismatch"
+        if cause == "platform":
+            return "platform_mismatch"
+        return None
+
+    def _fetch_verified(self, art: dict):
+        """(payload, outcome): payload bytes when the artifact fetched and
+        digest-verified, else (None, "fetch_error"|"digest_mismatch")."""
+        try:
+            payload = self.fetcher(art["file"])
+        except Exception:
+            return None, "fetch_error"
+        if hashlib.sha256(payload).hexdigest() != art.get("sha256"):
+            return None, "digest_mismatch"
+        return payload, "ok"
+
+    def probe(self, aot_dir: str | None = None,
+              xla_dir: str | None = None) -> dict:
+        """Non-writing per-artifact breakdown (the
+        `scripts/compile_cache_probe.py` surface). Unlike `hydrate`, the
+        kill switch does NOT silence this — a diagnostic that refuses to
+        diagnose is useless. Each artifact row gains an ``outcome``:
+        "ok" (would hydrate), "present" (already local),
+        "digest_mismatch" / "fetch_error", or the wholesale cause
+        ("stale_schema" / "version_mismatch" / "platform_mismatch")
+        stamped on every row so per-artifact reports stay honest about
+        why nothing is hydratable."""
+        manifest = self.manifest()
+        wholesale = self._wholesale_status(manifest)
+        arts = (manifest or {}).get("artifacts") or []
+        rows = []
+        hydratable = 0
+        for art in arts:
+            if not isinstance(art, dict):
+                continue
+            row = {k: art.get(k) for k in
+                   ("kind", "key", "file", "sha256", "bytes")}
+            if wholesale:
+                row["outcome"] = wholesale
+            else:
+                payload, outcome = self._fetch_verified(art)
+                if payload is None:
+                    row["outcome"] = outcome
+                elif self._locally_present(art, aot_dir, xla_dir):
+                    row["outcome"] = "present"
+                    hydratable += 1  # present counts: the cache IS warm
+                else:
+                    row["outcome"] = "ok"
+                    hydratable += 1
+            rows.append(row)
+        sched = (manifest or {}).get("schedules") if not wholesale else None
+        return {
+            "bundle": self.bundle,
+            "status": wholesale or "ok",
+            "artifacts": rows,
+            "hydratable": hydratable,
+            "schedules": len((sched or {}).get("schedules") or {}),
+        }
+
+    def _locally_present(self, art: dict, aot_dir, xla_dir) -> bool:
+        """Is this artifact already a VALID local cache entry? (A corrupt
+        local file is not present — hydrate overwrites it.)"""
+        from wam_tpu.pipeline.aot import read_aot_payload
+
+        if art.get("kind") == "aot":
+            payload, _ = read_aot_payload(str(art.get("key")), aot_dir)
+            return payload is not None
+        if art.get("kind") == "xla":
+            path = os.path.join(xla_dir or default_xla_dir(),
+                                str(art.get("key")))
+            return os.path.isfile(path)
+        return False
+
+    # -- hydrate ----------------------------------------------------------
+
+    def hydrate(self, aot_dir: str | None = None,
+                schedule_path: str | None = None,
+                xla_dir: str | None = None) -> HydrationReport:
+        """Seed the local caches from the bundle. Never raises for bundle
+        problems; the report says what happened and the process falls back
+        to compiling whatever did not hydrate."""
+        t0 = time.time()
+        if registry_disabled():
+            return self._finish(HydrationReport(self.bundle, "disabled"), t0)
+        manifest = self.manifest()
+        wholesale = self._wholesale_status(manifest)
+        if wholesale:
+            return self._finish(HydrationReport(self.bundle, wholesale), t0)
+
+        from wam_tpu.obs import sentinel
+        from wam_tpu.pipeline.aot import seed_aot_payload
+
+        counts: dict[str, int] = {}
+
+        def bump(kind: str, outcome: str):
+            counts[f"{kind}:{outcome}"] = counts.get(f"{kind}:{outcome}", 0) + 1
+            _artifacts.inc(kind=kind, outcome=outcome)
+
+        pub_jax = (manifest.get("platform") or {}).get("jax")
+        for art in manifest.get("artifacts") or []:
+            if not isinstance(art, dict):
+                continue
+            kind = art.get("kind")
+            if kind not in ("aot", "xla"):
+                bump(str(kind), "unknown_kind")
+                continue
+            if self._locally_present(art, aot_dir, xla_dir):
+                bump(kind, "present")  # local cache wins — hydrate is idempotent
+                continue
+            payload, outcome = self._fetch_verified(art)
+            if payload is None:
+                bump(kind, outcome)
+                if kind == "aot":
+                    sentinel.record_aot("registry_miss", str(art.get("key")))
+                continue
+            if kind == "aot":
+                path = seed_aot_payload(str(art.get("key")), payload, aot_dir,
+                                        jax_version=pub_jax)
+                bump(kind, "hydrated" if path else "write_error")
+            else:
+                ok = self._write_xla(str(art.get("key")), payload, xla_dir)
+                bump(kind, "hydrated" if ok else "write_error")
+
+        added, sched_status = self._merge_schedules(
+            manifest.get("schedules"), schedule_path)
+        status = "hydrated" if (counts or added) else "empty"
+        report = HydrationReport(self.bundle, status, counts,
+                                 schedules_added=added,
+                                 schedules_status=sched_status)
+        return self._finish(report, t0)
+
+    def _write_xla(self, rel_key: str, payload: bytes, xla_dir) -> bool:
+        root = xla_dir or default_xla_dir()
+        # bundle keys are publisher-relative paths; refuse escapes
+        path = os.path.normpath(os.path.join(root, rel_key))
+        if not path.startswith(os.path.normpath(root) + os.sep):
+            return False
+        try:
+            os.makedirs(os.path.dirname(path), exist_ok=True)
+            fd, tmp = tempfile.mkstemp(dir=os.path.dirname(path),
+                                       suffix=".tmp")
+            with os.fdopen(fd, "wb") as f:
+                f.write(payload)
+            os.replace(tmp, path)
+        except OSError:
+            return False
+        return True
+
+    def _merge_schedules(self, snapshot, schedule_path) -> tuple[int, str]:
+        """Merge the bundle's schedule snapshot UNDER local entries (local
+        wins — a locally-tuned schedule reflects this machine). Stale
+        snapshot version → ignored wholesale, the `tune/cache.py` rule."""
+        from wam_tpu.tune.cache import (
+            SCHEDULE_CACHE_VERSION,
+            ScheduleCache,
+            invalidate_process_cache,
+        )
+
+        if not isinstance(snapshot, dict):
+            _schedules.inc(outcome="absent")
+            return 0, "absent"
+        if snapshot.get("version") != SCHEDULE_CACHE_VERSION:
+            _schedules.inc(outcome="stale")
+            return 0, "stale"
+        entries = snapshot.get("schedules")
+        if not isinstance(entries, dict) or not entries:
+            _schedules.inc(outcome="empty")
+            return 0, "empty"
+        cache = ScheduleCache(path=schedule_path)
+        added = 0
+        for key, ent in entries.items():
+            if not isinstance(ent, dict):
+                continue
+            if cache.get(key) is None:
+                cache.put(key, ent)
+                added += 1
+        if added:
+            cache.save()
+            invalidate_process_cache()
+            _schedules.inc(added, outcome="added")
+        _schedules.inc(outcome="merged")
+        return added, "merged"
+
+    def _finish(self, report: HydrationReport, t0: float) -> HydrationReport:
+        report.duration_s = time.time() - t0
+        _hydrations.inc(status=report.status)
+        return report
+
+
+def resolve_client(registry) -> "RegistryClient | None":
+    """Normalize the serve-stack ``registry=`` parameter: None/"" → None,
+    a path string → `RegistryClient(path)`, a client → itself."""
+    if registry is None or registry == "":
+        return None
+    if isinstance(registry, RegistryClient):
+        return registry
+    return RegistryClient(str(registry))

@@ -186,6 +186,14 @@ class FleetServer:
         None/False (default) keeps the historical permanent-on-first-death
         semantics. In-flight/queued work re-routes to survivors either way
         — supervision only changes whether the replica comes BACK.
+    registry : compile-artifact bundle (`wam_tpu.registry`): a bundle path
+        or `RegistryClient`, hydrated ONCE fleet-wide before the replicas
+        warm (the AOT/XLA/schedule caches are process-local, so one
+        hydration serves every replica) and AGAIN before each supervisor
+        rebuild (idempotent — already-present artifacts are skipped, but a
+        cache wiped under a running fleet re-seeds instead of recompiling).
+        Can also be passed to `start(registry=...)`. Same silent-miss
+        fallback as `AttributionServer`.
     """
 
     def __init__(
@@ -214,6 +222,7 @@ class FleetServer:
         slo=None,
         memory_budget=None,
         supervise=None,
+        registry=None,
     ):
         if not callable(entry_factory):
             raise TypeError("entry_factory must be callable(replica_id, metrics)")
@@ -236,6 +245,8 @@ class FleetServer:
         self._lock = threading.Lock()
         self._closed = False
         self._started = False
+        self._registry = registry
+        self.registry_report = None  # latest fleet-wide HydrationReport
 
         # everything _make_server needs to (re)build one replica server —
         # the restart path constructs from the same recipe as first start
@@ -305,12 +316,29 @@ class FleetServer:
             **self._server_kw,
         )
 
+    def _hydrate(self):
+        """Hydrate the configured registry bundle into the process-local
+        caches (no-op without one). Idempotent — already-present artifacts
+        are skipped — so the supervisor calls it before every rebuild:
+        normally free, but a cache wiped under a running fleet re-seeds
+        from the bundle instead of recompiling."""
+        if self._registry is None or self._registry == "":
+            return None
+        from wam_tpu.registry.client import resolve_client
+
+        client = resolve_client(self._registry)
+        if client is None:
+            return None
+        self.registry_report = client.hydrate()
+        return self.registry_report
+
     def _rebuild_replica(self, rid) -> None:
         """Supervisor restart procedure: close the dead server (drains any
         request that raced in — each fails with `ServerClosedError` and
-        re-routes), rebuild + warm a fresh one (`start()` re-runs the
-        parallel bucket warmup; the process-level jit/AOT caches make it a
-        rehydration, not a recompile), then swap it live under the fleet
+        re-routes), re-hydrate the registry bundle (when configured),
+        rebuild + warm a fresh one (`start()` re-runs the parallel bucket
+        warmup; the registry-seeded / process-level jit+AOT caches make it
+        a rehydration, not a recompile), then swap it live under the fleet
         lock."""
         replica = self._replicas[rid]
         try:
@@ -318,6 +346,7 @@ class FleetServer:
         except Exception:
             pass  # the old server may be arbitrarily broken; the fresh
             # one replaces it regardless
+        self._hydrate()
         server = self._make_server(rid, replica.metrics)
         server.start()
         with self._lock:
@@ -331,10 +360,15 @@ class FleetServer:
             server.close(emit_metrics=False)
             raise ServerClosedError("fleet closed during replica rebuild")
 
-    def start(self) -> "FleetServer":
-        """Start (and warm) every replica concurrently. Idempotent."""
+    def start(self, registry=None) -> "FleetServer":
+        """Start (and warm) every replica concurrently. Idempotent.
+        ``registry`` overrides the constructor's bundle for this start —
+        hydration runs ONCE here, before any replica's warmup compiles."""
         if self._started:
             return self
+        if registry is not None:
+            self._registry = registry
+        self._hydrate()
         live = [r for r in self._replicas if r.alive]
         if len(live) == 1:
             live[0].server.start()
@@ -360,8 +394,11 @@ class FleetServer:
         if emit_metrics and self.metrics_path:
             from wam_tpu.results import JsonlWriter
 
+            writer = JsonlWriter(self.metrics_path)
+            if self.registry_report is not None:
+                writer.write(self.registry_report.row())
             self.metrics.emit(
-                JsonlWriter(self.metrics_path),
+                writer,
                 config=self.describe(),
                 replica_configs={r.rid: r.server.describe() for r in self._replicas},
             )
@@ -396,6 +433,8 @@ class FleetServer:
                 self._supervisor.describe() if self._supervisor is not None
                 else None
             ),
+            "registry": (getattr(self._registry, "bundle", None)
+                         or (str(self._registry) if self._registry else None)),
         }
 
     # -- client side --------------------------------------------------------

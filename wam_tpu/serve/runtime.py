@@ -210,6 +210,16 @@ class AttributionServer:
         builds a per-server `MemoryBudget` on this server's device; an
         existing budget is used as-is; None/0 disables the admission check
         (watermarks are still captured when a budget object is given).
+    registry : compile-artifact bundle to hydrate from BEFORE any warmup
+        compile (`wam_tpu.registry`): a bundle path or `RegistryClient`;
+        None/"" disables. Hydration is the first thing `start()` does —
+        verified executables seed the AOT cache, XLA cache files and the
+        tuned-schedule snapshot land before `load_schedule_cache()` reads
+        the table — so a cold process warms at ``compile_count == 0``. A
+        missing/corrupt/mismatched bundle silently falls back to compiling
+        (per-artifact miss semantics); the `HydrationReport` lands on
+        ``registry_report`` and, when ``metrics_path`` is set, as a
+        ``registry_hydration`` ledger row.
     """
 
     def __init__(
@@ -235,6 +245,7 @@ class AttributionServer:
         health=None,
         slo=None,
         memory=None,
+        registry=None,
     ):
         if max_batch < 1:
             raise ValueError("max_batch must be >= 1")
@@ -257,6 +268,10 @@ class AttributionServer:
         self.pipelined = pipelined
         self._device = device
         self.degraded = False
+        self._registry = registry
+        # HydrationReport from start()'s bundle hydration (None: no
+        # registry, or not started yet)
+        self.registry_report = None
 
         # health plane (DESIGN.md "Health plane"): all three default off so
         # direct constructions keep their exact pre-health behavior
@@ -318,6 +333,17 @@ class AttributionServer:
         tuned knobs agree across its concurrent traces."""
         if self._started:
             return self
+        if self._registry is not None and self._registry != "":
+            # hydrate FIRST: seeded AOT entries make the bucket warmups
+            # below zero-trace, the bundle's XLA cache files must exist
+            # before the compilation cache initializes over that dir, and
+            # the schedule snapshot must land before load_schedule_cache()
+            # reads the table
+            from wam_tpu.registry.client import resolve_client
+
+            client = resolve_client(self._registry)
+            if client is not None:
+                self.registry_report = client.hydrate()
         if self.compilation_cache:
             from wam_tpu.config import enable_compilation_cache
 
@@ -387,7 +413,10 @@ class AttributionServer:
         if emit_metrics and self.metrics_path:
             from wam_tpu.results import JsonlWriter
 
-            self.metrics.emit(JsonlWriter(self.metrics_path), config=self.describe())
+            writer = JsonlWriter(self.metrics_path)
+            if self.registry_report is not None:
+                writer.write(self.registry_report.row())
+            self.metrics.emit(writer, config=self.describe())
         self._started = False
 
     def __enter__(self):
@@ -414,6 +443,8 @@ class AttributionServer:
                 else None
             ),
             "memory": self._memory.describe() if self._memory is not None else None,
+            "registry": (getattr(self._registry, "bundle", None)
+                         or (str(self._registry) if self._registry else None)),
         }
 
     # -- client side --------------------------------------------------------
