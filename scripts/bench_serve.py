@@ -45,6 +45,17 @@ Fleet modes:
 - ``--toy`` shrinks the workload (one small bucket, few requests) — the
   verify-skill smoke.
 
+Pod mode (``--pod N``, `wam_tpu.pod`) raises the failure domain from
+replica threads to worker PROCESSES: a front-door `PodRouter` in this
+process spreads the same closed-loop load across N spawned
+``wam_tpu.pod.worker`` subprocesses (each its own fleet + jax runtime)
+and prints the process-scaling curve over [1, N]. ``--pod-chaos`` adds
+seeded mid-stream SIGKILLs at the largest point — worker death, in-flight
+re-route, supervised respawn, registry rehydration all exercised for real
+— and gates on ZERO LOST requests::
+
+    python scripts/bench_serve.py --pod 2 --toy --fake-entry --pod-chaos
+
 Cold-start modes (`wam_tpu.registry`):
 - ``--registry BUNDLE`` (a `ServeConfig` field) hydrates the bundle's
   compiled executables + schedules before warmup; with ``--aot-keys`` the
@@ -387,6 +398,171 @@ def run_bench(cfg, args, n_fleet: int):
     return summary, errors
 
 
+def run_pod_bench(cfg, args, n_workers: int, chaos_on: bool):
+    """One pod point: spawn a `PodRouter` over ``n_workers`` independent
+    fleet worker processes, drive it with closed-loop clients (optionally
+    killing workers mid-stream), return (point, errors, trace_events).
+
+    The pod analog of `run_bench`: same request mix, same retry-driven
+    client loop, same loss accounting — but the failure domain under test
+    is a whole PROCESS, so `NoLiveWorkerError` is always retryable here
+    (a dead worker's respawn window is backpressure, not failure)."""
+    import numpy as np
+
+    from wam_tpu import obs
+    from wam_tpu.pod import NoLiveWorkerError, PodRouter
+    from wam_tpu.serve import (
+        QueueFullError,
+        RetryBudgetExceededError,
+        RetryPolicy,
+        RetryStats,
+    )
+    from wam_tpu.tune import resolve_bucket_cap
+
+    obs.reset()
+
+    if args.toy:
+        bucket_shapes = [(1, 16, 16)]
+        n_requests, n_clients = 240, 8
+    else:
+        bucket_shapes = (cfg.bucket_shapes()
+                         or [(1, 32, 32), (1, 48, 48), (1, 64, 64)])
+        # pod points need a load window long enough to amortize kill +
+        # respawn gaps (seconds each), hence the larger default
+        n_requests = args.requests if args.requests is not None else 12000
+        n_clients = args.clients if args.clients is not None else 16
+    n_requests *= n_workers
+    n_clients *= n_workers
+    request_shapes = list(bucket_shapes) + [
+        (s[0],) + tuple(max(1, d - 4) for d in s[1:]) for s in bucket_shapes
+    ]
+    max_batch = resolve_bucket_cap(cfg.max_batch, bucket_shapes[0], replicas=1)
+    bucket_str = ",".join("x".join(str(d) for d in s) for s in bucket_shapes)
+
+    metrics_base = cfg.metrics_path or "results/bench_pod.jsonl"
+    worker_ledger = metrics_base.replace(".jsonl", "_worker{wid}.jsonl")
+    worker_argv = [
+        sys.executable, "-m", "wam_tpu.pod.worker",
+        "--device", "cpu" if cfg.device == "auto" else cfg.device,
+        "--buckets", bucket_str,
+        "--max-batch", str(max_batch),
+        "--max-wait-ms", str(cfg.max_wait_ms),
+        "--queue-depth", str(cfg.queue_depth),
+        "--seed", str(args.seed),
+        "--metrics-path", worker_ledger,
+    ]
+    if args.fake_entry is not None:
+        worker_argv += ["--fake-entry", str(args.fake_entry)]
+    else:
+        worker_argv += ["--n-samples", str(args.n_samples or 2)]
+    if cfg.registry:
+        worker_argv += ["--registry", cfg.registry]
+    if cfg.slo:
+        worker_argv += ["--slo", cfg.slo]
+    if getattr(args, "chaos", "") and args.chaos not in ("off", "none"):
+        # in-process faults compose with process kills: each worker gets
+        # the same deterministic schedule its fleet run would
+        worker_argv += ["--chaos", args.chaos]
+
+    autoscale = None
+    start_workers = n_workers
+    if chaos_on and args.pod_autoscale:
+        from wam_tpu.pod import AutoscaleConfig
+
+        autoscale = AutoscaleConfig(min_workers=1,
+                                    max_workers=int(args.pod_autoscale))
+        start_workers = 1
+
+    router = PodRouter(
+        worker_argv,
+        bucket_str,
+        workers=start_workers,
+        heartbeat_s=0.1,
+        metrics_path=metrics_base,
+        seed=args.seed,
+        autoscale=autoscale,
+    )
+
+    killer = None
+    if chaos_on:
+        from wam_tpu.testing import PodChaosKiller
+
+        killer = PodChaosKiller(router, n_requests, seed=args.seed)
+
+    budget = threading.Semaphore(n_requests)
+    errors = []
+    policy = RetryPolicy(
+        max_attempts=max(1, cfg.retry_attempts),
+        budget_s=cfg.retry_budget_s or None,
+        retry_on=(QueueFullError, NoLiveWorkerError),
+    )
+    retry_stats = RetryStats()
+    counts = {"submitted": 0, "resolved_ok": 0, "resolved_error": 0, "lost": 0}
+    counts_lock = threading.Lock()
+
+    def client(cid: int):
+        rng = random.Random(args.seed * 997 + cid)
+        while budget.acquire(blocking=False):
+            shape = request_shapes[rng.randrange(len(request_shapes))]
+            x = np.asarray(
+                [[rng.random() for _ in range(shape[-1])]
+                 for _ in range(shape[-2])], np.float32,
+            )[None].repeat(shape[0], axis=0)
+            y = rng.randrange(4)
+            with counts_lock:
+                counts["submitted"] += 1
+            try:
+                policy.run(
+                    lambda rem: router.submit(x, y),
+                    rng=rng, stats=retry_stats,
+                )
+                outcome = "resolved_ok"
+            except RetryBudgetExceededError as e:
+                outcome = "lost" if e.pending else "resolved_error"
+                errors.append(repr(e))
+            except Exception as e:  # noqa: BLE001 - typed errors end this request
+                outcome = "resolved_error"
+                errors.append(repr(e))
+            with counts_lock:
+                counts[outcome] += 1
+                resolved = counts["resolved_ok"] + counts["resolved_error"]
+            if killer is not None:
+                killer.on_progress(resolved)
+
+    t_load0 = time.perf_counter()
+    threads = [threading.Thread(target=client, args=(i,))
+               for i in range(n_clients)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    load_s = time.perf_counter() - t_load0
+    router.close()  # collects worker byes (+ spans) and emits the ledger
+    trace_events = router.trace_events()
+
+    summary = router.pod_summary()
+    point = {
+        "pod": n_workers,
+        "workers_final": summary["workers"],
+        "completed": summary["completed"],
+        "attributions_per_s": (counts["resolved_ok"] / load_s
+                               if load_s > 0 else 0.0),
+        "load_window_s": load_s,
+        "latency_p50_ms": summary["latency_p50_ms"],
+        "latency_p99_ms": summary["latency_p99_ms"],
+        "deaths": len(summary["deaths"]),
+        "restarts": summary["restarts"],
+        "permanent_dead": summary["permanent_dead"],
+        "autoscale_actions": summary["autoscale_actions"],
+        "per_worker": summary["per_worker"],
+        **counts,
+        **{k: retry_stats.as_dict()[k] for k in ("retries", "hedges")},
+    }
+    if killer is not None:
+        point["kills"] = killer.kills
+    return point, errors, trace_events
+
+
 def _bench_arm(label: str, tmp: str, extra_args: list, env_caches: dict,
                seed: int) -> dict:
     """Run one bench arm in a FRESH subprocess with its own cache dirs
@@ -584,6 +760,69 @@ def _print_slo_report(path):
                   f"{st['health_rate'] * 100:>8.2f} {st['burn_rate']:>6.2f}")
 
 
+def _pod_main(cfg, args, obs) -> int:
+    """--pod N: run the pod scaling sweep [1, N] (just [N] when N == 1),
+    process-kill chaos (``--pod-chaos``) applied at the LARGEST point only
+    so the 1-worker baseline stays an honest denominator. Prints the
+    process-scaling curve, emits it (``--emit``), exports the merged
+    router+worker Chrome trace (``--trace``), and gates chaos runs on
+    zero lost requests."""
+    points = [1, args.pod] if args.pod > 1 else [args.pod]
+    curve = []
+    any_errors = []
+    trace_events = []
+    for n in points:
+        chaos_on = args.pod_chaos and n == max(points)
+        point, errors, trace_events = run_pod_bench(cfg, args, n, chaos_on)
+        any_errors.extend(errors)
+        curve.append(point)
+        print(json.dumps(point, indent=2))
+
+    if args.trace:
+        # the merged cross-process trace: this (router) process's spans
+        # plus every worker's shipped ring, one timeline (the per-point
+        # obs.reset() means local spans describe the LAST point)
+        print(f"trace: {obs.export_chrome_trace(args.trace, trace_events)}")
+
+    if len(curve) > 1:
+        base = curve[0]["attributions_per_s"] or 1.0
+        for p in curve:
+            p["pod_speedup_vs_1"] = round(p["attributions_per_s"] / base, 3)
+        print("pod scaling:", " ".join(
+            f"{p['pod']}x={p['pod_speedup_vs_1']:.2f}" for p in curve))
+    if args.emit:
+        payload = {
+            "bench": "bench_serve_pod",
+            "device": cfg.device,
+            "fake_entry_ms": args.fake_entry,
+            "requests_per_pod_unit": args.requests,
+            "clients_per_pod_unit": args.clients,
+            "pod_chaos": args.pod_chaos,
+            "curve": curve,
+        }
+        os.makedirs(os.path.dirname(args.emit) or ".", exist_ok=True)
+        with open(args.emit, "w") as f:
+            json.dump(payload, f, indent=2)
+        print(f"emitted: {args.emit}")
+
+    lost = sum(p.get("lost", 0) for p in curve)
+    if args.pod_chaos:
+        kills = sum(len(p.get("kills", [])) for p in curve)
+        if any_errors:
+            print(f"pod-chaos: {len(any_errors)} typed request errors "
+                  f"(first: {any_errors[0]})", file=sys.stderr)
+        print(f"pod-chaos: {kills} worker kill(s), {lost} lost request(s)")
+        if lost:
+            print("pod-chaos: zero-loss gate FAILED", file=sys.stderr)
+            return 1
+        return 0
+    if any_errors:
+        print(f"{len(any_errors)} request errors, first: {any_errors[0]}",
+              file=sys.stderr)
+        return 1
+    return 0
+
+
 def _pre_scan_fleet(argv):
     """Peek at --fleet/--fleet-sweep/--device BEFORE any wam_tpu import
     (importing the package imports jax, after which XLA_FLAGS is inert)."""
@@ -608,17 +847,35 @@ def main():
         _force_host_devices(max(sweep))
 
     parser = argparse.ArgumentParser(description=__doc__)
-    parser.add_argument("--requests", type=int, default=96,
-                        help="total requests across all clients (×fleet size)")
-    parser.add_argument("--clients", type=int, default=4,
-                        help="closed-loop client threads (×fleet size)")
+    parser.add_argument("--requests", type=int, default=None,
+                        help="total requests across all clients (×fleet/pod "
+                             "size; default 96, pod mode 12000)")
+    parser.add_argument("--clients", type=int, default=None,
+                        help="closed-loop client threads (×fleet/pod size; "
+                             "default 4, pod mode 16)")
     parser.add_argument("--n-samples", type=int, default=4,
                         help="SmoothGrad samples per attribution")
     parser.add_argument("--seed", type=int, default=0)
     parser.add_argument("--fleet-sweep", type=str, default="",
                         help="comma list of fleet sizes, e.g. 1,2,4,8")
-    parser.add_argument("--fake-entry", type=float, default=None, metavar="MS",
-                        help="fixed-cost fake entry (ms/batch) instead of the model")
+    parser.add_argument("--fake-entry", type=float, nargs="?", const=25.0,
+                        default=None, metavar="MS",
+                        help="fixed-cost fake entry (ms/batch) instead of "
+                             "the model; bare flag = 25ms")
+    parser.add_argument("--pod", type=int, default=0, metavar="N",
+                        help="pod mode: route requests across N independent "
+                             "fleet worker PROCESSES (wam_tpu.pod); N>1 "
+                             "sweeps [1, N] and prints the process-scaling "
+                             "curve")
+    parser.add_argument("--pod-chaos", action="store_true",
+                        help="seeded mid-stream SIGKILLs of pod workers "
+                             "(testing.faults.PodChaosKiller) at the "
+                             "largest pod point; the run gates on zero "
+                             "lost requests")
+    parser.add_argument("--pod-autoscale", type=str, default="", metavar="MAX",
+                        help="start the largest pod point at 1 worker with "
+                             "the autoscaler allowed up to MAX (opt-in: "
+                             "keeps the chaos/scaling points deterministic)")
     parser.add_argument("--toy", action="store_true",
                         help="tiny smoke workload (one bucket, 16 requests)")
     parser.add_argument("--emit", type=str, default="",
@@ -684,6 +941,14 @@ def main():
         return _cold_start_ab(cfg, args)
 
     obs.configure(enabled=args.obs == "on")
+
+    if args.pod > 0:
+        return _pod_main(cfg, args, obs)
+
+    if args.requests is None:
+        args.requests = 96
+    if args.clients is None:
+        args.clients = 4
 
     curve = []
     any_errors = []

@@ -60,7 +60,7 @@ import sys
 
 DEFAULT_DIRS = ("wam_tpu/core", "wam_tpu/evalsuite", "wam_tpu/serve",
                 "wam_tpu/pipeline", "wam_tpu/wavelets", "wam_tpu/obs",
-                "wam_tpu/testing", "wam_tpu/registry",
+                "wam_tpu/testing", "wam_tpu/registry", "wam_tpu/pod",
                 "wam_tpu/parallel/mesh.py", "wam_tpu/parallel/multihost.py",
                 "wam_tpu/parallel/halo.py", "wam_tpu/parallel/halo_modes.py",
                 "wam_tpu/parallel/seq_estimators.py")

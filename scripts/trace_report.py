@@ -6,7 +6,11 @@ Consumes the trace-event JSON written by ``bench_serve --trace out.json``
 count, total/mean/p50/p99 milliseconds, and the share of summed request
 wall time — plus a coverage line: how much of each ``request`` span's
 duration is tiled by spans sharing its ``trace_id`` (queue_wait + service
-should cover ~all of it; a gap means an uninstrumented phase).
+should cover ~all of it; a gap means an uninstrumented phase). Merged
+pod traces (router + worker processes, `PodRouter.trace_events`) join on
+``trace_id`` across pids — one request timeline per root, whichever
+processes its spans ran in — and spans no request root claims are
+reported as ``orphaned`` rather than silently dropped.
 
     python scripts/trace_report.py results/trace.json
     python scripts/trace_report.py results/trace.json --min-coverage 0.95
@@ -145,9 +149,31 @@ def phase_table(events: list[dict]) -> list[dict]:
     return rows
 
 
+def orphaned_spans(events: list[dict]) -> list[dict]:
+    """Spans that cannot join any request timeline: no ``request`` root in
+    the trace shares their ``trace_id`` (or they carry no trace identity at
+    all). In a merged pod trace these are typically worker spans whose root
+    lived in a ring that overflowed, or background work (warmup, heartbeat
+    handling) that legitimately has no request parent — either way they are
+    REPORTED as orphaned, never silently dropped from the accounting."""
+    root_tids = {
+        e.get("args", {}).get("trace_id")
+        for e in events
+        if e["name"] == ROOT_NAME
+    }
+    return [
+        e for e in events
+        if e["name"] != ROOT_NAME
+        and e.get("args", {}).get("trace_id") not in root_tids
+    ]
+
+
 def request_coverage(events: list[dict]) -> list[float]:
     """Per-request covered fraction: the union of same-trace child span
-    intervals clipped to the root ``request`` span, over its duration."""
+    intervals clipped to the root ``request`` span, over its duration.
+    The join key is ``args.trace_id`` alone — spans from OTHER PROCESSES
+    (pod workers re-establishing the router's context) join the same
+    request timeline as local ones; ``pid`` plays no part."""
     roots = {}
     children: dict[object, list[tuple[float, float]]] = {}
     for e in events:
@@ -228,6 +254,21 @@ def main() -> int:
             # typically warmup: those compiles predate any request span
             print("no matching trace span for phases: "
                   + ", ".join(sorted(unmatched)))
+
+    pids = {e.get("pid") for e in events}
+    if len(pids) > 1:
+        print(f"\ncross-process trace: {len(pids)} processes "
+              f"(spans joined per trace_id)")
+
+    orphans = orphaned_spans(events)
+    if orphans:
+        by_name: dict[str, int] = {}
+        for e in orphans:
+            by_name[e["name"]] = by_name.get(e["name"], 0) + 1
+        detail = ", ".join(f"{n}×{c}" for n, c in
+                           sorted(by_name.items(), key=lambda kv: -kv[1]))
+        print(f"orphaned spans (no request root shares their trace_id): "
+              f"{len(orphans)} — {detail}")
 
     cov = request_coverage(events)
     if cov:

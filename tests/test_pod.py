@@ -1,0 +1,333 @@
+"""Pod-scale failure domains (`wam_tpu/pod`): router load-spreading over
+real worker subprocesses, zero-loss re-route across a mid-stream SIGKILL,
+crash-loop escalation to permanent-dead, autoscaler decisions from
+synthetic health signals, the typed-error wire round-trip, and the
+registry-hydrated zero-compile respawn.
+
+Process tests spawn REAL ``wam_tpu.pod.worker`` subprocesses (fake
+entries keep them fast: ~1s bring-up each, no model compiles); policy
+tests (supervisor, autoscaler, protocol) run pure in-process with stub
+callables and synthetic `WorkerSnapshot`s — the same split the pod
+package is layered for."""
+
+import sys
+import time
+from concurrent.futures import Future
+
+import numpy as np
+
+from wam_tpu.pod import (
+    AutoscaleConfig,
+    NoLiveWorkerError,
+    PodMetrics,
+    PodRouter,
+    PodSupervisor,
+    PodWorkerError,
+    WorkerSnapshot,
+)
+from wam_tpu.pod.autoscaler import decide
+from wam_tpu.pod.protocol import decode_error, encode_error
+from wam_tpu.serve import (
+    NoLiveReplicaError,
+    QueueFullError,
+    RetryPolicy,
+    RetryStats,
+    SupervisorConfig,
+)
+from wam_tpu.serve.runtime import MemoryAdmissionError, ServerClosedError
+
+WORKER_ARGV = [
+    sys.executable, "-m", "wam_tpu.pod.worker",
+    "--device", "cpu", "--fake-entry", "5", "--buckets", "1x16x16",
+]
+
+
+def _pod(n=2, **kw):
+    kw.setdefault("heartbeat_s", 0.1)
+    return PodRouter(WORKER_ARGV, "1x16x16", workers=n, **kw)
+
+
+def _poll(pred, timeout_s=30.0, dt=0.05):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(dt)
+    return pred()
+
+
+def _x():
+    return np.zeros((1, 16, 16), np.float32)
+
+
+# -- router over real worker processes --------------------------------------
+
+
+def test_router_spreads_load_across_workers():
+    router = _pod(2)
+    try:
+        futs = [router.submit(_x(), 0) for _ in range(60)]
+        assert all(f.result(timeout=60).shape == (1, 16, 16) for f in futs)
+    finally:
+        router.close()
+    summary = router.pod_summary()
+    assert summary["completed"] == 60
+    # both worker processes served a share: scoring is load-aware, so a
+    # closed burst of 60 must not all land on one worker
+    per_worker = {w["worker_id"]: w for w in summary["per_worker"]}
+    assert set(per_worker) == {0, 1}
+    assert all(w["completed"] > 0 for w in per_worker.values())
+    assert sum(w["completed"] for w in per_worker.values()) == 60
+
+
+def test_kill_worker_midstream_zero_lost():
+    router = _pod(2, supervise=SupervisorConfig(seed=0, backoff_base_s=0.01))
+    policy = RetryPolicy(max_attempts=8, budget_s=60.0,
+                         retry_on=(QueueFullError, NoLiveWorkerError))
+    stats = RetryStats()
+    try:
+        futs = [router.submit_with_retry(_x(), 0, policy=policy, stats=stats)
+                for _ in range(40)]
+        victim = router.live_worker_ids()[0]
+        assert router.kill_worker(victim)
+        futs += [router.submit_with_retry(_x(), 0, policy=policy, stats=stats)
+                 for _ in range(40)]
+        # ZERO lost: every future resolves OK despite the SIGKILL — the
+        # router re-dispatches the dead worker's in-flight host copies
+        assert all(f.result(timeout=60) is not None for f in futs)
+        summary = router.pod_summary()
+        assert summary["completed"] == 80
+        assert len(summary["deaths"]) == 1
+        assert summary["deaths"][0]["worker_id"] == victim
+        # the supervisor respawns the victim (fresh incarnation, alive)
+        assert _poll(lambda: sorted(router.live_worker_ids()) == [0, 1],
+                     timeout_s=60.0)
+    finally:
+        router.close()
+    assert stats.as_dict()["exhausted"] == 0
+    rows = [r for r in router.metrics.restarts if r["transition"] == "alive"]
+    assert len(rows) == 1 and rows[0]["worker_id"] == victim
+
+
+def test_shrink_drains_gracefully():
+    router = _pod(2)
+    try:
+        futs = [router.submit(_x(), 0) for _ in range(20)]
+        wid = router.shrink()
+        assert wid is not None
+        # draining is not death: everything resolves, no death recorded,
+        # and the retired worker leaves the routable set
+        assert all(f.result(timeout=60) is not None for f in futs)
+        assert router.pod_summary()["deaths"] == []
+        assert _poll(lambda: router.live_worker_ids() == [1 - wid],
+                     timeout_s=30.0)
+        assert router.attribute(_x(), 0) is not None
+    finally:
+        router.close()
+
+
+# -- supervisor policy (stub respawn, no processes) --------------------------
+
+
+def test_crash_loop_escalates_to_permanent_dead():
+    metrics = PodMetrics()
+    respawns = []
+    sup = PodSupervisor(
+        respawns.append, metrics,
+        SupervisorConfig(max_restarts=2, window_s=60.0,
+                         backoff_base_s=0.001, seed=0))
+    def alive_rows():
+        return [r for r in metrics.restarts if r["transition"] == "alive"]
+
+    try:
+        for expected in (1, 2):
+            sup.notify_death(7, reason="test kill")
+            # wait for the "alive" ROW, not just the respawn call: the
+            # crash-loop history entry lands right before the row does
+            assert _poll(lambda: len(alive_rows()) == expected,
+                         timeout_s=10.0)
+        assert len(respawns) == 2
+        # third death inside the window: over max_restarts=2 -> escalate,
+        # NOT another respawn
+        sup.notify_death(7, reason="test kill")
+        assert sup.permanently_dead(7)
+        assert sup.permanently_dead() == [7]
+        sup.notify_death(7, reason="ignored")  # no-op once permanent
+        time.sleep(0.05)
+        assert len(respawns) == 2
+    finally:
+        sup.close()
+    transitions = [r["transition"] for r in metrics.restarts
+                   if r["worker_id"] == 7]
+    assert transitions.count("alive") == 2
+    assert transitions[-1] == "permanent_dead"
+
+
+def test_failed_respawn_counts_toward_crash_loop():
+    metrics = PodMetrics()
+
+    def bad_respawn(wid):
+        raise RuntimeError("spawn exploded")
+
+    sup = PodSupervisor(
+        bad_respawn, metrics,
+        SupervisorConfig(max_restarts=1, window_s=60.0,
+                         backoff_base_s=0.001, seed=0))
+    try:
+        sup.notify_death(3, reason="test kill")
+        assert _poll(lambda: sup.permanently_dead(3), timeout_s=10.0)
+    finally:
+        sup.close()
+    transitions = [r["transition"] for r in metrics.restarts
+                   if r["worker_id"] == 3]
+    assert "respawn_failed" in transitions
+    assert transitions[-1] == "permanent_dead"
+    assert "alive" not in transitions
+
+
+# -- autoscaler policy (pure decide, synthetic signals) -----------------------
+
+
+def _snap(wid, drain=0.0, penalty=0.0):
+    return WorkerSnapshot(worker_id=wid, pid=0, t_worker=0.0,
+                          projected_drain_s=drain, slo_penalty_s=penalty)
+
+
+def test_autoscaler_decisions():
+    cfg = AutoscaleConfig(min_workers=1, max_workers=4,
+                          grow_drain_s=0.5, shrink_drain_s=0.05)
+    # deep queues -> grow
+    assert decide(cfg, [_snap(0, drain=2.0), _snap(1, drain=1.0)], 2) == 1
+    # SLO burn alone (penalty > 0 means burn crossed 1.0) -> grow
+    assert decide(cfg, [_snap(0, drain=0.0, penalty=0.2)], 1) == 1
+    # at max_workers pressure cannot grow further
+    assert decide(cfg, [_snap(i, drain=2.0) for i in range(4)], 4) == 0
+    # calm on both signals with headroom -> shrink
+    assert decide(cfg, [_snap(0, drain=0.01), _snap(1, drain=0.0)], 2) == -1
+    # calm at min_workers holds
+    assert decide(cfg, [_snap(0, drain=0.01)], 1) == 0
+    # in-between load holds
+    assert decide(cfg, [_snap(0, drain=0.2)], 2) == 0
+    # below min_workers always grows (even with no snapshots yet)
+    assert decide(cfg, [], 0) == 1
+    # a burning pod with headroom grows even with empty queues...
+    assert decide(cfg, [_snap(0, drain=0.0, penalty=0.1),
+                        _snap(1, drain=0.0)], 2) == 1
+    # ...and at max_workers it HOLDS — burn blocks the shrink branch
+    assert decide(cfg, [_snap(i, drain=0.0, penalty=0.1 if i == 0 else 0.0)
+                        for i in range(4)], 4) == 0
+
+
+# -- typed errors across the process boundary --------------------------------
+
+
+def test_error_wire_roundtrip_preserves_backpressure():
+    q = decode_error(encode_error(QueueFullError(0.25)))
+    assert isinstance(q, QueueFullError) and q.retry_after_s == 0.25
+    m = decode_error(encode_error(MemoryAdmissionError(0.5, bucket="1x16x16")))
+    assert isinstance(m, MemoryAdmissionError) and m.retry_after_s == 0.5
+    n = decode_error(encode_error(
+        NoLiveReplicaError("all dead", retry_after_s=1.5)))
+    assert isinstance(n, NoLiveReplicaError) and n.retry_after_s == 1.5
+    s = decode_error(encode_error(ServerClosedError("closing")))
+    assert isinstance(s, ServerClosedError) and "closing" in str(s)
+    # unknown class degrades to the typed pod error, never a decode crash
+    u = decode_error({"type": "SomethingForeign", "message": "boom",
+                      "retry_after_s": 2.0})
+    assert isinstance(u, PodWorkerError) and u.retry_after_s == 2.0
+
+
+def test_no_live_errors_are_retryable_backpressure():
+    # satellite: fleet-wide (and pod-wide) death during a restart window
+    # carries retry_after_s, so RetryPolicy backs off and retries instead
+    # of exhausting against a recovering service
+    assert NoLiveReplicaError("x").retry_after_s is None
+    assert NoLiveWorkerError("x", retry_after_s=0.02).retry_after_s == 0.02
+
+    attempts = []
+
+    def submit(remaining_s):
+        attempts.append(remaining_s)
+        f = Future()
+        if len(attempts) < 3:
+            f.set_exception(NoLiveWorkerError("pod down",
+                                              retry_after_s=0.005))
+        else:
+            f.set_result("served")
+        return f
+
+    policy = RetryPolicy(max_attempts=5, backoff_base_s=0.001,
+                         retry_on=(QueueFullError, NoLiveWorkerError))
+    stats = RetryStats()
+    assert policy.run(submit, stats=stats) == "served"
+    assert len(attempts) == 3
+    assert stats.as_dict()["retries"] == 2
+
+
+# -- registry-hydrated respawn (real toy workers, sentinel-verified) ----------
+
+
+def test_registry_hydrated_respawn_zero_compiles(tmp_path):
+    """The pod acceptance criterion end-to-end: seed a toy worker under
+    throwaway caches, publish its compiled artifacts as a bundle, bring a
+    pod worker up with COLD caches + ``--registry``, SIGKILL it, and
+    verify the supervisor's respawn rejoins at ``compile_count == 0`` —
+    warmup hydrates the bundle instead of re-tracing."""
+    from wam_tpu.registry import publish_bundle
+
+    key_base = "test_pod|toy2d|J2|n2|mb8"
+    toy_argv = [
+        sys.executable, "-m", "wam_tpu.pod.worker",
+        "--device", "cpu", "--buckets", "1x16x16", "--n-samples", "2",
+        "--aot-key-base", key_base,
+    ]
+
+    def caches(label):
+        root = tmp_path / label
+        return {
+            "WAM_TPU_AOT_CACHE": str(root / "aot"),
+            "WAM_TPU_SCHEDULE_CACHE": str(root / "schedules.json"),
+            "WAM_TPU_CACHE_DIR": str(root / "xla"),
+        }
+
+    seed_env = caches("seed")
+    router = PodRouter(toy_argv, "1x16x16", workers=1, env=seed_env,
+                       ready_timeout_s=300.0)
+    try:
+        assert router.attribute(_x(), 0) is not None
+    finally:
+        router.close()
+
+    manifest = publish_bundle(
+        str(tmp_path / "bundle"),
+        aot_dir=seed_env["WAM_TPU_AOT_CACHE"],
+        schedule_path=seed_env["WAM_TPU_SCHEDULE_CACHE"],
+        xla_dir=seed_env["WAM_TPU_CACHE_DIR"],
+        source={"test": "test_pod seed worker"},
+    )
+    assert sum(1 for a in manifest["artifacts"] if a["kind"] == "aot") > 0
+
+    hydrated_argv = toy_argv + ["--registry", str(tmp_path / "bundle")]
+    router = PodRouter(hydrated_argv, "1x16x16", workers=1,
+                       env=caches("cold"), ready_timeout_s=300.0,
+                       supervise=SupervisorConfig(seed=0,
+                                                  backoff_base_s=0.01))
+    try:
+        def ready_rows(incarnation):
+            return [r for r in router.metrics.worker_rows
+                    if r["phase"] == "ready"
+                    and r["incarnation"] == incarnation]
+
+        # even the FIRST spawn hydrates: cold caches, zero compiles
+        first = ready_rows(0)
+        assert first and first[0]["compile_count"] == 0
+        assert router.kill_worker(0)
+        assert _poll(lambda: bool(ready_rows(1)), timeout_s=240.0)
+        respawned = ready_rows(1)[0]
+        # THE acceptance bar: the respawned worker's ready snapshot shows
+        # zero compiles ever (bundle hydration) and zero post-warm traces
+        assert respawned["compile_count"] == 0
+        assert respawned["post_warm_compiles"] == 0
+        assert router.attribute(_x(), 0) is not None
+    finally:
+        router.close()

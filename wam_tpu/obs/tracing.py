@@ -57,6 +57,8 @@ __all__ = [
     "set_enabled",
     "enabled",
     "set_ring_size",
+    "namespace_ids",
+    "spans_to_events",
 ]
 
 
@@ -91,6 +93,16 @@ def set_ring_size(n: int) -> None:
 
 def _next_id() -> str:
     return f"{next(_ids):x}"
+
+
+def namespace_ids(pid: int) -> None:
+    """Partition the span-id space by process: restart this process's id
+    counter at ``pid << 40``. Pod workers call it once at startup so ids
+    minted in N processes never collide when the router merges their span
+    rings into one trace (2^40 ids per process before overlap — the ring
+    holds 4096). Idempotent in effect; call before any spans record."""
+    global _ids
+    _ids = itertools.count((int(pid) << 40) + 1)
 
 
 def _stack() -> list:
@@ -290,27 +302,28 @@ def clear_spans() -> None:
     _STATE.ring.clear()
 
 
-def export_chrome_trace(path: str, extra_events: list[dict] | None = None) -> str:
-    """Write the span ring as Chrome trace-event JSON (Perfetto-loadable).
+def spans_to_events(rows, *, pid: int, clock_offset_s: float = 0.0,
+                    process_name: str | None = None) -> list[dict]:
+    """Span-ring dicts → Chrome trace events, attributable to ``pid``.
 
-    Every span becomes one complete (``"ph": "X"``) event: ``ts``/``dur``
-    in microseconds on the perf_counter timebase, ``pid`` = this process,
-    ``tid`` = a stable per-thread-name integer, and the trace identity
-    (``trace_id``/``span_id``/``parent_id``) plus user attrs under
-    ``args``. `scripts/trace_report.py` consumes this file; so does
-    ``chrome://tracing`` / https://ui.perfetto.dev. Returns ``path``."""
-    rows = spans()
+    The cross-process half of trace export: a pod router calls this on
+    the span ring each worker ships at shutdown, with ``clock_offset_s``
+    the worker→router perf_counter offset estimated from heartbeat RTTs
+    — so spans minted on N different monotonic clocks land on ONE shared
+    timeline (`ts`/`dur` in µs, offset applied). ``process_name`` adds
+    the Perfetto process-label metadata row. Thread-name metadata is
+    emitted per distinct thread seen in ``rows``."""
     tids: dict[str, int] = {}
-    events = []
+    events: list[dict] = []
     for r in rows:
         tid = tids.setdefault(r["thread"], len(tids) + 1)
         events.append({
             "name": r["name"],
             "cat": r["cat"],
             "ph": "X",
-            "ts": r["t0"] * 1e6,
+            "ts": (r["t0"] + clock_offset_s) * 1e6,
             "dur": max(0.0, (r["t1"] - r["t0"]) * 1e6),
-            "pid": os.getpid(),
+            "pid": pid,
             "tid": tid,
             "args": {
                 "trace_id": r["trace_id"],
@@ -320,11 +333,26 @@ def export_chrome_trace(path: str, extra_events: list[dict] | None = None) -> st
             },
         })
     events.extend(
-        {"name": name, "ph": "M", "pid": os.getpid(), "tid": tid,
+        {"name": "thread_name", "ph": "M", "pid": pid, "tid": tid,
          "args": {"name": thread}}
         for thread, tid in tids.items()
-        for name in ("thread_name",)
     )
+    if process_name is not None:
+        events.append({"name": "process_name", "ph": "M", "pid": pid,
+                       "tid": 0, "args": {"name": process_name}})
+    return events
+
+
+def export_chrome_trace(path: str, extra_events: list[dict] | None = None) -> str:
+    """Write the span ring as Chrome trace-event JSON (Perfetto-loadable).
+
+    Every span becomes one complete (``"ph": "X"``) event: ``ts``/``dur``
+    in microseconds on the perf_counter timebase, ``pid`` = this process,
+    ``tid`` = a stable per-thread-name integer, and the trace identity
+    (``trace_id``/``span_id``/``parent_id``) plus user attrs under
+    ``args``. `scripts/trace_report.py` consumes this file; so does
+    ``chrome://tracing`` / https://ui.perfetto.dev. Returns ``path``."""
+    events = spans_to_events(spans(), pid=os.getpid())
     if extra_events:
         events.extend(extra_events)
     payload = {"traceEvents": events, "displayTimeUnit": "ms"}

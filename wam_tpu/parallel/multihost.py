@@ -15,12 +15,21 @@ device mesh when there is one process.
 from __future__ import annotations
 
 import math
+import time
 
 import jax
 import numpy as np
 from jax.sharding import Mesh
 
-__all__ = ["init_distributed", "hybrid_mesh", "process_local_batch"]
+__all__ = ["CoordinatorConnectError", "init_distributed", "hybrid_mesh",
+           "process_local_batch"]
+
+
+class CoordinatorConnectError(RuntimeError):
+    """Could not reach the jax.distributed coordinator within the retry
+    budget. The message names the coordinator address and the attempts
+    made — a pod bring-up that fails here fails diagnosable, not as a raw
+    hang or a bare RuntimeError from deep inside the runtime."""
 
 
 def _distributed_client_exists() -> bool:
@@ -34,19 +43,55 @@ def _distributed_client_exists() -> bool:
         return False
 
 
+def _initialize_with_retries(
+    coordinator_address,
+    connect_attempts: int,
+    connect_backoff_s: float,
+    **kwargs,
+) -> None:
+    """Bounded-retry wrapper around `jax.distributed.initialize`: slow pod
+    bring-up (coordinator container still scheduling, DNS not yet
+    propagated) retries with linear backoff; exhaustion raises
+    `CoordinatorConnectError` naming the address. Already-initialized
+    runtimes pass through as success on any attempt."""
+    last: Exception | None = None
+    for attempt in range(1, max(1, connect_attempts) + 1):
+        try:
+            jax.distributed.initialize(
+                coordinator_address=coordinator_address, **kwargs)
+            return
+        except RuntimeError as exc:
+            if _distributed_client_exists() or "already" in str(exc).lower():
+                return
+            last = exc
+            if attempt < connect_attempts:
+                time.sleep(connect_backoff_s * attempt)
+    raise CoordinatorConnectError(
+        f"could not connect to jax.distributed coordinator at "
+        f"{coordinator_address or '<env-discovered>'} after "
+        f"{connect_attempts} attempt(s) "
+        f"(backoff {connect_backoff_s:g}s/attempt): {last!r}"
+    ) from last
+
+
 def init_distributed(
     coordinator_address: str | None = None,
     num_processes: int | None = None,
     process_id: int | None = None,
     initialization_timeout: float | None = None,
+    connect_attempts: int = 3,
+    connect_backoff_s: float = 2.0,
 ) -> dict:
     """Connect this process to the multi-host runtime.
 
     On TPU pods the arguments are discovered from the environment, so a bare
     ``init_distributed()`` works under standard launchers; explicit arguments
     support manual bring-up. Safe to call in a single process with no
-    cluster environment (no-op). Returns {"process_index", "process_count",
-    "local_devices", "global_devices"}.
+    cluster environment (no-op). Coordinator connect is bounded:
+    ``connect_attempts`` tries with ``connect_backoff_s``-linear backoff,
+    then `CoordinatorConnectError` naming the coordinator address (pod
+    workers surface it verbatim instead of hanging bring-up). Returns
+    {"process_index", "process_count", "local_devices", "global_devices"}.
     """
     import os
 
@@ -54,8 +99,10 @@ def init_distributed(
         kwargs = {}
         if initialization_timeout is not None:
             kwargs["initialization_timeout"] = initialization_timeout
-        jax.distributed.initialize(
-            coordinator_address=coordinator_address,
+        _initialize_with_retries(
+            coordinator_address,
+            connect_attempts,
+            connect_backoff_s,
             num_processes=num_processes,
             process_id=process_id,
             **kwargs,
@@ -74,16 +121,12 @@ def init_distributed(
             )
         ) or ("," in os.environ.get("TPU_WORKER_HOSTNAMES", ""))
         if multi_host:
-            try:
-                jax.distributed.initialize()
-            except RuntimeError as exc:
-                # Suppress ONLY the already-initialized/backend-already-up
-                # cases; a genuine bring-up failure (unreachable coordinator,
-                # bad env) must not silently degrade to single-process
-                # (round-1 ADVICE.md item 3).
-                already = _distributed_client_exists() or "already" in str(exc).lower()
-                if not already:
-                    raise
+            # Already-initialized/backend-already-up still passes through as
+            # success inside the retry wrapper; a genuine bring-up failure
+            # (unreachable coordinator, bad env) must not silently degrade
+            # to single-process (round-1 ADVICE.md item 3) — it exhausts the
+            # retries and raises CoordinatorConnectError.
+            _initialize_with_retries(None, connect_attempts, connect_backoff_s)
     return {
         "process_index": jax.process_index(),
         "process_count": jax.process_count(),

@@ -1,0 +1,40 @@
+"""Pod serving: process-level failure domains over N independent fleets.
+
+The resilience ladder so far lived inside ONE process: replica threads
+with supervised restarts (`serve.supervisor`), client retry/hedging
+(`serve.retry`), chaos injection (`testing.faults`), registry-backed
+zero-compile rehydration (`registry`). This package adds the tier above
+— a front-door `PodRouter` spreading work across worker PROCESSES
+(`pod.worker`, each a full `serve.fleet.FleetServer`), a `PodSupervisor`
+respawning dead processes with the same crash-loop policy grammar
+(`serve.supervisor.SupervisorConfig` reused), and an `AutoscalerLoop`
+growing/shrinking the worker set from the pod's aggregate health plane —
+so a SIGKILL, host OOM, or hardware loss costs one worker, never the
+service, and in-flight requests re-route with zero loss.
+
+Layering (imports point downward only):
+
+    router ──> supervisor ──> metrics ──> protocol
+       │            │
+       └─> autoscaler (policy pure; loop drives router.grow/shrink)
+
+`pod.worker` is the subprocess entrypoint (``python -m
+wam_tpu.pod.worker``) and imports none of the router side at runtime.
+"""
+
+from wam_tpu.pod.autoscaler import AutoscaleConfig, AutoscalerLoop
+from wam_tpu.pod.metrics import PodMetrics
+from wam_tpu.pod.protocol import PodWorkerError, WorkerSnapshot
+from wam_tpu.pod.router import NoLiveWorkerError, PodRouter
+from wam_tpu.pod.supervisor import PodSupervisor
+
+__all__ = [
+    "AutoscaleConfig",
+    "AutoscalerLoop",
+    "NoLiveWorkerError",
+    "PodMetrics",
+    "PodRouter",
+    "PodSupervisor",
+    "PodWorkerError",
+    "WorkerSnapshot",
+]

@@ -1,0 +1,721 @@
+"""Front-door pod router: health-aware routing over N worker processes.
+
+`PodRouter` is the process-level analog of `serve.fleet.FleetServer`: the
+fleet routes items across replica threads inside one process; the router
+routes them across INDEPENDENT worker processes (`wam_tpu.pod.worker`),
+each a full fleet of its own. The routing discipline is deliberately the
+same shape as `FleetServer._route_inner` so operators reason about one
+model at both scales:
+
+- **healthy-first, load-aware**: candidates are scored by the worker's
+  last-heartbeat ``projected_drain_s`` plus the router-side in-flight
+  count times the worker's per-bucket EMA service time (the heartbeat is
+  stale by up to one interval; in-flight accounting covers the gap) plus
+  the worker's SLO burn penalty. Workers whose every replica is
+  quarantined are last-resort candidates, never dropped.
+- **typed backpressure, aggregated fleet-style**: a worker's
+  `QueueFullError` re-routes the request to the next candidate; when
+  every live worker rejected, the request fails with a `QueueFullError`
+  carrying the SMALLEST ``retry_after_s`` any worker offered.
+- **zero lost requests across worker death**: the router keeps the host
+  copy of every in-flight request until its result arrives; a worker
+  death (channel EOF, heartbeat timeout, or exit code — whichever signal
+  lands first) re-routes everything that worker held to the survivors,
+  exactly like the fleet's `_harvest` re-route, while the
+  `PodSupervisor` respawns the process with jittered backoff (hydrating
+  the registry bundle so rejoin is seconds). With ZERO live workers the
+  submit fails `NoLiveWorkerError` whose ``retry_after_s`` estimates the
+  respawn ETA — `RetryPolicy` treats a total-outage window as
+  backpressure, not a terminal failure.
+
+Trace identity crosses the process boundary: the router opens the
+per-request root span, ships ``(trace_id, span_id)`` with the submit, and
+workers re-establish it (`obs.tracing.use_context`) so their spans join
+the request's timeline. At close each worker ships its span ring back;
+`trace_events()` re-bases them onto the router's clock (offset estimated
+from heartbeat RTTs) for one merged Chrome trace.
+"""
+
+from __future__ import annotations
+
+import itertools
+import os
+import secrets
+import subprocess
+import sys
+import threading
+import time
+from concurrent.futures import Future
+from dataclasses import dataclass, field
+from multiprocessing.connection import Listener
+
+import numpy as np
+
+from wam_tpu.obs import tracing as obs_tracing
+from wam_tpu.pod.metrics import PodMetrics
+from wam_tpu.pod.protocol import AUTHKEY_ENV, Channel, decode_error
+from wam_tpu.pod.supervisor import PodSupervisor
+from wam_tpu.serve.buckets import BucketTable, bucket_key
+from wam_tpu.serve.metrics import EMA_SEED_S
+from wam_tpu.serve.runtime import (
+    DeadlineExceededError,
+    QueueFullError,
+    ServeError,
+    ServerClosedError,
+)
+from wam_tpu.serve.supervisor import SupervisorConfig
+
+__all__ = ["NoLiveWorkerError", "PodRouter"]
+
+# seed for the spawn-time EMA before the first worker came up (the
+# respawn-ETA half of NoLiveWorkerError.retry_after_s)
+_SPAWN_EMA_SEED_S = 5.0
+
+
+class NoLiveWorkerError(ServeError):
+    """Every pod worker is dead (or refused this request after deaths).
+    ``retry_after_s`` estimates when a supervised respawn will be serving
+    again (pending-restart ETA + spawn-time EMA; None when the pod is
+    unsupervised and nobody is coming back) — `RetryPolicy` floors its
+    backoff at it, turning a total-outage window into survivable
+    backpressure."""
+
+    def __init__(self, msg: str, retry_after_s: float | None = None):
+        super().__init__(msg)
+        self.retry_after_s = retry_after_s
+
+
+@dataclass
+class _PodRequest:
+    """One admitted item's routing state (the process-level twin of
+    `serve.fleet._FleetRequest`): the router holds ``x`` until a result
+    lands, so a worker death re-dispatches from the host copy."""
+
+    req_id: int
+    x: np.ndarray
+    y: int | None
+    bkey: str
+    deadline_at: float | None
+    future: Future
+    t_submit: float
+    tried: set = field(default_factory=set)
+    min_retry_after: float | None = None
+    ctx: tuple | None = None
+
+
+class _Worker:
+    """Router-side state for one worker process incarnation."""
+
+    def __init__(self, wid: int, incarnation: int):
+        self.wid = wid
+        self.incarnation = incarnation
+        self.proc: subprocess.Popen | None = None
+        self.chan: Channel | None = None
+        self.snapshot = None  # latest protocol.WorkerSnapshot
+        self.last_reply = time.monotonic()
+        self.alive = False
+        self.draining = False  # autoscale shrink: no new routes
+        self.closing = False  # router-initiated close: EOF is not a death
+        self.ready = threading.Event()
+        self.inflight: dict[int, _PodRequest] = {}
+        self.inflight_lock = threading.Lock()
+        # perf_counter offset: t_router ~= t_worker + clock_offset_s,
+        # estimated from the lowest-RTT heartbeat (midpoint method)
+        self.clock_offset_s = 0.0
+        self.best_rtt_s = float("inf")
+        self.spans: list[dict] = []  # shipped at bye
+        self.final_snapshot = None
+
+
+class PodRouter:
+    """See module docstring.
+
+    Parameters
+    ----------
+    worker_argv : base command for one worker process, e.g.
+        ``[sys.executable, "-m", "wam_tpu.pod.worker", "--device", "cpu",
+        "--fake-entry", "25", "--buckets", "1x16x16"]``. The router
+        appends ``--connect``/``--worker-id``; the literal ``{wid}`` in
+        any element is substituted with the worker id (per-worker ledger
+        paths and the like). Respawns and autoscale grows reuse it, so a
+        ``--registry`` in here is what makes every rejoin hydrate.
+    workers : initial worker count.
+    buckets : the workers' admitted item shapes (`ServeConfig` grammar or
+        a shape list) — the router needs them for bucket-keyed scoring
+        and fail-fast `NoBucketError` before anything queues.
+    labeled : whether submits carry a class label (must match the
+        workers' entries).
+    supervise : `serve.supervisor.SupervisorConfig` / True for supervised
+        respawn with crash-loop escalation; None/False = a dead worker
+        stays dead (in-flight work still re-routes either way).
+    autoscale : a `pod.autoscaler.AutoscaleConfig` to grow/shrink the
+        worker set from aggregate drain + SLO burn; None = fixed set.
+    heartbeat_s / heartbeat_timeout_s : health-poll period and the
+        silence threshold that declares a worker dead.
+    ready_timeout_s : max wall time for a spawned worker to warm and
+        say hello (covers jax import + registry hydration + warmup).
+    env : extra environment for worker processes.
+    metrics_path : pod JSONL ledger (pod_worker / worker_restart /
+        pod_autoscale / pod_summary rows) written at close.
+    """
+
+    def __init__(
+        self,
+        worker_argv,
+        buckets,
+        *,
+        workers: int = 2,
+        labeled: bool = True,
+        supervise=True,
+        autoscale=None,
+        heartbeat_s: float = 0.25,
+        heartbeat_timeout_s: float = 5.0,
+        ready_timeout_s: float = 180.0,
+        env: dict | None = None,
+        metrics: PodMetrics | None = None,
+        metrics_path: str | None = None,
+        seed: int = 0,
+        auto_start: bool = True,
+    ):
+        if isinstance(buckets, str):
+            from wam_tpu.config import ServeConfig
+
+            buckets = ServeConfig(buckets=buckets).bucket_shapes()
+        self.table = (buckets if isinstance(buckets, BucketTable)
+                      else BucketTable(buckets))
+        self._worker_argv = [str(a) for a in worker_argv]
+        self.n_initial = int(workers)
+        self.labeled = labeled
+        self.heartbeat_s = heartbeat_s
+        self.heartbeat_timeout_s = heartbeat_timeout_s
+        self.ready_timeout_s = ready_timeout_s
+        self._env = dict(env or {})
+        self.metrics = metrics if metrics is not None else PodMetrics()
+        self.metrics_path = metrics_path
+        self.seed = seed
+
+        self._lock = threading.Lock()
+        self._workers: dict[int, _Worker] = {}
+        self._wid_counter = itertools.count(0)
+        self._req_ids = itertools.count(1)
+        self._closed = False
+        self._started = False
+        self._stop = threading.Event()
+        self._threads: list[threading.Thread] = []
+        self._spawn_ema_s = _SPAWN_EMA_SEED_S
+        self._authkey = secrets.token_bytes(16)
+        self._listener: Listener | None = None
+        self.address: str | None = None
+
+        self._supervisor = None
+        if supervise:
+            cfg = supervise if isinstance(supervise, SupervisorConfig) else None
+            self._supervisor = PodSupervisor(self._respawn_worker,
+                                             self.metrics, cfg)
+        self._autoscaler = None
+        if autoscale is not None:
+            from wam_tpu.pod.autoscaler import AutoscalerLoop
+
+            self._autoscaler = AutoscalerLoop(self, autoscale)
+        if auto_start:
+            self.start()
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self) -> "PodRouter":
+        if self._started:
+            return self
+        self._listener = Listener(("127.0.0.1", 0), authkey=self._authkey)
+        host, port = self._listener.address
+        self.address = f"{host}:{port}"
+        t = threading.Thread(target=self._accept_loop, daemon=True,
+                             name="wam-pod-accept")
+        t.start()
+        self._threads.append(t)
+        # first bring-up: spawn everything, then wait — warmups overlap
+        pending = [self._spawn_worker(next(self._wid_counter))
+                   for _ in range(self.n_initial)]
+        for w in pending:
+            self._await_ready(w)
+        t = threading.Thread(target=self._heartbeat_loop, daemon=True,
+                             name="wam-pod-heartbeat")
+        t.start()
+        self._threads.append(t)
+        if self._autoscaler is not None:
+            self._autoscaler.start()
+        self._started = True
+        return self
+
+    def _worker_env(self) -> dict:
+        env = {**os.environ, **self._env}
+        env[AUTHKEY_ENV] = self._authkey.hex()
+        return env
+
+    def _spawn_worker(self, wid: int, incarnation: int = 0) -> _Worker:
+        """Launch one worker process and register its pending slot; the
+        acceptor thread completes the handshake when its hello arrives."""
+        w = _Worker(wid, incarnation)
+        with self._lock:
+            self._workers[wid] = w
+        argv = [a.replace("{wid}", str(wid)) for a in self._worker_argv]
+        argv += ["--connect", self.address, "--worker-id", str(wid)]
+        w.t_spawn = time.perf_counter()
+        w.proc = subprocess.Popen(argv, env=self._worker_env(),
+                                  stdout=subprocess.DEVNULL)
+        return w
+
+    def _await_ready(self, w: _Worker) -> None:
+        if not w.ready.wait(self.ready_timeout_s):
+            try:
+                w.proc.kill()
+            except OSError:
+                pass
+            raise RuntimeError(
+                f"pod worker {w.wid} (pid {w.proc.pid}) did not become "
+                f"ready within {self.ready_timeout_s:g}s")
+        spawn_s = time.perf_counter() - w.t_spawn
+        self._spawn_ema_s = 0.7 * self._spawn_ema_s + 0.3 * spawn_s
+        self.metrics.note_worker_ready(w.wid, w.incarnation, w.snapshot,
+                                       spawn_s=spawn_s)
+
+    def _respawn_worker(self, wid: int) -> None:
+        """Supervisor restart procedure: spawn a fresh incarnation (same
+        argv — including any ``--registry`` bundle, so the rejoin
+        hydrates instead of recompiling) and block until it is warm."""
+        with self._lock:
+            if self._closed:
+                raise ServerClosedError("pod closed during worker respawn")
+            prev = self._workers.get(wid)
+            incarnation = (prev.incarnation + 1) if prev is not None else 0
+        w = self._spawn_worker(wid, incarnation)
+        self._await_ready(w)
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn = self._listener.accept()
+            except (OSError, EOFError):
+                return  # listener closed
+            try:
+                hello = conn.recv()
+            except (OSError, EOFError):
+                conn.close()
+                continue
+            wid = hello.get("worker_id")
+            with self._lock:
+                w = self._workers.get(wid)
+            if hello.get("op") != "hello" or w is None or w.ready.is_set():
+                conn.close()
+                continue
+            w.chan = Channel(conn)
+            w.snapshot = hello.get("snapshot")
+            w.last_reply = time.monotonic()
+            w.alive = True
+            t = threading.Thread(target=self._receive_loop, args=(w,),
+                                 daemon=True,
+                                 name=f"wam-pod-recv-{wid}")
+            t.start()
+            self._threads.append(t)
+            w.ready.set()
+
+    def close(self, emit_metrics: bool = True) -> None:
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+        self._stop.set()
+        if self._autoscaler is not None:
+            self._autoscaler.close()
+        if self._supervisor is not None:
+            self._supervisor.close()
+        with self._lock:
+            workers = list(self._workers.values())
+        for w in workers:
+            w.closing = True
+            if w.alive and w.chan is not None:
+                try:
+                    w.chan.send({"op": "close"})
+                except OSError:
+                    pass
+        deadline = time.monotonic() + 30.0
+        for w in workers:
+            if w.proc is not None:
+                try:
+                    w.proc.wait(max(0.1, deadline - time.monotonic()))
+                except subprocess.TimeoutExpired:
+                    w.proc.kill()
+        if self._listener is not None:
+            try:
+                self._listener.close()
+            except OSError:
+                pass
+        if emit_metrics and self.metrics_path:
+            from wam_tpu.results import JsonlWriter
+
+            self.metrics.emit(JsonlWriter(self.metrics_path),
+                              config=self.describe(), workers=workers)
+        self._started = False
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def describe(self) -> dict:
+        with self._lock:
+            workers = list(self._workers.values())
+        return {
+            "pod_workers": len([w for w in workers if w.alive]),
+            "workers_total": len(workers),
+            "buckets": [list(b.shape) for b in self.table],
+            "labeled": self.labeled,
+            "supervised": self._supervisor is not None,
+            "autoscaled": self._autoscaler is not None,
+            "heartbeat_s": self.heartbeat_s,
+            "worker_argv": self._worker_argv,
+        }
+
+    # -- health plane -------------------------------------------------------
+
+    def _heartbeat_loop(self) -> None:
+        while not self._stop.wait(self.heartbeat_s):
+            now = time.monotonic()
+            with self._lock:
+                # closing workers are retiring on purpose: their exit is
+                # the receive loop's EOF to handle, not a death to flag
+                workers = [w for w in self._workers.values()
+                           if w.alive and not w.closing]
+            for w in workers:
+                rc = w.proc.poll() if w.proc is not None else None
+                if rc is not None:
+                    self._mark_dead(w, f"exit code {rc}")
+                    continue
+                if now - w.last_reply > self.heartbeat_timeout_s:
+                    self._mark_dead(
+                        w, f"heartbeat silence > {self.heartbeat_timeout_s:g}s")
+                    try:
+                        w.proc.kill()  # unresponsive but running: fence it
+                    except OSError:
+                        pass
+                    continue
+                try:
+                    w.chan.send({"op": "health", "t_send": time.perf_counter()})
+                except OSError:
+                    self._mark_dead(w, "control channel write failed")
+            self.metrics.publish_gauges(self._live_snapshots())
+
+    def _live_snapshots(self):
+        with self._lock:
+            return [w.snapshot for w in self._workers.values()
+                    if w.alive and w.snapshot is not None]
+
+    def _receive_loop(self, w: _Worker) -> None:
+        while True:
+            try:
+                msg = w.chan.recv()
+            except (EOFError, OSError):
+                break
+            op = msg.get("op")
+            if op == "result":
+                self._on_result(w, msg)
+            elif op == "health_reply":
+                now = time.perf_counter()
+                rtt = now - msg["t_send"]
+                if rtt < w.best_rtt_s:
+                    # midpoint estimate from the tightest round-trip seen
+                    w.best_rtt_s = rtt
+                    w.clock_offset_s = (msg["t_send"] + rtt / 2.0
+                                        - msg["t_worker"])
+                w.snapshot = msg["snapshot"]
+                w.last_reply = time.monotonic()
+            elif op == "bye":
+                w.final_snapshot = msg.get("snapshot")
+                w.spans = msg.get("spans") or []
+                if w.final_snapshot is not None:
+                    self.metrics.note_worker_final(
+                        w.wid, w.incarnation, w.final_snapshot)
+        if not w.closing:
+            self._mark_dead(w, "control channel EOF")
+            return
+        # router-initiated retirement (shrink drain or pod close): not a
+        # death — but anything the worker still held must not strand
+        with self._lock:
+            w.alive = False
+        with w.inflight_lock:
+            orphans = list(w.inflight.values())
+            w.inflight.clear()
+        for req in orphans:
+            req.tried.add(w.wid)
+            self._route(req, raise_errors=False)
+
+    def _mark_dead(self, w: _Worker, reason: str) -> None:
+        """Worker death: re-route everything it held, tell the
+        supervisor. Idempotent per incarnation (EOF, heartbeat timeout,
+        and exit-code detection race — first signal wins)."""
+        with self._lock:
+            if not w.alive:
+                return
+            w.alive = False
+        self.metrics.note_worker_death(w.wid, reason,
+                                       snapshot=w.snapshot)
+        with w.inflight_lock:
+            orphans = list(w.inflight.values())
+            w.inflight.clear()
+        for req in orphans:
+            req.tried.add(w.wid)
+            self._route(req, raise_errors=False)
+        if (self._supervisor is not None and not self._closed
+                and not w.draining):
+            self._supervisor.notify_death(w.wid, reason)
+
+    def kill_worker(self, wid: int) -> bool:
+        """SIGKILL one worker process (the pod-chaos hook —
+        `testing.faults.PodChaosKiller` drives it). Returns whether a
+        live worker was killed. Death detection, re-route, and respawn
+        all go through the normal paths: a chaos kill is
+        indistinguishable from a real one by design."""
+        with self._lock:
+            w = self._workers.get(wid)
+        if w is None or not w.alive or w.proc is None:
+            return False
+        try:
+            w.proc.kill()
+        except OSError:
+            return False
+        return True
+
+    def live_worker_ids(self) -> list[int]:
+        with self._lock:
+            return sorted(w.wid for w in self._workers.values()
+                          if w.alive and not w.draining)
+
+    # -- autoscaler surface -------------------------------------------------
+
+    def grow(self) -> int:
+        """Add one worker (autoscaler grow). Returns its wid."""
+        wid = next(self._wid_counter)
+        w = self._spawn_worker(wid)
+        self._await_ready(w)
+        return wid
+
+    def shrink(self) -> int | None:
+        """Gracefully retire the least-loaded live worker (autoscaler
+        shrink): stop routing to it, let it drain, and do NOT treat its
+        exit as a death. Returns the wid, or None when nothing shrinks."""
+        with self._lock:
+            cands = [w for w in self._workers.values()
+                     if w.alive and not w.draining]
+            if len(cands) <= 1:
+                return None
+            w = min(cands, key=lambda w: (len(w.inflight), w.wid))
+            w.draining = True
+            w.closing = True
+        try:
+            w.chan.send({"op": "close"})
+        except OSError:
+            pass
+        return w.wid
+
+    # -- client side --------------------------------------------------------
+
+    def submit(self, x, y=None, deadline_ms: float | None = None) -> Future:
+        """Admit one item and route it to the best live worker. The
+        returned future survives worker death by re-routing; it fails
+        typed (`QueueFullError` / `NoLiveWorkerError` / deadline) when
+        the pod genuinely cannot take the work."""
+        if self.labeled and y is None:
+            raise ValueError("labeled pod: submit(x, y) needs a class label")
+        if not self.labeled and y is not None:
+            raise ValueError("unlabeled pod: submit() must not carry a label")
+        x = np.asarray(x, np.float32)
+        bucket = self.table.select(x.shape)  # NoBucketError pre-queue
+        now = time.perf_counter()
+        deadline_at = now + deadline_ms / 1e3 if deadline_ms else None
+        req = _PodRequest(next(self._req_ids), x, y, bucket_key(bucket.shape),
+                          deadline_at, Future(), now)
+        if obs_tracing._STATE.enabled:
+            root = obs_tracing.start_span("request", cat="pod",
+                                          bucket=req.bkey)
+            req.ctx = root.context
+            req.future.add_done_callback(
+                lambda f: root.end(
+                    error=type(f.exception()).__name__ if f.exception()
+                    else None))
+            try:
+                self._route(req, raise_errors=True)
+            except Exception as e:
+                root.end(error=type(e).__name__)
+                raise
+        else:
+            self._route(req, raise_errors=True)
+        return req.future
+
+    def attribute(self, x, y=None, deadline_ms: float | None = None):
+        return self.submit(x, y, deadline_ms=deadline_ms).result()
+
+    def submit_with_retry(self, x, y=None, *, policy=None, stats=None,
+                          rng=None, deadline_ms: float | None = None) -> Future:
+        """`submit` driven by a `serve.retry.RetryPolicy` (the
+        `FleetServer.submit_with_retry` discipline one level up). Pass a
+        policy whose ``retry_on`` includes `NoLiveWorkerError` to ride
+        out total-outage windows during supervised respawns."""
+        from wam_tpu.serve.retry import RetryPolicy
+
+        policy = policy if policy is not None else RetryPolicy()
+        outer: Future = Future()
+
+        def _submit(remaining_s):
+            per_attempt = deadline_ms
+            if remaining_s is not None:
+                rem_ms = remaining_s * 1e3
+                per_attempt = (rem_ms if per_attempt is None
+                               else min(per_attempt, rem_ms))
+            return self.submit(x, y, deadline_ms=per_attempt)
+
+        def _drive():
+            try:
+                outer.set_result(policy.run(_submit, rng=rng, stats=stats))
+            except BaseException as e:  # noqa: BLE001 - future carries it
+                outer.set_exception(e)
+
+        threading.Thread(target=_drive, daemon=True,
+                         name="wam-pod-retry-driver").start()
+        return outer
+
+    # -- routing ------------------------------------------------------------
+
+    def _respawn_hint_s(self) -> float | None:
+        """How long until a worker is plausibly serving again: the
+        supervisor's pending-restart ETA plus the spawn-time EMA. None
+        when unsupervised (nobody is coming back)."""
+        if self._supervisor is None:
+            return None
+        eta = self._supervisor.pending_eta_s()
+        if eta is None and not self._supervisor.any_restartable():
+            return None
+        return max(0.0, eta or 0.0) + self._spawn_ema_s
+
+    def _score(self, w: _Worker, bkey: str) -> float:
+        s = w.snapshot
+        if s is None:
+            return float("inf")
+        ema = s.ema_service_s.get(bkey)
+        if ema is None:
+            ema = (sum(s.ema_service_s.values()) / len(s.ema_service_s)
+                   if s.ema_service_s else EMA_SEED_S)
+        with w.inflight_lock:
+            inflight = len(w.inflight)
+        return s.projected_drain_s + inflight * ema + s.slo_penalty_s
+
+    def _route(self, req: _PodRequest, raise_errors: bool) -> None:
+        def _fail(exc: Exception) -> None:
+            if raise_errors:
+                raise exc
+            req.future.set_exception(exc)
+
+        with obs_tracing.use_context(req.ctx), obs_tracing.span(
+            "pod_admission", cat="pod", rerouted=bool(req.tried)
+        ):
+            return self._route_inner(req, _fail)
+
+    def _route_inner(self, req: _PodRequest, _fail) -> None:
+        with self._lock:
+            if self._closed:
+                return _fail(ServerClosedError("pod is not accepting requests"))
+            cands = [w for w in self._workers.values()
+                     if w.alive and not w.draining and w.ready.is_set()
+                     and w.wid not in req.tried]
+        if not cands:
+            if req.min_retry_after is not None:
+                # every live worker rejected: aggregated backpressure
+                return _fail(QueueFullError(req.min_retry_after))
+            return _fail(NoLiveWorkerError(
+                "no live pod worker left for this request",
+                retry_after_s=self._respawn_hint_s()))
+        if req.deadline_at is not None:
+            remaining_ms = (req.deadline_at - time.perf_counter()) * 1e3
+            if remaining_ms <= 0.0:
+                return _fail(
+                    DeadlineExceededError("deadline lapsed during re-route"))
+        else:
+            remaining_ms = None
+        cands.sort(key=lambda w: (self._score(w, req.bkey), w.wid))
+        quarantined = {w.wid: (w.snapshot.quarantined if w.snapshot else False)
+                       for w in cands}
+        if any(quarantined.values()):
+            cands = ([w for w in cands if not quarantined[w.wid]]
+                     + [w for w in cands if quarantined[w.wid]])
+        for w in cands:
+            with w.inflight_lock:
+                if not w.alive:
+                    continue
+                w.inflight[req.req_id] = req
+            try:
+                w.chan.send({
+                    "op": "submit", "req_id": req.req_id, "x": req.x,
+                    "y": req.y, "deadline_ms": remaining_ms, "ctx": req.ctx,
+                })
+            except (OSError, AttributeError):
+                # died between the candidate snapshot and the send: undo
+                # and fall through to the next candidate (its death path
+                # runs via the receiver/heartbeat threads)
+                with w.inflight_lock:
+                    w.inflight.pop(req.req_id, None)
+                continue
+            return
+        return _fail(NoLiveWorkerError(
+            "every live pod worker refused this request",
+            retry_after_s=self._respawn_hint_s()))
+
+    def _on_result(self, w: _Worker, msg: dict) -> None:
+        with w.inflight_lock:
+            req = w.inflight.pop(msg["req_id"], None)
+        if req is None:
+            return  # already re-routed by a racing death path
+        if msg.get("ok"):
+            self.metrics.note_request(time.perf_counter() - req.t_submit)
+            req.future.set_result(msg.get("value"))
+            return
+        exc = decode_error(msg.get("error") or {})
+        if isinstance(exc, QueueFullError):
+            # worker-level backpressure: try the rest of the pod, keeping
+            # the smallest retry_after offered (fleet aggregation rule)
+            req.tried.add(w.wid)
+            ra = getattr(exc, "retry_after_s", None) or 0.0
+            req.min_retry_after = (ra if req.min_retry_after is None
+                                   else min(req.min_retry_after, ra))
+            self._route(req, raise_errors=False)
+            return
+        if isinstance(exc, ServerClosedError):
+            # the WORKER's fleet closed under the request (its own
+            # supervisor restarting a replica, or shutdown racing in):
+            # liveness, not semantics — re-route
+            req.tried.add(w.wid)
+            self._route(req, raise_errors=False)
+            return
+        req.future.set_exception(exc)
+
+    # -- reporting ----------------------------------------------------------
+
+    def pod_summary(self) -> dict:
+        with self._lock:
+            workers = list(self._workers.values())
+        return self.metrics.pod_summary(workers)
+
+    def trace_events(self) -> list[dict]:
+        """Worker spans shipped at close, re-based onto the router's
+        perf_counter via each worker's heartbeat clock offset — ready for
+        `obs.export_chrome_trace(path, extra_events=...)`."""
+        events: list[dict] = []
+        with self._lock:
+            workers = list(self._workers.values())
+        for w in workers:
+            if not w.spans:
+                continue
+            pid = (w.final_snapshot.pid if w.final_snapshot is not None
+                   else (w.proc.pid if w.proc is not None else -w.wid))
+            events.extend(obs_tracing.spans_to_events(
+                w.spans, pid=pid, clock_offset_s=w.clock_offset_s,
+                process_name=f"pod-worker-{w.wid}"))
+        return events

@@ -1,0 +1,288 @@
+"""Pod worker process: one `FleetServer` behind a control channel.
+
+``python -m wam_tpu.pod.worker --connect HOST:PORT --worker-id K ...``
+is what `wam_tpu.pod.router.PodRouter` spawns N times. Each worker is a
+full, independent failure domain: its own Python process, its own jax
+runtime, its own `FleetServer` (replica supervision, health plane, SLO
+tracking, registry hydration all included) — a SIGKILL here costs the
+pod one worker, not the service.
+
+Lifecycle:
+
+1. Backend select. ``--device cpu`` must call
+   ``jax.config.update("jax_platforms", "cpu")`` ITSELF — workers are
+   bare subprocesses, nothing like tests/conftest.py runs first, and on
+   hosts with an accelerator plugin the ``JAX_PLATFORMS`` env var alone
+   is ignored (the plugin force-selects at registration).
+2. Optional multi-host bring-up: ``--coordinator`` routes through the
+   hardened `parallel.multihost.init_distributed` (bounded connect
+   retries, coordinator named in the timeout error).
+3. Build + warm the fleet. ``--registry BUNDLE`` hydrates compiled
+   artifacts before warmup — this is what makes a supervisor respawn
+   rejoin in seconds at zero compiles instead of re-tracing everything.
+4. Dial the router, send ``hello`` (readiness == liveness), then serve
+   the channel: ``submit`` ops run under the router's trace context so
+   worker-side spans join the request's cross-process timeline,
+   ``health`` ops answer with a `WorkerSnapshot`, ``close`` drains and
+   ships the span ring back for the merged trace export.
+
+The span-id counter is namespaced by pid (`obs.tracing.namespace_ids`)
+so ids minted here never collide with the router's when the traces merge.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+from wam_tpu.pod.protocol import (
+    Channel,
+    WorkerSnapshot,
+    connect_to_router,
+    encode_error,
+)
+
+__all__ = ["main", "build_worker_server"]
+
+
+def _parse(argv):
+    p = argparse.ArgumentParser(
+        prog="wam_tpu.pod.worker", description=__doc__)
+    p.add_argument("--connect", required=True, metavar="HOST:PORT",
+                   help="router control-channel address")
+    p.add_argument("--worker-id", type=int, required=True)
+    p.add_argument("--device", default="cpu")
+    p.add_argument("--fleet", type=int, default=1,
+                   help="replica servers inside this worker (one per chip)")
+    p.add_argument("--buckets", default="1x16x16",
+                   help="admitted item shapes, ServeConfig grammar")
+    p.add_argument("--max-batch", type=int, default=8)
+    p.add_argument("--max-wait-ms", type=float, default=5.0)
+    p.add_argument("--queue-depth", type=int, default=64)
+    p.add_argument("--fake-entry", type=float, default=None, metavar="MS",
+                   help="fixed-cost fake entry instead of the toy model")
+    p.add_argument("--n-samples", type=int, default=2,
+                   help="SmoothGrad samples for the toy entry")
+    p.add_argument("--aot-key-base", default="",
+                   help="AOT-key the toy entry (registry/executable cache)")
+    p.add_argument("--registry", default="",
+                   help="compile-artifact bundle to hydrate before warmup")
+    p.add_argument("--chaos", default="",
+                   help="in-process fault spec (wam_tpu.testing.faults)")
+    p.add_argument("--slo", default="")
+    p.add_argument("--metrics-path", default="")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--coordinator", default="",
+                   help="multi-host coordinator address (init_distributed)")
+    p.add_argument("--num-processes", type=int, default=None)
+    p.add_argument("--process-id", type=int, default=None)
+    return p.parse_args(argv)
+
+
+class _FakeEntry:
+    """Fixed-service-time entry (the bench_serve fake, process-local
+    copy): one 'compile' per new input shape, one GIL-releasing sleep per
+    batch — pod scaling curves measure routing, not model contention."""
+
+    def __init__(self, metrics, ms: float):
+        import threading
+
+        self._metrics = metrics
+        self._seen = set()
+        self._lock = threading.Lock()
+        self._s = ms / 1e3
+
+    def __call__(self, xs, ys):
+        import numpy as np
+
+        shape = tuple(int(d) for d in xs.shape)
+        with self._lock:
+            if shape not in self._seen:
+                self._seen.add(shape)
+                self._metrics.note_compile()
+        time.sleep(self._s)
+        return np.zeros(shape, np.float32)
+
+
+def build_worker_server(args, fleet_metrics):
+    """Construct (not yet started) the worker's `FleetServer` from parsed
+    args — the same recipe for first spawn and supervisor respawns."""
+    import jax
+
+    from wam_tpu.config import ServeConfig
+    from wam_tpu.serve import FleetServer, SupervisorConfig
+
+    buckets = ServeConfig(buckets=args.buckets).bucket_shapes()
+    if args.fake_entry is not None:
+        entry_factory = lambda rid, m: _FakeEntry(m, args.fake_entry)
+    else:
+        from wam_tpu.models.toy import toy_conv_model
+        from wam_tpu.wam2d import WaveletAttribution2D
+
+        toy = toy_conv_model(jax.random.PRNGKey(0), ndim=2)
+        wam = WaveletAttribution2D(
+            lambda x: toy(x.mean(axis=1)), J=2,
+            n_samples=args.n_samples, sample_batch_size=None)
+        if args.aot_key_base or args.registry:
+            base = (args.aot_key_base
+                    or f"pod_worker|toy2d|J2|n{args.n_samples}|mb{args.max_batch}")
+
+            def entry_factory(rid, m, _wam=wam, _base=base):
+                from wam_tpu.serve import OVERSIZE_ENTRY_ID, fleet_aot_key
+
+                key = (fleet_aot_key(_base, args.fleet)
+                       if rid == OVERSIZE_ENTRY_ID else _base)
+                return _wam.serve_entry(on_trace=m.note_compile, aot_key=key)
+        else:
+            entry_factory = lambda rid, m: wam.serve_entry(
+                on_trace=m.note_compile)
+    if args.chaos and args.chaos not in ("off", "none"):
+        from wam_tpu.testing import ChaosSchedule
+
+        entry_factory = ChaosSchedule(
+            args.chaos, seed=args.seed).wrap_factory(entry_factory)
+    return FleetServer(
+        entry_factory,
+        buckets,
+        replicas=max(1, args.fleet),
+        max_batch=args.max_batch,
+        max_wait_ms=args.max_wait_ms,
+        queue_depth=args.queue_depth,
+        metrics=fleet_metrics,
+        metrics_path=args.metrics_path or None,
+        slo=args.slo or None,
+        supervise=SupervisorConfig(seed=args.seed),
+        registry=args.registry or None,
+        auto_start=False,
+    )
+
+
+def main(argv=None) -> int:
+    t_start = time.perf_counter()
+    args = _parse(argv if argv is not None else sys.argv[1:])
+
+    import jax
+
+    from wam_tpu.config import select_backend
+
+    select_backend(args.device)
+    if args.device == "cpu":
+        # bare subprocess: repeat the conftest/bench backend pin — the env
+        # var alone loses to an installed accelerator plugin
+        jax.config.update("jax_platforms", "cpu")
+    if args.coordinator:
+        from wam_tpu.parallel.multihost import init_distributed
+
+        init_distributed(coordinator_address=args.coordinator,
+                         num_processes=args.num_processes,
+                         process_id=args.process_id)
+
+    from wam_tpu.obs import sentinel as obs_sentinel
+    from wam_tpu.obs import tracing as obs_tracing
+    from wam_tpu.serve import FleetMetrics
+
+    # cross-process span ids: offset this process's counter by pid so the
+    # merged pod trace never sees two spans with one id
+    obs_tracing.namespace_ids(os.getpid())
+
+    fleet_metrics = FleetMetrics()
+    server = build_worker_server(args, fleet_metrics)
+    server.start()
+    warm_s = time.perf_counter() - t_start
+    warm_traces = obs_sentinel.trace_count()
+
+    def snapshot() -> WorkerSnapshot:
+        sig = server.pod_signals()
+        return WorkerSnapshot(
+            worker_id=args.worker_id,
+            pid=os.getpid(),
+            t_worker=time.perf_counter(),
+            projected_drain_s=sig["projected_drain_s"],
+            ema_service_s=sig["ema_service_s"],
+            slo_penalty_s=sig["slo_penalty_s"],
+            quarantined=sig["quarantined"],
+            live_replicas=sig["live_replicas"],
+            dead_replicas=sig["dead_replicas"],
+            submitted=sig["submitted"],
+            completed=sig["completed"],
+            compile_count=sig["compile_count"],
+            post_warm_compiles=obs_sentinel.trace_count() - warm_traces,
+            warm_s=warm_s,
+        )
+
+    chan = connect_to_router(args.connect)
+    chan.send({
+        "op": "hello",
+        "worker_id": args.worker_id,
+        "pid": os.getpid(),
+        "snapshot": snapshot(),
+        "buckets": args.buckets,
+    })
+
+    def _send_result(req_id, fut) -> None:
+        try:
+            exc = fut.exception()
+            if exc is None:
+                chan.send({"op": "result", "req_id": req_id, "ok": True,
+                           "value": fut.result()})
+            else:
+                chan.send({"op": "result", "req_id": req_id, "ok": False,
+                           "error": encode_error(exc)})
+        except OSError:
+            pass  # router vanished mid-reply; the pod supervisor owns us
+
+    graceful = False
+    while True:
+        try:
+            msg = chan.recv()
+        except (EOFError, OSError):
+            break  # router gone: drain and exit (supervised by the pod)
+        op = msg.get("op")
+        if op == "submit":
+            req_id = msg["req_id"]
+            ctx = tuple(msg["ctx"]) if msg.get("ctx") else None
+            try:
+                # the router's trace context re-established on this side of
+                # the process boundary: every span the serve runtime opens
+                # for this request joins the router's timeline
+                with obs_tracing.use_context(ctx):
+                    fut = server.submit(msg["x"], msg.get("y"),
+                                        deadline_ms=msg.get("deadline_ms"))
+            except Exception as e:  # noqa: BLE001 - typed over the wire
+                _send_result(req_id, _failed_future(e))
+                continue
+            fut.add_done_callback(
+                lambda f, rid=req_id: _send_result(rid, f))
+        elif op == "health":
+            try:
+                chan.send({"op": "health_reply", "t_send": msg["t_send"],
+                           "t_worker": time.perf_counter(),
+                           "snapshot": snapshot()})
+            except OSError:
+                break
+        elif op == "close":
+            graceful = True
+            break
+    server.close(emit_metrics=bool(args.metrics_path))
+    if graceful:
+        try:
+            chan.send({"op": "bye", "snapshot": snapshot(),
+                       "spans": obs_tracing.spans()})
+        except OSError:
+            pass
+    chan.close()
+    return 0
+
+
+def _failed_future(exc):
+    from concurrent.futures import Future
+
+    f = Future()
+    f.set_exception(exc)
+    return f
+
+
+if __name__ == "__main__":
+    sys.exit(main())

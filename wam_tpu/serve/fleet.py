@@ -90,7 +90,7 @@ from wam_tpu.serve.buckets import (
     bucket_key,
     pad_item,
 )
-from wam_tpu.serve.metrics import FleetMetrics, ServeMetrics
+from wam_tpu.serve.metrics import EMA_SEED_S, FleetMetrics, ServeMetrics
 from wam_tpu.serve.runtime import (
     AttributionServer,
     DeadlineExceededError,
@@ -107,7 +107,16 @@ OVERSIZE_ENTRY_ID = "fleet"
 
 class NoLiveReplicaError(ServeError):
     """Every replica is dead (or rejected this request after deaths) — the
-    fleet cannot serve it."""
+    fleet cannot serve it RIGHT NOW. ``retry_after_s`` estimates when a
+    supervised restart will have a replica back (None when the fleet is
+    unsupervised or every dead replica escalated to permanent): with it,
+    `serve.retry.RetryPolicy` floors its backoff at the restart window
+    and treats fleet-wide death as backpressure instead of exhausting
+    its attempts against a fleet that is seconds from recovering."""
+
+    def __init__(self, msg: str, retry_after_s: float | None = None):
+        super().__init__(msg)
+        self.retry_after_s = retry_after_s
 
 
 @dataclass
@@ -437,6 +446,60 @@ class FleetServer:
                          or (str(self._registry) if self._registry else None)),
         }
 
+    def _restart_hint_s(self) -> float | None:
+        """How long a client should wait for a supervised restart to put a
+        replica back: the supervisor's worst-case backoff (every dead
+        replica restarts within it). None when nobody is coming back —
+        unsupervised fleet, or every dead replica escalated permanent."""
+        if self._supervisor is None:
+            return None
+        with self._lock:
+            dead = [r.rid for r in self._replicas if not r.alive]
+        if dead and all(self._supervisor.permanently_dead(rid) for rid in dead):
+            return None
+        cfg = self._supervisor.config
+        return cfg.backoff_cap_s * (1.0 + cfg.jitter_frac)
+
+    def pod_signals(self) -> dict:
+        """The health-plane aggregate a pod worker ships in its heartbeat
+        `WorkerSnapshot` — the same quantities `_score` routes on, rolled
+        up to whole-fleet granularity for the tier above (the pod router
+        scores worker PROCESSES the way this fleet scores replicas).
+        Drain is the best live replica's (the fleet itself routes new work
+        there); EMAs are per-bucket means over live replicas; the SLO
+        penalty is the worst bucket's mean; ``quarantined`` only when
+        EVERY live replica is (a partially-quarantined fleet still takes
+        front-door traffic)."""
+        with self._lock:
+            replicas = list(self._replicas)
+        live = [r for r in replicas if r.alive]
+        ema: dict[str, float] = {}
+        penalties: list[float] = []
+        for b in self.table:
+            vals = [r.metrics.ema_service_s(b.shape) for r in live]
+            ema[b.key] = sum(vals) / len(vals) if vals else EMA_SEED_S
+            pen = [r.server.slo_penalty_s(b.shape) for r in live]
+            if pen:
+                penalties.append(sum(pen) / len(pen))
+        snaps = [r.metrics.snapshot() for r in replicas]
+        os_snap = self.metrics.oversize.snapshot()
+        return {
+            "projected_drain_s": min(
+                (r.server.projected_drain_s() for r in live), default=0.0),
+            "ema_service_s": ema,
+            "slo_penalty_s": max(penalties, default=0.0),
+            "quarantined": bool(live)
+            and not any(r.server.health_ok() for r in live),
+            "live_replicas": len(live),
+            "dead_replicas": len(replicas) - len(live),
+            "submitted": sum(s["submitted"] for s in snaps)
+            + os_snap["submitted"],
+            "completed": sum(s["completed"] for s in snaps)
+            + os_snap["completed"],
+            "compile_count": sum(s["compile_count"] for s in snaps)
+            + os_snap["compile_count"],
+        }
+
     # -- client side --------------------------------------------------------
 
     def submit(self, x, y=None, deadline_ms: float | None = None) -> Future:
@@ -588,7 +651,9 @@ class FleetServer:
                 return _fail(ServerClosedError("fleet is not accepting requests"))
             cands = [r for r in self._replicas if r.alive and r.rid not in req.tried]
         if not cands:
-            return _fail(NoLiveReplicaError("no live replica left for this request"))
+            return _fail(NoLiveReplicaError(
+                "no live replica left for this request",
+                retry_after_s=self._restart_hint_s()))
         if req.deadline_at is not None:
             remaining_ms = (req.deadline_at - time.perf_counter()) * 1e3
             if remaining_ms <= 0.0:
@@ -619,7 +684,9 @@ class FleetServer:
             return
         if retry_after is not None:
             return _fail(QueueFullError(retry_after))
-        return _fail(NoLiveReplicaError("every live replica refused this request"))
+        return _fail(NoLiveReplicaError(
+            "every live replica refused this request",
+            retry_after_s=self._restart_hint_s()))
 
     def _harvest(self, inner: Future, replica: _Replica, req: _FleetRequest) -> None:
         """Future callback (runs on the replica's worker thread): forward

@@ -11,6 +11,7 @@ from wam_tpu.testing.faults import (
     ChaosSchedule,
     FaultInjector,
     FaultSpec,
+    PodChaosKiller,
     parse_chaos,
     stager_chaos,
 )
@@ -21,6 +22,7 @@ __all__ = [
     "DEFAULT_CHAOS",
     "FaultInjector",
     "FaultSpec",
+    "PodChaosKiller",
     "parse_chaos",
     "stager_chaos",
 ]
