@@ -1,0 +1,364 @@
+"""Round-13 admission layer: the coalescing window, QoS lanes, the
+content-addressed result cache, per-bucket backpressure estimates, the
+occupancy histogram, and class-dimensioned SLOs.
+
+Timing-sensitive window tests use WIDE margins (a 300 ms window asserted
+against a <100 ms fast path) so they stay deterministic on loaded CI
+hosts; everything queue-shaped goes through the gated-entry handshake
+idiom from tests/test_serve.py instead of sleeps."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from wam_tpu.serve import (
+    AttributionServer,
+    DeadlineExceededError,
+    QueueFullError,
+    ResultCache,
+    ServeMetrics,
+    result_cache_key,
+)
+
+
+class _RecordingEntry:
+    """Instant entry that records each dispatched batch's labels."""
+
+    def __init__(self):
+        self.batches = []
+
+    def __call__(self, xs, ys):
+        self.batches.append(None if ys is None else [int(y) for y in ys])
+        return np.asarray(xs) * 2.0
+
+
+class _GateEntry:
+    """Parks the worker inside the dispatch until released (the
+    deterministic queue-buildup handshake)."""
+
+    def __init__(self):
+        self.entered = threading.Event()
+        self.release = threading.Event()
+        self.batches = []
+
+    def __call__(self, xs, ys):
+        self.batches.append(None if ys is None else [int(y) for y in ys])
+        self.entered.set()
+        assert self.release.wait(timeout=10), "test gate never released"
+        return np.asarray(xs) * 2.0
+
+
+def _x(fill=0.0, n=4):
+    return np.full((n,), fill, np.float32)
+
+
+# -- coalescing window --------------------------------------------------------
+
+
+def test_full_batch_releases_before_window():
+    """A full bucket dispatches immediately — the window is a cap on
+    waiting for fill, never a tax on already-full batches."""
+    entry = _RecordingEntry()
+    server = AttributionServer(
+        entry, [(4,)], max_batch=2, coalesce_ms=5000.0, warmup=False)
+    try:
+        t0 = time.perf_counter()
+        a = server.submit(_x(1.0), 0)
+        b = server.submit(_x(2.0), 1)
+        a.result(timeout=10), b.result(timeout=10)
+        assert time.perf_counter() - t0 < 2.0  # nowhere near the 5 s window
+        assert entry.batches == [[0, 1]]  # one coalesced dispatch
+    finally:
+        server.close()
+
+
+def test_partial_batch_held_for_the_window():
+    entry = _RecordingEntry()
+    server = AttributionServer(
+        entry, [(4,)], max_batch=8, coalesce_ms=300.0, warmup=False)
+    try:
+        t0 = time.perf_counter()
+        fut = server.submit(_x(), 0)
+        fut.result(timeout=10)
+        elapsed = time.perf_counter() - t0
+        assert elapsed >= 0.25  # held ~the window before dispatching alone
+    finally:
+        server.close()
+    # control: coalesce_ms=0 is the historical immediate-ish path
+    entry0 = _RecordingEntry()
+    server0 = AttributionServer(
+        entry0, [(4,)], max_batch=8, coalesce_ms=0.0, max_wait_ms=0.0,
+        warmup=False)
+    try:
+        t0 = time.perf_counter()
+        server0.submit(_x(), 0).result(timeout=10)
+        assert time.perf_counter() - t0 < 0.25
+    finally:
+        server0.close()
+
+
+def test_deadline_pressure_releases_window_early():
+    """A tight queued deadline collapses the window: the dispatch goes as
+    soon as waiting longer would risk the deadline, not at window expiry."""
+    entry = _RecordingEntry()
+    server = AttributionServer(
+        entry, [(4,)], max_batch=8, coalesce_ms=10_000.0, warmup=False)
+    try:
+        t0 = time.perf_counter()
+        fut = server.submit(_x(), 0, deadline_ms=200.0)
+        np.testing.assert_array_equal(fut.result(timeout=10), _x() * 2.0)
+        assert time.perf_counter() - t0 < 5.0  # far inside the 10 s window
+        assert entry.batches  # actually dispatched, not expired
+    finally:
+        server.close()
+
+
+def test_deadline_expiring_inside_window_fails_before_dispatch():
+    """Satellite: a request whose deadline lapses while the window holds
+    it fails with DeadlineExceededError at pop time — it never burns a
+    batch slot and the worker never dispatches for it."""
+    entry = _RecordingEntry()
+    server = AttributionServer(
+        entry, [(4,)], max_batch=8, coalesce_ms=10_000.0, warmup=False)
+    try:
+        fut = server.submit(_x(), 0, deadline_ms=0.001)  # lapses instantly
+        with pytest.raises(DeadlineExceededError):
+            fut.result(timeout=10)
+        assert entry.batches == []  # no dispatch happened for the expiry
+        assert server.metrics.expired == 1
+        assert server.metrics.completed == 0
+    finally:
+        server.close()
+
+
+# -- QoS lanes ----------------------------------------------------------------
+
+
+def test_interactive_lane_drains_first_batch_backfills():
+    entry = _GateEntry()
+    server = AttributionServer(
+        entry, [(4,)], max_batch=4, max_wait_ms=0.0, warmup=False)
+    try:
+        first = server.submit(_x(), 9, qos="batch")
+        assert entry.entered.wait(timeout=10)  # worker parked in dispatch
+        lag = server.submit(_x(), 1, qos="batch")
+        pri = server.submit(_x(), 2, qos="interactive")
+        assert server.qos_depths() == {"interactive": 1, "batch": 1}
+        entry.release.set()
+        for f in (first, lag, pri):
+            f.result(timeout=10)
+        # second dispatch: the younger interactive row leads, batch
+        # backfills (trailing rows are replicate-batch padding)
+        assert entry.batches[0][0] == 9
+        assert entry.batches[1][:2] == [2, 1]
+    finally:
+        entry.release.set()
+        server.close()
+
+
+def test_submit_rejects_unknown_qos_class():
+    server = AttributionServer(
+        _RecordingEntry(), [(4,)], max_batch=2, warmup=False)
+    try:
+        with pytest.raises(ValueError, match="qos"):
+            server.submit(_x(), 0, qos="bulk")
+    finally:
+        server.close()
+
+
+def test_retry_after_reflects_target_bucket_not_fleet_sum():
+    """Satellite: QueueFullError.retry_after_s is the REJECTED bucket's
+    projected drain, not the sum over every bucket — a rejection against
+    a nearly-empty bucket must not quote the busy bucket's backlog."""
+    entry = _GateEntry()
+    server = AttributionServer(
+        entry, [(4,), (8,)], max_batch=1, max_wait_ms=0.0, queue_depth=4,
+        warmup=False)
+    try:
+        server.submit(_x(), 0)  # bucket (4,): worker parks here
+        assert entry.entered.wait(timeout=10)
+        for _ in range(3):
+            server.submit(_x(), 0)  # bucket (4,) backlog
+        server.submit(_x(n=8), 0)  # bucket (8,): depth limit reached
+        with pytest.raises(QueueFullError) as ei:
+            server.submit(_x(n=8), 0)
+        # (8,)-drain: 1 queued batch at the 50 ms EMA seed. The all-bucket
+        # sum (>= 4 batches + in-flight) would quote >= 4x that.
+        assert 0.0 < ei.value.retry_after_s <= 0.12
+    finally:
+        entry.release.set()
+        server.close()
+
+
+# -- result cache: unit level -------------------------------------------------
+
+
+def test_result_cache_lru_respects_byte_budget():
+    cache = ResultCache(max_bytes=3 * 400, cache_id="unit")
+    rows = {f"k{i}": np.full((100,), float(i), np.float32) for i in range(5)}
+    for k, v in rows.items():
+        assert cache.put(k, v)
+    assert len(cache) == 3 and cache.total_bytes <= 3 * 400
+    assert cache.stats()["evictions"] == 2
+    assert cache.get("k0") is None and cache.get("k1") is None  # LRU'd out
+    np.testing.assert_array_equal(cache.get("k4"), rows["k4"])
+    # a get refreshes recency: k2 survives the next insert, k3 does not
+    cache.get("k2")
+    cache.put("k5", np.zeros((100,), np.float32))
+    assert cache.get("k3") is None
+    assert cache.get("k2") is not None
+
+
+def test_result_cache_refuses_oversized_value():
+    cache = ResultCache(max_bytes=100, cache_id="unit")
+    assert not cache.put("big", np.zeros((1000,), np.float32))
+    assert len(cache) == 0 and cache.total_bytes == 0
+
+
+def test_result_cache_key_separates_shape_dtype_label_and_id():
+    x = np.arange(4, dtype=np.float32)
+    base = result_cache_key(x, 0, "m1")
+    assert result_cache_key(x.copy(), 0, "m1") == base  # content-addressed
+    assert result_cache_key(x.reshape(2, 2), 0, "m1") != base
+    assert result_cache_key(x.astype(np.float64), 0, "m1") != base
+    assert result_cache_key(x, 1, "m1") != base
+    assert result_cache_key(x, 0, "m2") != base
+
+
+def test_result_cache_key_tracks_schedule_fingerprint(tmp_path, monkeypatch):
+    """A tuned schedule landing (or the schedule kill switch flipping)
+    changes every key — stale-schedule hits are structurally impossible."""
+    from wam_tpu.tune import invalidate_process_cache, record_schedule
+
+    monkeypatch.setenv("WAM_TPU_SCHEDULE_CACHE", str(tmp_path / "sched.json"))
+    monkeypatch.delenv("WAM_TPU_NO_SCHEDULE_CACHE", raising=False)
+    invalidate_process_cache()
+    try:
+        x = np.arange(4, dtype=np.float32)
+        before = result_cache_key(x, 0, "m")
+        record_schedule("wam2d", (1, 4, 4), 8, {"sample_batch_size": 4})
+        after = result_cache_key(x, 0, "m")
+        assert after != before
+        monkeypatch.setenv("WAM_TPU_NO_SCHEDULE_CACHE", "1")
+        assert result_cache_key(x, 0, "m") not in (before, after)
+    finally:
+        invalidate_process_cache()
+
+
+def test_result_cache_kill_switch(monkeypatch):
+    cache = ResultCache(max_bytes=1 << 20, cache_id="unit")
+    monkeypatch.setenv("WAM_TPU_NO_RESULT_CACHE", "1")
+    assert not cache.put("k", np.zeros((4,), np.float32))
+    assert cache.get("k") is None
+    assert cache.stats()["disabled"]
+    monkeypatch.setenv("WAM_TPU_NO_RESULT_CACHE", "0")  # read per call
+    assert cache.put("k", np.zeros((4,), np.float32))
+    assert cache.get("k") is not None
+
+
+# -- result cache: through the server -----------------------------------------
+
+
+def test_repeat_submit_hits_cache_bit_identically():
+    entry = _RecordingEntry()
+    metrics = ServeMetrics()
+    server = AttributionServer(
+        entry, [(4,)], max_batch=2, warmup=False, metrics=metrics,
+        result_cache=1 << 20, cache_id="toy")
+    try:
+        x = _x(3.0)
+        r1 = server.submit(x, 1).result(timeout=10)
+        r2 = server.submit(x, 1).result(timeout=10)
+        np.testing.assert_array_equal(r1, r2)  # bit-identical replay
+        assert len(entry.batches) == 1  # second submit never dispatched
+        assert metrics.cache_hits == 1
+        assert server.describe()["result_cache"]["hits"] == 1
+        # different label: a real miss, not a collision
+        server.submit(x, 2).result(timeout=10)
+        assert len(entry.batches) == 2
+    finally:
+        server.close()
+    snap = metrics.snapshot()
+    assert snap["cache_hits"] == 1
+    assert snap["completed"] == 2  # hits resolve outside the dispatch path
+
+
+def test_server_cache_kill_switch_forces_recompute(monkeypatch):
+    monkeypatch.setenv("WAM_TPU_NO_RESULT_CACHE", "1")
+    entry = _RecordingEntry()
+    server = AttributionServer(
+        entry, [(4,)], max_batch=2, warmup=False,
+        result_cache=1 << 20, cache_id="toy")
+    try:
+        x = _x(3.0)
+        server.submit(x, 1).result(timeout=10)
+        server.submit(x, 1).result(timeout=10)
+        assert len(entry.batches) == 2  # both computed
+        assert server.metrics.cache_hits == 0
+    finally:
+        server.close()
+
+
+# -- occupancy metric ---------------------------------------------------------
+
+
+def test_batch_rows_carry_occupancy_and_histogram(tmp_path):
+    from wam_tpu import obs
+
+    obs.reset()
+    path = tmp_path / "serve.jsonl"
+    metrics = ServeMetrics()
+    server = AttributionServer(
+        _RecordingEntry(), [(4,)], max_batch=4, max_wait_ms=0.0,
+        warmup=False, metrics=metrics, metrics_path=str(path))
+    try:
+        server.submit(_x(), 0).result(timeout=10)
+    finally:
+        server.close()
+    import json
+
+    rows = [json.loads(line) for line in path.read_text().splitlines()]
+    batch = next(r for r in rows if r["metric"] == "serve_batch")
+    assert batch["occupancy"] == pytest.approx(0.25)  # 1 real row of 4
+    assert batch["fill_ratio"] == batch["occupancy"]
+    summary = next(r for r in rows if r["metric"] == "serve_summary")
+    assert summary["occupancy_mean"] == pytest.approx(0.25)
+    assert "wam_tpu_serve_batch_occupancy" in obs.render_prom()
+
+
+# -- class-dimensioned SLOs ---------------------------------------------------
+
+
+def test_parse_slo_accepts_class_keys_and_rejects_empty_class():
+    from wam_tpu.obs.slo import parse_slo
+
+    policy = parse_slo("4@interactive: p99_ms=10; *@batch: p99_ms=100; "
+                       "*: p99_ms=50")
+    assert policy["4@interactive"].p99_ms == 10.0
+    assert policy["*@batch"].p99_ms == 100.0
+    with pytest.raises(ValueError, match="QoS class"):
+        parse_slo("4@: p99_ms=5")
+
+
+def test_slo_objective_ladder_and_class_penalty():
+    from wam_tpu.obs.slo import SLOTracker
+
+    t = SLOTracker("4@interactive: p99_ms=10, window_s=60; "
+                   "*@batch: p99_ms=500; *: p99_ms=100")
+    # ladder: exact -> *@class -> bare bucket -> *
+    assert t.objectives_for("4@interactive").p99_ms == 10.0
+    assert t.objectives_for("8@batch").p99_ms == 500.0
+    assert t.objectives_for("8@interactive").p99_ms == 100.0
+    assert t.objectives_for("8").p99_ms == 100.0
+    # every interactive sample blows its 10 ms target; the batch class is
+    # comfortably inside 500 ms — the per-class window must still penalize
+    # the bucket (max over class windows, not the diluted aggregate)
+    now = 1000.0
+    for i in range(20):
+        t.note("4", latency_s=0.05, qos="interactive", now=now + i * 0.01)
+        t.note("4", latency_s=0.05, qos="batch", now=now + i * 0.01)
+    assert t.burn_rate("4@interactive", now=now + 1) > 1.0
+    assert t.burn_rate("4@batch", now=now + 1) <= 1.0
+    assert t.penalty_s("4", now=now + 1) > 0.0

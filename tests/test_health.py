@@ -333,7 +333,9 @@ def test_server_emits_slo_status_ledger_row(tmp_path):
     rows = [json.loads(l) for l in open(path) if l.strip()]
     slo_rows = [r for r in rows if r["metric"] == "slo_status"]
     assert len(slo_rows) == 1
-    st = slo_rows[0]["buckets"]["4"]
+    # served requests land in the bucket@class window (QoS lanes); the
+    # default submit class is "interactive"
+    st = slo_rows[0]["buckets"]["4@interactive"]
     assert st["n"] == 5 and st["error_rate"] == 0.0
     assert st["burn_rate"] == 0.0  # well under both objectives
     assert slo_rows[0]["objectives"]["*"]["p99_ms"] == 1000.0

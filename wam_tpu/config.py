@@ -187,6 +187,15 @@ class ServeConfig:
 
     max_batch: int | str = 8  # rows per dispatched batch, or "auto" (tuned)
     max_wait_ms: float = 5.0
+    # cross-request admission window (serve/runtime "Coalescing"): hold a
+    # bucket's dispatch up to this long for batch fill, with deadline-
+    # pressure early release. 0 = historical max_wait-only behavior. ON by
+    # default for config-built servers — the open-loop round-13 A/B showed
+    # it is what amortizes the fixed per-dispatch tunnel cost.
+    coalesce_ms: float = 3.0
+    # content-addressed result cache budget (serve/result_cache), MB per
+    # server (fleet: one shared cache at the admission tier). 0 = off.
+    result_cache_mb: float = 64.0
     queue_depth: int = 64
     deadline_ms: float = 0.0  # 0 = no per-request deadline
     buckets: str = ""

@@ -30,6 +30,18 @@ Objective policies are declared as CLI-friendly strings in
 one objective set to every bucket; per-bucket overrides are
 ``;``-separated with a bucket-key prefix —
 ``"*:p99_ms=250;3x32x32:p99_ms=100,health_rate=0.99"``.
+
+**QoS class dimension** (the serve admission lanes): a key may carry an
+``@<class>`` suffix — ``"*@interactive:p99_ms=50;*:p99_ms=500"`` holds
+interactive traffic to a tight p99 while batch traffic rides the loose
+default. The serve worker notes each request with its class
+(``note(bkey, ..., qos="interactive")``), which lands the sample in the
+``<bucket>@<class>`` window, so burn rates stay per bucket×class.
+Objective resolution for a classed window walks ``bucket@class`` →
+``*@class`` → ``bucket`` → ``*``; class-less notes keep their historical
+plain-bucket windows and ladder. `penalty_s` aggregates a bucket's
+windows across classes (max burn), so the fleet's per-bucket routing
+penalty sees a violated class even when the bucket aggregate looks fine.
 """
 
 from __future__ import annotations
@@ -66,8 +78,10 @@ class SLObjectives:
 
 def parse_slo(spec) -> dict | None:
     """Parse a ``ServeConfig.slo`` policy string into a ``{bucket_key:
-    SLObjectives}`` map ('*' = default). Accepts an existing map or a bare
-    `SLObjectives` (becomes the '*' entry); returns None for empty specs."""
+    SLObjectives}`` map ('*' = default). Keys may carry an ``@<class>``
+    QoS suffix (``*@interactive``, ``3x32x32@batch`` — module docstring).
+    Accepts an existing map or a bare `SLObjectives` (becomes the '*'
+    entry); returns None for empty specs."""
     if spec is None or spec == "":
         return None
     if isinstance(spec, SLObjectives):
@@ -98,6 +112,8 @@ def parse_slo(spec) -> dict | None:
             if k not in ("p99_ms", "error_rate", "health_rate", "window_s"):
                 raise ValueError(f"unknown SLO objective {k!r} in {spec!r}")
             kwargs[k] = float(v)
+        if "@" in bucket and not bucket.rsplit("@", 1)[1]:
+            raise ValueError(f"empty QoS class in SLO key {bucket!r}")
         policy[bucket] = SLObjectives(**kwargs)
     return policy or None
 
@@ -139,21 +155,34 @@ class SLOTracker:
         self._last_publish = 0.0
 
     def objectives_for(self, bucket_key: str) -> SLObjectives | None:
-        return self.policy.get(bucket_key, self.policy.get("*"))
+        """Policy lookup for a (possibly class-suffixed) window key:
+        ``bucket@class`` → ``*@class`` → ``bucket`` → ``*``."""
+        obj = self.policy.get(bucket_key)
+        if obj is not None:
+            return obj
+        if "@" in bucket_key:
+            bare, qos = bucket_key.rsplit("@", 1)
+            for k in (f"*@{qos}", bare):
+                obj = self.policy.get(k)
+                if obj is not None:
+                    return obj
+        return self.policy.get("*")
 
     # -- note path (serve worker) -------------------------------------------
 
     def note(self, bucket_key: str, *, latency_s: float = 0.0,
              ok: bool = True, healthy: bool = True,
-             now: float | None = None) -> None:
-        """One resolved request. Errors and expiries go through
-        `note_error` (they have no meaningful latency sample)."""
-        if self.objectives_for(bucket_key) is None:
+             now: float | None = None, qos: str | None = None) -> None:
+        """One resolved request. ``qos`` lands the sample in the
+        ``bucket@class`` window (module docstring). Errors and expiries go
+        through `note_error` (they have no meaningful latency sample)."""
+        key = f"{bucket_key}@{qos}" if qos else bucket_key
+        if self.objectives_for(key) is None:
             return
         now = time.perf_counter() if now is None else now
         publish = False
         with self._lock:
-            self._windows.setdefault(bucket_key, deque()).append(
+            self._windows.setdefault(key, deque()).append(
                 (now, float(latency_s), bool(ok), bool(healthy)))
             if now - self._last_publish >= _PUBLISH_MIN_INTERVAL_S:
                 self._last_publish = now
@@ -162,14 +191,15 @@ class SLOTracker:
             self.snapshot_row(now=now)
 
     def note_error(self, bucket_key: str, n: int = 1,
-                   now: float | None = None) -> None:
+                   now: float | None = None, qos: str | None = None) -> None:
         """Failed/expired requests: counted against the error AND health
         budgets, no latency sample."""
-        if self.objectives_for(bucket_key) is None:
+        key = f"{bucket_key}@{qos}" if qos else bucket_key
+        if self.objectives_for(key) is None:
             return
         now = time.perf_counter() if now is None else now
         with self._lock:
-            w = self._windows.setdefault(bucket_key, deque())
+            w = self._windows.setdefault(key, deque())
             for _ in range(int(n)):
                 w.append((now, 0.0, False, False))
 
@@ -226,8 +256,17 @@ class SLOTracker:
 
     def penalty_s(self, bucket_key: str, now: float | None = None) -> float:
         """Routing penalty: seconds added to the fleet's load score while
-        this bucket burns over budget (0 at/below burn 1.0)."""
-        return max(0.0, self.burn_rate(bucket_key, now=now) - 1.0) * PENALTY_SCALE_S
+        this bucket burns over budget (0 at/below burn 1.0). Takes the MAX
+        burn across the bucket's windows — the aggregate window plus every
+        per-class one — so one violated class penalizes the bucket even
+        when the other class dilutes the aggregate."""
+        with self._lock:
+            keys = [k for k in self._windows
+                    if k == bucket_key or k.startswith(bucket_key + "@")]
+        if not keys:
+            keys = [bucket_key]
+        burn = max(self.burn_rate(k, now=now) for k in keys)
+        return max(0.0, burn - 1.0) * PENALTY_SCALE_S
 
     # -- snapshot (gauges + ledger row, same floats) ------------------------
 

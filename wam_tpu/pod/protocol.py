@@ -73,6 +73,7 @@ class WorkerSnapshot:
     t_worker: float  # worker perf_counter at snapshot time
     projected_drain_s: float = 0.0
     ema_service_s: dict = field(default_factory=dict)  # bucket key -> s
+    qos_depth: dict = field(default_factory=dict)  # QoS class -> queued items
     slo_penalty_s: float = 0.0
     quarantined: bool = False  # EVERY live replica quarantined
     live_replicas: int = 1

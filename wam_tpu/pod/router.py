@@ -57,6 +57,7 @@ from wam_tpu.pod.protocol import AUTHKEY_ENV, Channel, decode_error
 from wam_tpu.pod.supervisor import PodSupervisor
 from wam_tpu.serve.buckets import BucketTable, bucket_key
 from wam_tpu.serve.metrics import EMA_SEED_S
+from wam_tpu.serve.fleet import INTERACTIVE_DEPTH_WEIGHT
 from wam_tpu.serve.runtime import (
     DeadlineExceededError,
     QueueFullError,
@@ -98,6 +99,7 @@ class _PodRequest:
     deadline_at: float | None
     future: Future
     t_submit: float
+    qos: str = "interactive"
     tried: set = field(default_factory=set)
     min_retry_after: float | None = None
     ctx: tuple | None = None
@@ -519,8 +521,11 @@ class PodRouter:
 
     # -- client side --------------------------------------------------------
 
-    def submit(self, x, y=None, deadline_ms: float | None = None) -> Future:
-        """Admit one item and route it to the best live worker. The
+    def submit(self, x, y=None, deadline_ms: float | None = None,
+               qos: str = "interactive") -> Future:
+        """Admit one item and route it to the best live worker. ``qos``
+        rides the wire to the worker fleet's admission lanes (and weighs
+        into routing via each worker's heartbeat ``qos_depth``). The
         returned future survives worker death by re-routing; it fails
         typed (`QueueFullError` / `NoLiveWorkerError` / deadline) when
         the pod genuinely cannot take the work."""
@@ -533,7 +538,7 @@ class PodRouter:
         now = time.perf_counter()
         deadline_at = now + deadline_ms / 1e3 if deadline_ms else None
         req = _PodRequest(next(self._req_ids), x, y, bucket_key(bucket.shape),
-                          deadline_at, Future(), now)
+                          deadline_at, Future(), now, qos=qos)
         if obs_tracing._STATE.enabled:
             root = obs_tracing.start_span("request", cat="pod",
                                           bucket=req.bkey)
@@ -551,8 +556,9 @@ class PodRouter:
             self._route(req, raise_errors=True)
         return req.future
 
-    def attribute(self, x, y=None, deadline_ms: float | None = None):
-        return self.submit(x, y, deadline_ms=deadline_ms).result()
+    def attribute(self, x, y=None, deadline_ms: float | None = None,
+                  qos: str = "interactive"):
+        return self.submit(x, y, deadline_ms=deadline_ms, qos=qos).result()
 
     def submit_with_retry(self, x, y=None, *, policy=None, stats=None,
                           rng=None, deadline_ms: float | None = None) -> Future:
@@ -606,7 +612,12 @@ class PodRouter:
                    if s.ema_service_s else EMA_SEED_S)
         with w.inflight_lock:
             inflight = len(w.inflight)
-        return s.projected_drain_s + inflight * ema + s.slo_penalty_s
+        # heartbeat-reported queued-interactive depth weighs extra, the
+        # same discipline the in-process fleet applies per replica
+        # (serve.fleet.INTERACTIVE_DEPTH_WEIGHT) lifted one tier up
+        interactive_depth = (s.qos_depth or {}).get("interactive", 0)
+        return (s.projected_drain_s + inflight * ema + s.slo_penalty_s
+                + INTERACTIVE_DEPTH_WEIGHT * interactive_depth * ema)
 
     def _route(self, req: _PodRequest, raise_errors: bool) -> None:
         def _fail(exc: Exception) -> None:
@@ -655,6 +666,7 @@ class PodRouter:
                 w.chan.send({
                     "op": "submit", "req_id": req.req_id, "x": req.x,
                     "y": req.y, "deadline_ms": remaining_ms, "ctx": req.ctx,
+                    "qos": req.qos,
                 })
             except (OSError, AttributeError):
                 # died between the candidate snapshot and the send: undo

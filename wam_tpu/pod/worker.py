@@ -60,6 +60,10 @@ def _parse(argv):
                    help="admitted item shapes, ServeConfig grammar")
     p.add_argument("--max-batch", type=int, default=8)
     p.add_argument("--max-wait-ms", type=float, default=5.0)
+    p.add_argument("--coalesce-ms", type=float, default=0.0,
+                   help="cross-request admission window (0 = max-wait only)")
+    p.add_argument("--result-cache-mb", type=float, default=0.0,
+                   help="fleet-tier result cache budget in MB (0 = off)")
     p.add_argument("--queue-depth", type=int, default=64)
     p.add_argument("--fake-entry", type=float, default=None, metavar="MS",
                    help="fixed-cost fake entry instead of the toy model")
@@ -149,6 +153,8 @@ def build_worker_server(args, fleet_metrics):
         replicas=max(1, args.fleet),
         max_batch=args.max_batch,
         max_wait_ms=args.max_wait_ms,
+        coalesce_ms=getattr(args, "coalesce_ms", 0.0),
+        result_cache=int(getattr(args, "result_cache_mb", 0.0) * 2**20) or None,
         queue_depth=args.queue_depth,
         metrics=fleet_metrics,
         metrics_path=args.metrics_path or None,
@@ -201,6 +207,7 @@ def main(argv=None) -> int:
             t_worker=time.perf_counter(),
             projected_drain_s=sig["projected_drain_s"],
             ema_service_s=sig["ema_service_s"],
+            qos_depth=sig.get("qos_depth", {}),
             slo_penalty_s=sig["slo_penalty_s"],
             quarantined=sig["quarantined"],
             live_replicas=sig["live_replicas"],
@@ -249,7 +256,8 @@ def main(argv=None) -> int:
                 # for this request joins the router's timeline
                 with obs_tracing.use_context(ctx):
                     fut = server.submit(msg["x"], msg.get("y"),
-                                        deadline_ms=msg.get("deadline_ms"))
+                                        deadline_ms=msg.get("deadline_ms"),
+                                        qos=msg.get("qos", "interactive"))
             except Exception as e:  # noqa: BLE001 - typed over the wire
                 _send_result(req_id, _failed_future(e))
                 continue

@@ -22,8 +22,10 @@ from wam_tpu.serve.buckets import Bucket, BucketTable, NoBucketError, bucket_key
 from wam_tpu.serve.entry import fleet_aot_key, jit_entry
 from wam_tpu.serve.fleet import OVERSIZE_ENTRY_ID, FleetServer, NoLiveReplicaError
 from wam_tpu.serve.metrics import SCHEMA_VERSION, FleetMetrics, ServeMetrics, percentile_ms
+from wam_tpu.serve.result_cache import ResultCache, result_cache_key
 from wam_tpu.serve.retry import RetryBudgetExceededError, RetryPolicy, RetryStats
 from wam_tpu.serve.runtime import (
+    QOS_CLASSES,
     AttributionServer,
     DeadlineExceededError,
     MemoryAdmissionError,
@@ -57,6 +59,9 @@ __all__ = [
     "SCHEMA_VERSION",
     "OVERSIZE_ENTRY_ID",
     "percentile_ms",
+    "ResultCache",
+    "result_cache_key",
+    "QOS_CLASSES",
     "jit_entry",
     "fleet_aot_key",
     "bucket_key",

@@ -91,7 +91,9 @@ from wam_tpu.serve.buckets import (
     pad_item,
 )
 from wam_tpu.serve.metrics import EMA_SEED_S, FleetMetrics, ServeMetrics
+from wam_tpu.serve.result_cache import ResultCache
 from wam_tpu.serve.runtime import (
+    QOS_CLASSES,
     AttributionServer,
     DeadlineExceededError,
     QueueFullError,
@@ -99,10 +101,17 @@ from wam_tpu.serve.runtime import (
     ServerClosedError,
 )
 
-__all__ = ["FleetServer", "NoLiveReplicaError", "OVERSIZE_ENTRY_ID"]
+__all__ = ["FleetServer", "NoLiveReplicaError", "OVERSIZE_ENTRY_ID",
+           "INTERACTIVE_DEPTH_WEIGHT"]
 
 # entry_factory's replica_id for the fleet-wide oversize pjit entry
 OVERSIZE_ENTRY_ID = "fleet"
+
+# routing weight on a replica's queued-interactive depth (`_score`): each
+# max_batch worth of queued interactive work on a replica makes it look
+# this many bucket-EMAs busier, so latency-sensitive traffic spreads away
+# from interactive-loaded replicas harder than raw drain alone implies
+INTERACTIVE_DEPTH_WEIGHT = 0.5
 
 
 class NoLiveReplicaError(ServeError):
@@ -138,6 +147,10 @@ class _FleetRequest:
     bucket: Bucket
     deadline_at: float | None  # perf_counter timestamp, None = no deadline
     future: Future
+    qos: str = "interactive"
+    # fleet-tier result-cache key (None = cache off): computed once at
+    # submit, survives re-routes, populated from whichever replica wins
+    ckey: str | None = None
     tried: set = field(default_factory=set)
     # obs trace identity: every admission/queue/service span of this
     # request (including re-routes after a death) parents here
@@ -203,6 +216,16 @@ class FleetServer:
         cache wiped under a running fleet re-seeds instead of recompiling).
         Can also be passed to `start(registry=...)`. Same silent-miss
         fallback as `AttributionServer`.
+    coalesce_ms : per-replica cross-request admission window
+        (`AttributionServer` "Coalescing"); forwarded to every replica so
+        routed single-item submits pack into full bucket dispatches.
+    result_cache : ONE shared content-addressed result cache at the fleet
+        admission tier (int byte budget or a `ResultCache`): `submit`
+        consults it before routing (a hit costs no replica slot),
+        `_harvest` populates it from whichever replica computed the row.
+        Replicas themselves carry no cache.
+    cache_id : entry identity baked into fleet cache keys (defaults to
+        the entry factory's ``__name__``).
     """
 
     def __init__(
@@ -214,6 +237,7 @@ class FleetServer:
         devices=None,
         max_batch: int = 8,
         max_wait_ms: float = 5.0,
+        coalesce_ms: float = 0.0,
         queue_depth: int = 64,
         deadline_ms: float = 0.0,
         labeled: bool = True,
@@ -232,6 +256,8 @@ class FleetServer:
         memory_budget=None,
         supervise=None,
         registry=None,
+        result_cache=None,
+        cache_id: str | None = None,
     ):
         if not callable(entry_factory):
             raise TypeError("entry_factory must be callable(replica_id, metrics)")
@@ -263,6 +289,7 @@ class FleetServer:
         self._server_kw = dict(
             max_batch=max_batch,
             max_wait_ms=max_wait_ms,
+            coalesce_ms=coalesce_ms,
             queue_depth=queue_depth,
             deadline_ms=0.0,  # the fleet applies its default at admission
             labeled=labeled,
@@ -275,7 +302,26 @@ class FleetServer:
             health=health,
             slo=slo,
             memory=memory_budget,
+            # replicas carry NO result cache: the fleet keeps ONE shared
+            # cache at its admission tier (consulted in submit, populated
+            # in _harvest), so a hit never costs a routing decision and
+            # N replicas never hold N copies of the same hot row
+            result_cache=None,
         )
+
+        # fleet-tier content-addressed result cache (serve.result_cache):
+        # an int byte budget builds one; an instance is shared as-is
+        if isinstance(result_cache, ResultCache):
+            self._cache = result_cache
+        elif result_cache:
+            self._cache = ResultCache(
+                int(result_cache),
+                cache_id=cache_id if cache_id is not None else getattr(
+                    entry_factory, "__name__", type(entry_factory).__name__))
+        else:
+            self._cache = None
+        if self._cache is not None:
+            self.metrics.result_cache = self._cache
 
         self._replicas: list[_Replica] = []
         for rid, dev in enumerate(self.devices):
@@ -427,6 +473,9 @@ class FleetServer:
     def describe(self) -> dict:
         return {
             "replicas": self.n_replicas,
+            "coalesce_ms": self._server_kw["coalesce_ms"],
+            "result_cache": (self._cache.stats()
+                             if self._cache is not None else None),
             "devices": [str(d) for d in self.devices],
             "dead": [r.rid for r in self._replicas if not r.alive],
             "quarantined": [
@@ -483,9 +532,14 @@ class FleetServer:
                 penalties.append(sum(pen) / len(pen))
         snaps = [r.metrics.snapshot() for r in replicas]
         os_snap = self.metrics.oversize.snapshot()
+        qos_depth = dict.fromkeys(QOS_CLASSES, 0)
+        for r in live:
+            for cls, depth in r.server.qos_depths().items():
+                qos_depth[cls] = qos_depth.get(cls, 0) + depth
         return {
             "projected_drain_s": min(
                 (r.server.projected_drain_s() for r in live), default=0.0),
+            "qos_depth": qos_depth,
             "ema_service_s": ema,
             "slo_penalty_s": max(penalties, default=0.0),
             "quarantined": bool(live)
@@ -502,23 +556,40 @@ class FleetServer:
 
     # -- client side --------------------------------------------------------
 
-    def submit(self, x, y=None, deadline_ms: float | None = None) -> Future:
+    def submit(self, x, y=None, deadline_ms: float | None = None,
+               qos: str = "interactive") -> Future:
         """Admit one item and route it to the least-loaded live replica.
         Returns a fleet-level future — it survives a replica death by
-        re-routing to survivors. Raises `QueueFullError` only when every
+        re-routing to survivors. ``qos`` is the request's admission class
+        (threaded to the replica's lanes and into routing via the
+        interactive-depth weight). Raises `QueueFullError` only when every
         live replica rejected."""
         if self.labeled and y is None:
             raise ValueError("labeled fleet: submit(x, y) needs a class label")
         if not self.labeled and y is not None:
             raise ValueError("unlabeled fleet: submit() must not carry a label")
+        if qos not in QOS_CLASSES:
+            raise ValueError(f"qos must be one of {QOS_CLASSES}, got {qos!r}")
         x = np.asarray(x, self.dtype)
         bucket = self.table.select(x.shape)  # NoBucketError before any queueing
+        ckey = None
+        if self._cache is not None:
+            # fleet-tier consult BEFORE routing: a hit never costs a
+            # replica queue slot or a scoring pass
+            ckey = self._cache.key(x, y)
+            hit = self._cache.get(ckey)
+            if hit is not None:
+                self.metrics.note_cache_hit()
+                fut: Future = Future()
+                fut.set_result(hit)
+                return fut
         now = time.perf_counter()
         if deadline_ms is None:
             deadline_at = (now + self.default_deadline_s) if self.default_deadline_s else None
         else:
             deadline_at = now + deadline_ms / 1e3
-        req = _FleetRequest(x, y, bucket, deadline_at, Future())
+        req = _FleetRequest(x, y, bucket, deadline_at, Future(),
+                            qos=qos, ckey=ckey)
         if obs_tracing._STATE.enabled:
             # detached per-request root: ends on whichever thread resolves
             # the fleet future (worker callback), closing the trace
@@ -537,9 +608,10 @@ class FleetServer:
             self._route(req, raise_errors=True)
         return req.future
 
-    def attribute(self, x, y=None, deadline_ms: float | None = None):
+    def attribute(self, x, y=None, deadline_ms: float | None = None,
+                  qos: str = "interactive"):
         """Blocking convenience wrapper: submit + wait."""
-        return self.submit(x, y, deadline_ms=deadline_ms).result()
+        return self.submit(x, y, deadline_ms=deadline_ms, qos=qos).result()
 
     def submit_with_retry(self, x, y=None, *, policy=None, stats=None,
                           rng=None, deadline_ms: float | None = None) -> Future:
@@ -573,12 +645,16 @@ class FleetServer:
                          name="wam-retry-driver").start()
         return outer
 
-    def attribute_batch(self, xs, ys=None, deadline_ms: float | None = None):
+    def attribute_batch(self, xs, ys=None, deadline_ms: float | None = None,
+                        qos: str = "batch"):
         """Attribute a whole batch. ``len(xs) <= max_batch`` fans out as
         routed per-item submits (the workers coalesce them back into full
         device batches); anything larger takes the oversize data-parallel
         path over the fleet mesh (module docstring) instead of being the
-        caller's chunking problem. Blocking; returns the stacked result."""
+        caller's chunking problem. Blocking; returns the stacked result.
+        Fanned-out items default to the ``batch`` QoS lane — whole-batch
+        callers are throughput work that must not displace interactive
+        single-item submits (override with ``qos="interactive"``)."""
         xs = np.asarray(xs, self.dtype)
         if xs.ndim < 2:
             raise ValueError("attribute_batch needs a leading batch axis")
@@ -602,7 +678,8 @@ class FleetServer:
             )
         if len(xs) <= self.max_batch or not fleet_whole:
             futs = [
-                self.submit(x, int(ys[i]) if self.labeled else None, deadline_ms)
+                self.submit(x, int(ys[i]) if self.labeled else None,
+                            deadline_ms, qos=qos)
                 for i, x in enumerate(xs)
             ]
             rows = [f.result() for f in futs]
@@ -617,11 +694,20 @@ class FleetServer:
         the replica's OWN per-bucket EMA (an idle-but-slow replica loses
         to an idle-and-fast one), plus the replica's SLO burn-rate penalty
         (`AttributionServer.slo_penalty_s` — an objective-violating
-        replica sheds load proportionally to how hard it is burning)."""
+        replica sheds load proportionally to how hard it is burning),
+        plus the interactive-depth weight: queued interactive work counts
+        EXTRA beyond its share of raw drain (`INTERACTIVE_DEPTH_WEIGHT`),
+        so interactive-loaded replicas shed new work to keep the
+        latency-sensitive lane short."""
+        ema = replica.metrics.ema_service_s(bucket.shape)
+        interactive_depth = replica.server.qos_depths()["interactive"]
         return (
             replica.server.projected_drain_s()
-            + replica.metrics.ema_service_s(bucket.shape)
+            + ema
             + replica.server.slo_penalty_s(bucket.shape)
+            + INTERACTIVE_DEPTH_WEIGHT
+            * (interactive_depth / replica.server.max_batch)
+            * ema
         )
 
     def _route(self, req: _FleetRequest, raise_errors: bool) -> None:
@@ -670,7 +756,8 @@ class FleetServer:
         retry_after = None
         for r in cands:
             try:
-                inner = r.server.submit(req.x, req.y, deadline_ms=remaining_ms)
+                inner = r.server.submit(req.x, req.y, deadline_ms=remaining_ms,
+                                        qos=req.qos)
             except QueueFullError as e:
                 retry_after = (
                     e.retry_after_s
@@ -695,7 +782,14 @@ class FleetServer:
         and re-route to survivors."""
         exc = inner.exception()
         if exc is None:
-            req.future.set_result(inner.result())
+            result = inner.result()
+            if (self._cache is not None and req.ckey is not None
+                    and not replica.server.degraded):
+                # populate at the fleet tier (replicas carry no cache);
+                # degraded CPU-rebuilt entries are skipped — their rounding
+                # differs from the accelerator rows the cache promises
+                self._cache.put(req.ckey, result)
+            req.future.set_result(result)
             return
         if isinstance(exc, ServerClosedError):
             # the REPLICA closed under this request (supervisor restart in

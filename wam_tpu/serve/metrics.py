@@ -44,7 +44,7 @@ from wam_tpu.results import JsonlWriter
 from wam_tpu.serve.buckets import bucket_key
 
 __all__ = ["ServeMetrics", "FleetMetrics", "percentile_ms", "SCHEMA_VERSION",
-           "write_obs_snapshot", "write_slo_status"]
+           "write_obs_snapshot", "write_slo_status", "write_result_cache"]
 
 SCHEMA_VERSION = 2
 
@@ -91,6 +91,11 @@ _g_ema_service = _obs_registry.gauge(
 _h_latency = _obs_registry.histogram(
     "wam_tpu_serve_latency_seconds", "submit->result request latency",
     labels=("replica",))
+_h_occupancy = _obs_registry.histogram(
+    "wam_tpu_serve_batch_occupancy",
+    "per-dispatch real-row occupancy (n_real / max_batch) — the coalescing "
+    "acceptance gate reads this", labels=("replica",),
+    buckets=(0.125, 0.25, 0.375, 0.5, 0.625, 0.75, 0.875, 1.0))
 _h_service = _obs_registry.histogram(
     "wam_tpu_serve_service_seconds", "dispatch->harvest batch service time",
     labels=("replica",))
@@ -141,9 +146,14 @@ class ServeMetrics:
         self.failed = 0  # engine raised; no fallback could serve it
         self.fallbacks = 0  # batches served by the degraded CPU entry
         self.busy_s = 0.0  # summed dispatch->harvest service time
+        self.cache_hits = 0  # result-cache hits (resolved without admission)
         self.latencies_s: list[float] = []  # submit -> result, per request
         self.queue_waits_s: list[float] = []  # submit -> batch assembly
         self.batch_rows: list[dict] = []  # one dict per dispatched batch
+        self._latency_by_qos: dict[str, list[float]] = {}  # class -> sample
+        # runtime attaches its ResultCache so emit() can flush a
+        # result_cache row next to this replica's summary (None = no cache)
+        self.result_cache = None
         self.warmup_s: dict[str, float] = {}  # bucket key -> warmup seconds
         self._ema_service_s: dict[str, float] = {}  # bucket key -> EMA
         # runtime attaches its SLOTracker so emit() can flush a slo_status
@@ -164,6 +174,14 @@ class ServeMetrics:
         with self._lock:
             self.submitted += n
         _c_submitted.inc(n, replica=self._rl)
+
+    def note_cache_hit(self, n: int = 1) -> None:
+        """A submit answered from the result cache (never admitted — the
+        hit does NOT count into ``completed``/``latencies_s``, which remain
+        the computed-request ledger; the cache's own hit/miss counters live
+        on the `ResultCache` and its registry instruments)."""
+        with self._lock:
+            self.cache_hits += n
 
     def note_reject(self) -> None:
         with self._lock:
@@ -214,14 +232,21 @@ class ServeMetrics:
         service_s: float,
         queue_waits_s: list[float],
         latencies_s: list[float],
+        qos: list[str] | None = None,
     ) -> None:
         """One dispatched batch: aggregate row + per-request samples, and
         the per-bucket service-time EMA update (first observation seeds the
-        EMA directly; later ones blend 0.8/0.2)."""
+        EMA directly; later ones blend 0.8/0.2). ``qos`` is the per-request
+        class list parallel to ``latencies_s`` — it splits the latency
+        sample into per-class percentiles (`snapshot` ``latency_by_qos``)."""
+        occupancy = n_real / max_batch
         with self._lock:
             self.completed += len(latencies_s)
             self.latencies_s.extend(latencies_s)
             self.queue_waits_s.extend(queue_waits_s)
+            if qos is not None:
+                for cls, lat in zip(qos, latencies_s):
+                    self._latency_by_qos.setdefault(cls, []).append(lat)
             self.busy_s += service_s
             key = bucket_key(bucket_shape)
             prev = self._ema_service_s.get(key)
@@ -232,7 +257,8 @@ class ServeMetrics:
                 "metric": "serve_batch",
                 "bucket": list(bucket_shape),
                 "n_real": n_real,
-                "fill_ratio": n_real / max_batch,
+                "fill_ratio": occupancy,
+                "occupancy": occupancy,
                 "pad_waste": pad_waste,
                 "queue_depth": queue_depth,
                 "service_s": service_s,
@@ -248,6 +274,7 @@ class ServeMetrics:
         _g_ema_service.set(self._ema_service_s[key], replica=self._rl,
                            bucket=key)
         _h_service.observe(service_s, replica=self._rl)
+        _h_occupancy.observe(occupancy, replica=self._rl)
         for lat in latencies_s:
             _h_latency.observe(lat, replica=self._rl)
 
@@ -280,6 +307,18 @@ class ServeMetrics:
                 "batches": len(self.batch_rows),
                 "compile_count": self.compile_count,
                 "fill_ratio_mean": float(np.mean(fills)) if fills else float("nan"),
+                # occupancy is fill_ratio under its coalescing-gate name;
+                # the open-loop bench and BASELINE round 13 read this key
+                "occupancy_mean": float(np.mean(fills)) if fills else float("nan"),
+                "cache_hits": self.cache_hits,
+                "latency_by_qos": {
+                    cls: {
+                        "n": len(sample),
+                        "p50_ms": percentile_ms(sample, 50),
+                        "p99_ms": percentile_ms(sample, 99),
+                    }
+                    for cls, sample in sorted(self._latency_by_qos.items())
+                },
                 "pad_waste_mean": float(np.mean(wastes)) if wastes else float("nan"),
                 "queue_depth_mean": float(np.mean(depths)) if depths else float("nan"),
                 "queue_depth_max": int(max(depths)) if depths else 0,
@@ -317,6 +356,8 @@ class ServeMetrics:
         writer.write(summary)
         if self.slo is not None:
             write_slo_status(writer, self.slo)
+        if self.result_cache is not None:
+            write_result_cache(writer, self.result_cache)
         if obs_snapshot:
             write_obs_snapshot(writer)
         return summary
@@ -330,6 +371,17 @@ def write_slo_status(writer: JsonlWriter, tracker) -> dict:
     the ``wam_tpu_slo_*`` gauges from the SAME floats, so a ledger row and
     a registry scrape taken together agree exactly."""
     row = tracker.snapshot_row(publish=True)
+    row["schema_version"] = SCHEMA_VERSION
+    writer.write(row)
+    return row
+
+
+def write_result_cache(writer: JsonlWriter, cache) -> dict:
+    """One ``result_cache`` ledger row from a `serve.result_cache
+    .ResultCache`: hit/miss/eviction counters + resident bytes, stamped
+    with the ledger schema version here (the cache row body comes from
+    `ResultCache.row`, the envelope is the ledger's concern)."""
+    row = cache.row()
     row["schema_version"] = SCHEMA_VERSION
     writer.write(row)
     return row
@@ -361,6 +413,10 @@ class FleetMetrics:
         self.deaths: list[dict] = []
         self.restarts: list[dict] = []  # replica_restart transition rows
         self.oversize = ServeMetrics(replica_id="fleet")
+        # the fleet attaches its SHARED admission-tier ResultCache here
+        # (replica servers carry none — fleet.py owns consult/populate)
+        self.result_cache = None
+        self.cache_hits = 0  # fleet-tier submits answered from the cache
         self._t0 = time.perf_counter()
 
     def replica(self, replica_id) -> ServeMetrics:
@@ -369,6 +425,12 @@ class FleetMetrics:
             if replica_id not in self._replicas:
                 self._replicas[replica_id] = ServeMetrics(replica_id=replica_id)
             return self._replicas[replica_id]
+
+    def note_cache_hit(self, n: int = 1) -> None:
+        """A fleet-tier submit answered from the shared result cache
+        (never routed to a replica)."""
+        with self._lock:
+            self.cache_hits += n
 
     def note_replica_death(self, replica_id, reason: str = "") -> None:
         with self._lock:
@@ -453,6 +515,10 @@ class FleetMetrics:
         completed += os_snap["completed"]
         submitted += os_snap["submitted"]
         latencies.extend(self.oversize.latency_sample())
+        with self._lock:
+            cache_hits = self.cache_hits
+            cache_stats = (self.result_cache.stats()
+                           if self.result_cache is not None else None)
         return {
             "metric": "fleet_summary",
             "schema_version": SCHEMA_VERSION,
@@ -474,6 +540,8 @@ class FleetMetrics:
             "latency_p99_ms": percentile_ms(latencies, 99),
             "oversize_batches": os_snap["batches"],
             "oversize_completed": os_snap["completed"],
+            "cache_hits": cache_hits,
+            "result_cache": cache_stats,
             "per_replica": per_replica,
         }
 
@@ -502,5 +570,7 @@ class FleetMetrics:
         if config is not None:
             summary["config"] = config
         writer.write(summary)
+        if self.result_cache is not None:
+            write_result_cache(writer, self.result_cache)
         write_obs_snapshot(writer)
         return summary

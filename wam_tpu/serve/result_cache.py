@@ -1,0 +1,210 @@
+"""Content-addressed attribution result cache (the serve admission tier).
+
+WAM attribution is DETERMINISTIC per (input, label, entry, schedule): the
+engines derive their SmoothGrad noise from a fixed per-entry RNG path, the
+serve runtime's replicate-batch padding keeps real rows bit-identical
+regardless of batch fill, and tuned schedules are the only knob that moves
+the sampling chunking (and with it the noise realization). So a repeated
+query — the Zipf head of real traffic, viral inputs, retried clients — can
+be answered from a byte-bounded cache with EXACT results, not approximate
+ones.
+
+Key = ``sha256(input bytes | shape | dtype) | label | cache_id |
+schedule_fingerprint``:
+
+- the input digest covers the raw bytes plus shape/dtype, so a reshaped
+  or recast array never collides;
+- ``cache_id`` names the entry/model/method this cache serves. A cache is
+  only shared between servers running the SAME logical entry (fleet
+  replicas built from one factory); callers serving multiple entries from
+  one cache must pass distinguishing ids;
+- the schedule fingerprint (`tune.cache.schedule_fingerprint`) changes
+  whenever a tuned schedule lands or the schedule kill switch flips, so
+  stale-schedule hits are structurally impossible — the key stops
+  matching (tests pin this).
+
+Placement: `AttributionServer.submit` / `FleetServer.submit` consult the
+cache BEFORE admission — a hit resolves the future immediately and never
+touches the bounded queue, memory admission, or a batch slot (DESIGN.md
+"Admission & coalescing"). Population happens at harvest: each real row of
+a completed batch is stored host-side.
+
+Bounding: a plain LRU over an `OrderedDict` with a BYTE budget (values are
+numpy pytrees; their ``nbytes`` sum is the charge). Oversized single
+values are refused rather than evicting the whole cache. Eviction, hit,
+and miss counts publish to the obs registry
+(``wam_tpu_serve_cache_{hits,misses,evictions}_total``) and to a v2
+``result_cache`` ledger row (`serve.metrics.write_result_cache`).
+
+Kill switch: ``WAM_TPU_NO_RESULT_CACHE=1`` bypasses get/put per call
+(mirrors ``WAM_TPU_NO_SCHEDULE_CACHE`` / the AOT cache convention) — for
+bisecting "is the cache wrong" in production without a restart.
+
+Exactness caveat (serve.buckets): deterministic entries (``method=
+"gradcam"``/plain gradients) are bit-exact by construction. SmoothGrad
+entries are bit-exact PER ROW POSITION — the serve runtime always packs a
+request into *some* row of a full ``max_batch`` batch, and the engines'
+per-batch RNG gives each row its own noise stream, so two computes of the
+same input in different row positions differ by the (unbiased) sampling
+noise. The cache returns whichever realization was computed first —
+deterministic for a given arrival order, within estimator variance always.
+Callers for whom realization identity matters (eval suites) should bypass
+the cache (kill switch) rather than depend on arrival order.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import threading
+import time
+from collections import OrderedDict
+
+import numpy as np
+
+from wam_tpu.obs.registry import registry as _obs_registry
+
+__all__ = ["ResultCache", "result_cache_key", "cache_disabled"]
+
+_c_hits = _obs_registry.counter(
+    "wam_tpu_serve_cache_hits_total",
+    "result-cache hits (futures resolved without admission)")
+_c_misses = _obs_registry.counter(
+    "wam_tpu_serve_cache_misses_total",
+    "result-cache misses (requests that went through admission)")
+_c_evictions = _obs_registry.counter(
+    "wam_tpu_serve_cache_evictions_total",
+    "result-cache LRU evictions under the byte budget")
+_g_bytes = _obs_registry.gauge(
+    "wam_tpu_serve_cache_bytes", "resident result-cache payload bytes")
+_g_entries = _obs_registry.gauge(
+    "wam_tpu_serve_cache_entries", "resident result-cache entries")
+
+
+def cache_disabled() -> bool:
+    """``WAM_TPU_NO_RESULT_CACHE=1`` kill switch, read per call so flipping
+    the env var takes effect without a restart."""
+    return os.environ.get("WAM_TPU_NO_RESULT_CACHE", "") not in ("", "0")
+
+
+def result_cache_key(x: np.ndarray, y, cache_id: str) -> str:
+    """Content address for one request: input digest + label + entry id +
+    the live tuned-schedule fingerprint (module docstring)."""
+    from wam_tpu.tune.cache import schedule_fingerprint
+
+    h = hashlib.sha256()
+    h.update(x.tobytes())
+    h.update(repr((x.shape, str(x.dtype))).encode())
+    return f"{h.hexdigest()}|{y}|{cache_id}|{schedule_fingerprint()}"
+
+
+def _tree_bytes(value) -> int:
+    import jax
+
+    return sum(int(np.asarray(leaf).nbytes)
+               for leaf in jax.tree_util.tree_leaves(value))
+
+
+class ResultCache:
+    """Thread-safe bounded LRU of attribution result pytrees.
+
+    ``max_bytes`` bounds the summed payload ``nbytes`` (keys and dict
+    overhead are not charged — the payloads dominate by orders of
+    magnitude). ``cache_id`` is baked into every key (module docstring).
+    One instance may be shared by many servers: client threads `get` under
+    `submit`, worker threads `put` at harvest; one lock covers both (the
+    critical sections are dict moves, not hashing — keys are computed
+    outside).
+    """
+
+    def __init__(self, max_bytes: int, *, cache_id: str = ""):
+        if max_bytes < 1:
+            raise ValueError("max_bytes must be >= 1")
+        self.max_bytes = int(max_bytes)
+        self.cache_id = str(cache_id)
+        self._lock = threading.Lock()
+        self._data: OrderedDict[str, tuple[object, int]] = OrderedDict()
+        self._bytes = 0
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+
+    def key(self, x: np.ndarray, y) -> str:
+        return result_cache_key(x, y, self.cache_id)
+
+    def get(self, key: str):
+        """The cached pytree, or None. Counts a hit or a miss — call it
+        once per admission decision, not speculatively."""
+        if cache_disabled():
+            return None
+        with self._lock:
+            entry = self._data.get(key)
+            if entry is None:
+                self.misses += 1
+                _c_misses.inc()
+                return None
+            self._data.move_to_end(key)
+            self.hits += 1
+        _c_hits.inc()
+        return entry[0]
+
+    def put(self, key: str, value) -> bool:
+        """Insert (host-side pytree), evicting LRU entries down to the byte
+        budget. A single value over the whole budget is refused (returns
+        False) instead of flushing everything for an uncacheable row."""
+        if cache_disabled():
+            return False
+        nbytes = _tree_bytes(value)
+        if nbytes > self.max_bytes:
+            return False
+        evicted = 0
+        with self._lock:
+            old = self._data.pop(key, None)
+            if old is not None:
+                self._bytes -= old[1]
+            while self._bytes + nbytes > self.max_bytes and self._data:
+                _, (_, sz) = self._data.popitem(last=False)
+                self._bytes -= sz
+                self.evictions += 1
+                evicted += 1
+            self._data[key] = (value, nbytes)
+            self._bytes += nbytes
+            nbytes_now, entries_now = self._bytes, len(self._data)
+        if evicted:
+            _c_evictions.inc(evicted)
+        _g_bytes.set(nbytes_now)
+        _g_entries.set(entries_now)
+        return True
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._data)
+
+    @property
+    def total_bytes(self) -> int:
+        with self._lock:
+            return self._bytes
+
+    def stats(self) -> dict:
+        """Counter snapshot (the ``result_cache`` ledger-row body and the
+        bench's hit-rate report)."""
+        with self._lock:
+            hits, misses = self.hits, self.misses
+            return {
+                "hits": hits,
+                "misses": misses,
+                "evictions": self.evictions,
+                "entries": len(self._data),
+                "bytes": self._bytes,
+                "max_bytes": self.max_bytes,
+                "hit_rate": hits / (hits + misses) if hits + misses else 0.0,
+                "cache_id": self.cache_id,
+                "disabled": cache_disabled(),
+            }
+
+    def row(self) -> dict:
+        """The v2 ``result_cache`` ledger row (schema stamped by
+        `serve.metrics.write_result_cache`, which owns the envelope)."""
+        row = {"metric": "result_cache", "timestamp": time.time()}
+        row.update(self.stats())
+        return row

@@ -19,12 +19,34 @@ Operational semantics (DESIGN.md "Serving runtime"):
   projection (`projected_drain_s`) is the fleet's load-aware routing
   signal (`serve.fleet`) — reject-with-retry-after, never unbounded
   buffering.
-- **Coalescing**: the worker serves the bucket whose head request is
-  oldest, dispatching when the bucket has ``max_batch`` items or its head
-  has waited ``max_wait_ms`` — latency-bounded batch fill.
-- **Deadlines**: a request carrying a deadline that lapses while queued is
-  completed with `DeadlineExceededError` at pop time instead of wasting a
-  batch slot.
+- **Coalescing** (DESIGN.md "Admission & coalescing"): the worker serves
+  the bucket whose head request is oldest, holding its dispatch inside an
+  admission window — ``coalesce_ms`` when set, else ``max_wait_ms`` —
+  until the bucket is FULL, the window expires, or the oldest queued
+  deadline cannot survive sitting out the rest of the window plus one
+  EMA batch service (early release). ``coalesce_ms=0`` (the default for
+  direct constructions) is exactly the historical max_wait behavior;
+  ``ServeConfig.coalesce_ms`` defaults it on for config-built servers.
+  Cross-request coalescing is what amortizes the fixed per-dispatch
+  tunnel cost: independent single-item ``submit()``s from many clients
+  pack into one full bucket dispatch instead of N replicate-padded ones.
+- **QoS lanes**: ``submit(..., qos="interactive"|"batch")`` places the
+  request in one of two FIFO lanes per bucket. The pop drains the
+  interactive lane first and BACKFILLS a partially-full interactive
+  dispatch from the batch lane (padding rows that would be replicated
+  anyway carry real batch work instead); bucket selection prefers buckets
+  with interactive work. The admission window is still anchored at the
+  oldest head across both lanes, so batch work cannot starve.
+- **Deadlines**: a request whose deadline lapses while queued (including
+  while held in the admission window) is completed with
+  `DeadlineExceededError` at pop time, BEFORE slot accounting — expired
+  requests leave the lanes without displacing live ones from the take.
+- **Result cache** (``result_cache=``, `serve.result_cache.ResultCache`):
+  `submit` consults a content-addressed cache before admission; hits
+  resolve the future immediately — no queue, no memory admission, no
+  batch slot. Worker harvest populates it per real row. Off by default
+  for direct constructions (``ServeConfig.result_cache_mb`` turns it on
+  in config-built servers); ``WAM_TPU_NO_RESULT_CACHE=1`` kills it live.
 - **Degradation**: if the entry raises mid-run and `probe_accelerator`
   (forced re-probe) says the accelerator is gone, the server swaps in the
   ``fallback_factory`` entry (a CPU-backend rebuild) once, replays the
@@ -62,6 +84,7 @@ from wam_tpu.obs import tracing as obs_tracing
 from wam_tpu.pipeline.stager import put_committed
 from wam_tpu.serve.buckets import Bucket, BucketTable, bucket_key, pad_item
 from wam_tpu.serve.metrics import ServeMetrics
+from wam_tpu.serve.result_cache import ResultCache
 
 __all__ = [
     "AttributionServer",
@@ -71,7 +94,11 @@ __all__ = [
     "DeadlineExceededError",
     "ServerClosedError",
     "WorkerCrashedError",
+    "QOS_CLASSES",
 ]
+
+# admission lanes, in drain order (interactive first, batch backfills)
+QOS_CLASSES = ("interactive", "batch")
 
 
 class ServeError(RuntimeError):
@@ -133,6 +160,72 @@ class _Request:
     # to — captured at submit (the fleet router's context, or a fresh root
     # this server starts for direct submits)
     ctx: tuple | None = None
+    qos: str = "interactive"  # admission lane (QOS_CLASSES)
+    ckey: str | None = None  # result-cache key (None = cache off)
+
+
+class _Lanes:
+    """One bucket's queue as two FIFO lanes (module docstring "QoS
+    lanes"). Only ever touched under the server's ``_cond``."""
+
+    __slots__ = ("interactive", "batch")
+
+    def __init__(self):
+        self.interactive: list[_Request] = []
+        self.batch: list[_Request] = []
+
+    def __len__(self) -> int:
+        return len(self.interactive) + len(self.batch)
+
+    def append(self, r: _Request) -> None:
+        (self.interactive if r.qos == "interactive" else self.batch).append(r)
+
+    def head(self) -> _Request:
+        """Oldest request across both lanes — the admission window (and
+        the served-oldest-bucket choice) anchor here so the batch lane
+        cannot starve behind a steady interactive trickle."""
+        if self.interactive and self.batch:
+            a, b = self.interactive[0], self.batch[0]
+            return a if a.t_submit <= b.t_submit else b
+        return (self.interactive or self.batch)[0]
+
+    def min_deadline(self) -> float | None:
+        """Tightest queued deadline (the early-release trigger)."""
+        ds = [r.deadline for r in self.interactive if r.deadline is not None]
+        ds += [r.deadline for r in self.batch if r.deadline is not None]
+        return min(ds) if ds else None
+
+    def drop_expired(self, now: float) -> list[_Request]:
+        """Remove (and return) every request whose deadline lapsed — runs
+        at pop time, before slot accounting, so an expired request never
+        displaces a live one from the take (deadline hygiene)."""
+        expired = [r for r in self.interactive
+                   if r.deadline is not None and now > r.deadline]
+        expired += [r for r in self.batch
+                    if r.deadline is not None and now > r.deadline]
+        if expired:
+            gone = set(map(id, expired))
+            self.interactive = [r for r in self.interactive
+                                if id(r) not in gone]
+            self.batch = [r for r in self.batch if id(r) not in gone]
+        return expired
+
+    def pop(self, k: int) -> list[_Request]:
+        """Up to ``k`` requests: the interactive lane drains first, the
+        batch lane backfills the remaining rows."""
+        take = self.interactive[:k]
+        del self.interactive[:k]
+        fill = k - len(take)
+        if fill > 0 and self.batch:
+            take += self.batch[:fill]
+            del self.batch[:fill]
+        return take
+
+    def clear(self) -> list[_Request]:
+        reqs = self.interactive + self.batch
+        self.interactive = []
+        self.batch = []
+        return reqs
 
 
 @dataclass
@@ -167,6 +260,11 @@ class AttributionServer:
     max_batch : rows per dispatched batch (every batch is padded to exactly
         this, so each bucket compiles once).
     max_wait_ms : max time a head-of-bucket request waits for batch fill.
+    coalesce_ms : cross-request admission window (module docstring
+        "Coalescing"). 0 (default) = historical max_wait behavior; > 0
+        holds a bucket's dispatch up to this long for batch fill, with
+        deadline-pressure early release. Config-built servers default it
+        on via ``ServeConfig.coalesce_ms``.
     queue_depth : bound on queued items across all buckets (backpressure).
     deadline_ms : default per-request deadline (0 = none; per-`submit`
         override).
@@ -220,6 +318,17 @@ class AttributionServer:
         (per-artifact miss semantics); the `HydrationReport` lands on
         ``registry_report`` and, when ``metrics_path`` is set, as a
         ``registry_hydration`` ledger row.
+    result_cache : content-addressed result cache
+        (`serve.result_cache.ResultCache`): an int byte budget builds a
+        per-server cache; an existing instance is SHARED as-is (the fleet
+        keeps one at its admission tier and passes its replicas None);
+        None/0 (default) disables — direct constructions keep exact
+        pre-cache accounting (``completed == submitted`` stays pinned by
+        tests), ``ServeConfig.result_cache_mb`` turns it on for
+        config-built servers.
+    cache_id : entry/model identity baked into cache keys; defaults to the
+        entry's ``__name__`` (or type name). Pass an explicit id when one
+        `ResultCache` instance must distinguish entries.
     """
 
     def __init__(
@@ -229,6 +338,7 @@ class AttributionServer:
         *,
         max_batch: int = 8,
         max_wait_ms: float = 5.0,
+        coalesce_ms: float = 0.0,
         queue_depth: int = 64,
         deadline_ms: float = 0.0,
         labeled: bool = True,
@@ -246,15 +356,20 @@ class AttributionServer:
         slo=None,
         memory=None,
         registry=None,
+        result_cache=None,
+        cache_id: str | None = None,
     ):
         if max_batch < 1:
             raise ValueError("max_batch must be >= 1")
         if queue_depth < 1:
             raise ValueError("queue_depth must be >= 1")
+        if coalesce_ms < 0:
+            raise ValueError("coalesce_ms must be >= 0")
         self._entry = entry
         self.table = buckets if isinstance(buckets, BucketTable) else BucketTable(buckets)
         self.max_batch = max_batch
         self.max_wait_s = max_wait_ms / 1e3
+        self.coalesce_s = coalesce_ms / 1e3
         self.queue_depth = queue_depth
         self.default_deadline_s = deadline_ms / 1e3 if deadline_ms else None
         self.labeled = labeled
@@ -298,9 +413,23 @@ class AttributionServer:
                 int(memory), device=device, replica_id=replica_id)
         else:
             self._memory = None
+        # result cache (module docstring): off by default so direct
+        # constructions keep exact pre-cache request accounting
+        if isinstance(result_cache, ResultCache):
+            self._cache = result_cache
+        elif result_cache:
+            self._cache = ResultCache(
+                int(result_cache),
+                cache_id=cache_id if cache_id is not None else getattr(
+                    entry, "__name__", type(entry).__name__))
+        else:
+            self._cache = None
+        if self._cache is not None:
+            # the ledger hook: ServeMetrics.emit writes the result_cache row
+            self.metrics.result_cache = self._cache
 
         self._cond = threading.Condition()
-        self._queues: dict[Bucket, list[_Request]] = {b: [] for b in self.table}
+        self._queues: dict[Bucket, _Lanes] = {b: _Lanes() for b in self.table}
         # popped-but-unresolved requests: the crash guard's reach into
         # batches already taken off the queues (see _fail_pending)
         self._popped: list[_Request] = []
@@ -430,6 +559,9 @@ class AttributionServer:
             "buckets": [list(b.shape) for b in self.table],
             "max_batch": self.max_batch,
             "max_wait_ms": self.max_wait_s * 1e3,
+            "coalesce_ms": self.coalesce_s * 1e3,
+            "result_cache": (self._cache.stats()
+                             if self._cache is not None else None),
             "queue_depth": self.queue_depth,
             "labeled": self.labeled,
             "pipelined": self.pipelined,
@@ -449,18 +581,33 @@ class AttributionServer:
 
     # -- client side --------------------------------------------------------
 
-    def submit(self, x, y=None, deadline_ms: float | None = None) -> Future:
+    def submit(self, x, y=None, deadline_ms: float | None = None,
+               qos: str = "interactive") -> Future:
         """Enqueue one item (NO leading batch axis — a client batch is a
         sequence of submits, coalesced back together by the worker).
+        ``qos`` picks the admission lane (module docstring "QoS lanes").
         Returns a `concurrent.futures.Future` resolving to the item's
         attribution (leading axis stripped), or raising `ServeError`."""
         if self.labeled and y is None:
             raise ValueError("labeled server: submit(x, y) needs a class label")
         if not self.labeled and y is not None:
             raise ValueError("unlabeled server: submit() must not carry a label")
+        if qos not in QOS_CLASSES:
+            raise ValueError(f"qos must be one of {QOS_CLASSES}, got {qos!r}")
         x = np.asarray(x, self.dtype)
         bucket = self.table.select(x.shape)  # NoBucketError before any queueing
         self.metrics.note_submit()
+        ckey = None
+        if self._cache is not None:
+            # consult BEFORE admission: a hit resolves immediately and
+            # never touches the queue, memory admission, or a batch slot
+            ckey = self._cache.key(x, y)
+            hit = self._cache.get(ckey)
+            if hit is not None:
+                self.metrics.note_cache_hit()
+                fut: Future = Future()
+                fut.set_result(hit)
+                return fut
         if self._memory is not None:
             retry_after = self._memory.admit(
                 bucket_key(bucket.shape), self._estimate_bytes(bucket))
@@ -473,7 +620,7 @@ class AttributionServer:
             deadline = (now + self.default_deadline_s) if self.default_deadline_s else None
         else:
             deadline = now + deadline_ms / 1e3
-        req = _Request(x, y, bucket, now, deadline)
+        req = _Request(x, y, bucket, now, deadline, qos=qos, ckey=ckey)
         if obs_tracing._STATE.enabled:
             ctx = obs_tracing.current_context()
             if ctx is None:
@@ -497,37 +644,57 @@ class AttributionServer:
                     "serve worker is not running; the server cannot serve")
             if self._pending >= self.queue_depth:
                 self.metrics.note_reject()
-                raise QueueFullError(retry_after_s=self._drain_locked())
+                # the TARGET bucket's own drain: an idle bucket's clients
+                # retry immediately instead of backing off behind an
+                # unrelated hot bucket (the all-bucket sum stays the
+                # fleet routing signal, projected_drain_s)
+                raise QueueFullError(retry_after_s=self._drain_locked(bucket))
             self._queues[bucket].append(req)
             self._pending += 1
             self._cond.notify_all()
         return req.future
 
-    def attribute(self, x, y=None, deadline_ms: float | None = None):
+    def attribute(self, x, y=None, deadline_ms: float | None = None,
+                  qos: str = "interactive"):
         """Blocking convenience wrapper: submit + wait."""
-        return self.submit(x, y, deadline_ms=deadline_ms).result()
+        return self.submit(x, y, deadline_ms=deadline_ms, qos=qos).result()
 
     # -- load signal --------------------------------------------------------
 
-    def _drain_locked(self) -> float:
-        """Projected seconds to drain everything queued + in flight, summed
-        per bucket: (queued batches + active batches) × that bucket's EMA
-        service time (`ServeMetrics.ema_service_s`, seeded until the first
-        batch lands). Caller holds ``_cond``. This is both the
-        `QueueFullError.retry_after_s` estimate and the fleet's routing
-        score."""
+    def _drain_locked(self, bucket: Bucket | None = None) -> float:
+        """Projected seconds to drain everything queued + in flight:
+        (queued batches + active batches) × that bucket's EMA service time
+        (`ServeMetrics.ema_service_s`, seeded until the first batch
+        lands). With a ``bucket``: that bucket's own drain — the
+        `QueueFullError.retry_after_s` estimate, so a rejection against an
+        idle bucket does not inherit an unrelated hot bucket's backlog.
+        Without: the all-bucket sum — the fleet's routing score. Caller
+        holds ``_cond``."""
         total = 0.0
         for b, q in self._queues.items():
+            if bucket is not None and b is not bucket:
+                continue
             n_batches = -(-len(q) // self.max_batch) + self._active[b]
             if n_batches:
                 total += n_batches * self.metrics.ema_service_s(b.shape)
         return total
 
     def projected_drain_s(self) -> float:
-        """Thread-safe `_drain_locked` — the load-aware dispatch signal the
-        fleet router reads per submit (`serve.fleet.FleetServer`)."""
+        """Thread-safe all-bucket `_drain_locked` — the load-aware dispatch
+        signal the fleet router reads per submit (`serve.fleet.FleetServer`)."""
         with self._cond:
             return self._drain_locked()
+
+    def qos_depths(self) -> dict[str, int]:
+        """Queued items per QoS lane across all buckets — the fleet's
+        interactive-pressure routing term (`FleetServer._score`) and the
+        pod heartbeat's ``qos_depth`` signal (`FleetServer.pod_signals`)."""
+        with self._cond:
+            return {
+                "interactive": sum(len(q.interactive)
+                                   for q in self._queues.values()),
+                "batch": sum(len(q.batch) for q in self._queues.values()),
+            }
 
     def health_ok(self) -> bool:
         """Quarantine predicate for the fleet router: True when no health
@@ -606,12 +773,16 @@ class AttributionServer:
             return self._recover(xs, ys)
 
     def _take_batch(self, block: bool = True):
-        """Pop a ready batch (bucket full, head waited max_wait_ms, or
-        draining at close). Returns (bucket, requests, queue_depth_at_pop),
-        None when closed and drained, or — with ``block=False`` — the
-        `_NOT_READY` sentinel as soon as nothing is poppable *right now*
-        (the pipelined worker uses this to go harvest the in-flight batch
-        instead of sleeping on the queue)."""
+        """Pop a ready batch (bucket full, admission window expired,
+        deadline pressure, or draining at close). Returns ``(bucket,
+        requests, queue_depth_at_pop, expired)``, None when closed and
+        drained, or — with ``block=False`` — the `_NOT_READY` sentinel as
+        soon as nothing is poppable *right now* (the pipelined worker uses
+        this to go harvest the in-flight batch instead of sleeping on the
+        queue). ``expired`` requests left the lanes at pop time without
+        consuming a take slot; a pop may return ONLY expiries (empty
+        ``requests`` — no ``_active`` increment, the worker just fails
+        them and comes back)."""
         with self._cond:
             while True:
                 if self._pending == 0:
@@ -621,20 +792,47 @@ class AttributionServer:
                         return _NOT_READY
                     self._cond.wait(0.05)
                     continue
-                # serve the bucket whose head request is oldest
+                # serve the oldest head, preferring buckets with
+                # interactive work (lanes drain interactive-first)
                 bucket = min(
-                    (b for b, q in self._queues.items() if q),
-                    key=lambda b: self._queues[b][0].t_submit,
+                    (b for b, q in self._queues.items() if len(q)),
+                    key=lambda b: (0 if self._queues[b].interactive else 1,
+                                   self._queues[b].head().t_submit),
                 )
                 q = self._queues[bucket]
-                head_wait = time.perf_counter() - q[0].t_submit
+                now = time.perf_counter()
+                # deadline hygiene: expiries leave the lanes BEFORE slot
+                # accounting, so they cannot displace live requests from
+                # the take. Returned immediately (no pop) so their futures
+                # fail outside the lock with no added hold time.
+                expired = q.drop_expired(now)
+                if expired:
+                    self._pending -= len(expired)
+                    # crash-guard reach: until the worker fails them they
+                    # live nowhere else (_fail_pending scans _popped)
+                    self._popped = [r for r in self._popped
+                                    if not r.future.done()]
+                    self._popped.extend(expired)
+                    return bucket, [], self._pending, expired
+                head_wait = now - q.head().t_submit
+                # the admission window: coalesce_ms when set, else the
+                # historical max_wait bound (coalesce_ms=0 == old behavior)
+                window_s = self.coalesce_s if self.coalesce_s > 0 else self.max_wait_s
+                pressed = False
+                dmin = q.min_deadline() if self.coalesce_s > 0 else None
+                if dmin is not None:
+                    # early release: the tightest queued deadline cannot
+                    # survive sitting out the rest of the window plus one
+                    # EMA batch service — go now, don't hold it to death
+                    ema = self.metrics.ema_service_s(bucket.shape)
+                    pressed = dmin - now <= (window_s - head_wait) + ema
                 if (
                     len(q) >= self.max_batch
-                    or head_wait >= self.max_wait_s
-                    or self._closed  # draining: don't sit out max_wait
+                    or head_wait >= window_s
+                    or pressed
+                    or self._closed  # draining: don't sit out the window
                 ):
-                    take = q[: self.max_batch]
-                    del q[: self.max_batch]
+                    take = q.pop(self.max_batch)
                     self._pending -= len(take)
                     self._active[bucket] += 1  # in flight until _finish_active
                     # only the worker thread mutates _popped; resolved
@@ -642,10 +840,14 @@ class AttributionServer:
                     self._popped = [r for r in self._popped
                                     if not r.future.done()]
                     self._popped.extend(take)
-                    return bucket, take, self._pending + len(take)
+                    return bucket, take, self._pending + len(take), []
                 if not block:
                     return _NOT_READY
-                self._cond.wait(self.max_wait_s - head_wait)
+                wait_s = window_s - head_wait
+                if dmin is not None:
+                    # wake in time for the deadline-pressure release
+                    wait_s = min(wait_s, max(dmin - now - ema, 0.0))
+                self._cond.wait(max(wait_s, 1e-4))
 
     def _worker_loop(self):
         try:
@@ -664,9 +866,7 @@ class AttributionServer:
         the popped-but-unresolved ones the crash stranded mid-batch."""
         with self._cond:
             self._closed = True
-            reqs = [r for q in self._queues.values() for r in q]
-            for q in self._queues.values():
-                q.clear()
+            reqs = [r for q in self._queues.values() for r in q.clear()]
             self._pending = 0
             reqs += [r for r in self._popped if not r.future.done()]
             self._popped = []
@@ -691,19 +891,20 @@ class AttributionServer:
                 self._complete(inflight)
                 inflight = None
                 continue
-            bucket, reqs, depth = got
+            bucket, reqs, depth, expired_at_pop = got
+            # pop-time expiries never held a take slot (_take_batch drops
+            # them before slot accounting); fail them outside the lock
+            self._fail_expired(bucket, expired_at_pop)
+            if not reqs:
+                continue  # expiry-only wake: nothing was popped
             now = time.perf_counter()
             live, expired = [], []
             for r in reqs:
+                # race-window recheck (pop -> here); _take_batch already
+                # filtered, so this only catches deadlines that lapsed in
+                # the microseconds since
                 (expired if r.deadline is not None and now > r.deadline else live).append(r)
-            for r in expired:
-                r.future.set_exception(
-                    DeadlineExceededError("deadline lapsed while queued")
-                )
-            if expired:
-                self.metrics.note_expired(len(expired))
-                if self._slo is not None:
-                    self._slo.note_error(bucket_key(bucket.shape), len(expired))
+            self._fail_expired(bucket, expired)
             if not live:
                 self._finish_active(bucket)
                 continue
@@ -719,6 +920,23 @@ class AttributionServer:
                 # is exactly the overlap window
                 self._complete(inflight)
             inflight = batch
+
+    def _fail_expired(self, bucket: Bucket, expired: list[_Request]) -> None:
+        """Fail expired requests with `DeadlineExceededError` and account
+        them (per-QoS-class SLO errors)."""
+        if not expired:
+            return
+        for r in expired:
+            r.future.set_exception(
+                DeadlineExceededError("deadline lapsed while queued")
+            )
+        self.metrics.note_expired(len(expired))
+        if self._slo is not None:
+            bkey = bucket_key(bucket.shape)
+            for qos in QOS_CLASSES:
+                n = sum(1 for r in expired if r.qos == qos)
+                if n:
+                    self._slo.note_error(bkey, n, qos=qos)
 
     def _finish_active(self, bucket: Bucket) -> None:
         with self._cond:
@@ -774,7 +992,11 @@ class AttributionServer:
                     r.future.set_exception(e)
                 self.metrics.note_failed(n_real)
                 if self._slo is not None:
-                    self._slo.note_error(bucket_key(bucket.shape), n_real)
+                    bkey = bucket_key(bucket.shape)
+                    for qos in QOS_CLASSES:
+                        k = sum(1 for r in live if r.qos == qos)
+                        if k:
+                            self._slo.note_error(bkey, k, qos=qos)
                 return None
         return _Inflight(bucket, live, depth, xs, ys, t0, out, hvec)
 
@@ -804,7 +1026,10 @@ class AttributionServer:
                         r.future.set_exception(e)
                     self.metrics.note_failed(n_real)
                     if self._slo is not None:
-                        self._slo.note_error(bkey, n_real)
+                        for qos in QOS_CLASSES:
+                            k = sum(1 for r in live if r.qos == qos)
+                            if k:
+                                self._slo.note_error(bkey, k, qos=qos)
                     return
             if self._health is not None and hvec_host is not None:
                 # recorded BEFORE rows distribute so a sequential client's
@@ -815,6 +1040,14 @@ class AttributionServer:
                 done = time.perf_counter()
                 for i, r in enumerate(live):
                     row = jax.tree_util.tree_map(lambda a: np.asarray(a)[i], out)
+                    if (self._cache is not None and r.ckey is not None
+                            and not self.degraded):
+                        # populate at harvest (host-side rows). Degraded
+                        # batches are not cached: the CPU-rebuilt entry's
+                        # float rounding differs from the accelerator's,
+                        # and mixing provenances would break the
+                        # bit-identical-hit contract
+                        self._cache.put(r.ckey, row)
                     r.future.set_result(row)
             if obs_tracing._STATE.enabled:
                 # retroactive per-request phases: the worker only knows a
@@ -839,9 +1072,11 @@ class AttributionServer:
                 service_s=service_s,
                 queue_waits_s=[batch.t0 - r.t_submit for r in live],
                 latencies_s=latencies_s,
+                qos=[r.qos for r in live],
             )
             if self._slo is not None:
-                for lat in latencies_s:
-                    self._slo.note(bkey, latency_s=lat, ok=True, healthy=healthy)
+                for r, lat in zip(live, latencies_s):
+                    self._slo.note(bkey, latency_s=lat, ok=True,
+                                   healthy=healthy, qos=r.qos)
         finally:
             self._finish_active(batch.bucket)

@@ -27,6 +27,7 @@ from wam_tpu.tune.cache import (
     record_schedule,
     resolve_bucket_cap,
     resolve_fan_cap,
+    schedule_fingerprint,
     schedule_key,
 )
 from wam_tpu.tune.fused_relu import (
@@ -46,6 +47,7 @@ __all__ = [
     "record_schedule",
     "resolve_bucket_cap",
     "resolve_fan_cap",
+    "schedule_fingerprint",
     "schedule_key",
     "fused_relu",
     "get_fused_relu_impl",

@@ -127,6 +127,10 @@ class ScheduleCache:
 
     def put(self, key: str, entry: dict) -> None:
         self.entries[key] = dict(entry)
+        # a tuned entry changes the table identity: drop the memoized
+        # result-cache fingerprint (schedule_fingerprint) so cached
+        # attributions computed under the old table stop matching
+        self._fingerprint = None
 
     def save(self, path: str | None = None) -> str:
         """Write the USER layer (every current entry that is not a pinned
@@ -158,6 +162,33 @@ def invalidate_process_cache() -> None:
     global _process_cache
     with _lock:
         _process_cache = None
+
+
+def schedule_fingerprint() -> str:
+    """Digest of the loaded schedule table (entries + schema version) — the
+    "schedule version" component of serve result-cache keys
+    (`serve.result_cache`). Tuned schedules change the sampling chunking,
+    which changes SmoothGrad noise realizations, so a cached attribution is
+    only valid against the exact table it was computed under. Memoized on
+    the `ScheduleCache` instance: `invalidate_process_cache` (or a
+    `refresh=True` reload) naturally drops the memo with the instance."""
+    import hashlib
+
+    cache = load_schedule_cache()
+    # _disabled() is part of the identity (with lookups killed the entries
+    # serve under the fallback law, not the table), so the memo is keyed
+    # by the flag rather than assuming it is constant for the process
+    disabled = _disabled()
+    memo = getattr(cache, "_fingerprint", None)
+    if memo is not None and memo[0] == disabled:
+        return memo[1]
+    body = json.dumps(
+        {"version": SCHEDULE_CACHE_VERSION, "disabled": disabled,
+         "schedules": cache.entries},
+        sort_keys=True, default=str)
+    fp = hashlib.sha256(body.encode()).hexdigest()[:16]
+    cache._fingerprint = (disabled, fp)
+    return fp
 
 
 def _disabled() -> bool:
