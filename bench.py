@@ -7,6 +7,11 @@ forward/backward) — SURVEY.md §3.1. Since ptwt isn't installed here, the CPU
 baseline is a faithful torch re-statement of that pipeline (ptwt is itself
 strided torch conv) on a reduced workload, extrapolated linearly.
 
+This file benches the FLAGSHIP only; the canonical matrix — audio, volumes,
+ViT IG, the patch-aligned ViT row (``wam2d_ig_vit_b16_patchJ*``) and the
+video row (``wam3d_video_smooth_*``, wam_tpu.xattr) — lives in
+bench_matrix.py, sharing builders via bench_workloads.py.
+
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
 ``value`` is the device-plane (chip-only) throughput when the profiler
 yields one, wall otherwise — ``value_plane`` says which; the wall number is

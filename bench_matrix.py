@@ -307,6 +307,28 @@ def main():
     record(f"wam2d_ig_vitb16_path{steps}", 1,
            _sampled(run5, k=k, laps=laps), run=run5)
 
+    # 6. patch-aligned ViT IG (level_plan="patch": J from the token grid —
+    #    wam_tpu.xattr.planner; same model/steps as row 5, deeper mosaic) ----
+    from bench_workloads import video_workload, vit_patch_workload
+
+    ex6, x6, y6 = vit_patch_workload(
+        (16 if on_accel else 1) if not q else steps,
+        steps=steps, image=image, compute_dtype=dtype,
+    )
+    run6 = lambda: ex6(x6, y6)
+    record(f"wam2d_ig_vit_b16_patchJ{ex6.J}_path{steps}", 1,
+           _sampled(run6, k=k, laps=laps), run=run6)
+
+    # 7. video WAM (anisotropic space+time, wam_tpu.xattr.video) --------------
+    frames = 8 if q else 16
+    vsz = 16 if q else 32
+    cb, cn = (2, 3) if q else (4, 25)
+    ex7, x7, y7 = video_workload("auto" if on_accel else 1, b=cb, n=cn,
+                                 frames=frames, size=vsz)
+    run7 = lambda: ex7(x7, y7)
+    record(f"wam3d_video_smooth_r3d18_b{cb}_f{frames}_{vsz}sq_s2t1_n{cn}", cb,
+           _sampled(run7, k=k, laps=laps), "clips/s", run=run7)
+
 
 if __name__ == "__main__":
     main()

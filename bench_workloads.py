@@ -63,3 +63,48 @@ def vit_workload(chunk, *, steps: int = 64, image: int = 224, compute_dtype=None
     x = jax.random.normal(jax.random.PRNGKey(5), (1, 3, image, image), jnp.float32)
     y = jnp.zeros((1,), jnp.int32)
     return ex, x, y
+
+
+def vit_patch_workload(chunk, *, steps: int = 64, image: int = 224,
+                       patch: int = 16, compute_dtype=None):
+    """WAM-2D IG on ViT-B/16 with the PATCH-ALIGNED level plan
+    (``level_plan="patch"`` — wam_tpu.xattr.planner): J comes from the
+    patch grid (224/16 → J=4, level-4 cells = 1 token) instead of the
+    fixed J=3 of `vit_workload`, so the mosaic's coarsest band reads off
+    per token. BASELINE.md round-14 row ``wam2d_ig_vit_b16_patch*``."""
+    from wam_tpu.models import bind_inference
+    from wam_tpu.models.vit import vit_b16
+    from wam_tpu.wam2d import WaveletAttribution2D
+
+    model = vit_b16(num_classes=1000, patch=patch)
+    variables = model.init(jax.random.PRNGKey(0), jnp.zeros((1, image, image, 3)))
+    fn = bind_inference(model, variables, nchw=True, compute_dtype=compute_dtype)
+    ex = WaveletAttribution2D(
+        fn, wavelet="haar", method="integratedgrad", n_samples=steps,
+        sample_batch_size=chunk,
+        level_plan="patch", patch=patch, image_size=image,
+    )
+    x = jax.random.normal(jax.random.PRNGKey(5), (1, 3, image, image), jnp.float32)
+    y = jnp.zeros((1,), jnp.int32)
+    return ex, x, y
+
+
+def video_workload(chunk, *, b: int = 4, n: int = 25, frames: int = 16,
+                   size: int = 32):
+    """Video WAM SmoothGrad (wam_tpu.xattr.video): anisotropic 2-spatial /
+    1-temporal decomposition over the zoo's 3D-ResNet-18 consuming clips
+    (B, 1, T, H, W). BASELINE.md round-14 row ``wam3d_video_smooth_*``."""
+    from wam_tpu.models.resnet3d import resnet3d_18
+    from wam_tpu.xattr.video import WaveletAttributionVideo
+
+    vmodel = resnet3d_18(num_classes=10)
+    vvars = vmodel.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 1, frames, size, size)))
+    ex = WaveletAttributionVideo(
+        lambda clip: vmodel.apply(vvars, clip), wavelet="haar",
+        levels=(2, 1), method="smooth", n_samples=n, sample_batch_size=chunk,
+    )
+    x = jax.random.normal(jax.random.PRNGKey(6), (b, 1, frames, size, size),
+                          jnp.float32)
+    y = jnp.arange(b, dtype=jnp.int32) % 10
+    return ex, x, y

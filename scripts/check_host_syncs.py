@@ -30,7 +30,7 @@ Two-tier AST scan, no imports of the scanned code:
      (obs timing belongs OUTSIDE the traced function, in `obs.tracing`
      spans around the dispatch).
 
-Scope: wam_tpu/{core,evalsuite,serve,pipeline,wavelets,obs,testing} plus
+Scope: wam_tpu/{core,evalsuite,serve,pipeline,wavelets,obs,testing,xattr} plus
 the fleet's mesh plumbing (wam_tpu/parallel/{mesh,multihost}.py) and the
 long-context path the fleet's sequence-sharded oversize route runs through
 (wam_tpu/parallel/{halo,halo_modes,seq_estimators}.py). serve/ covers the
@@ -42,7 +42,10 @@ shape products inside shard_map bodies (legal — shapes are concrete under
 trace — but indistinguishable from real syncs here); those are
 `math.prod` on shape tuples now, so the exclusion is lifted — the
 one-fused-dispatch estimator loops are exactly where a hidden per-sample
-sync would hurt most.
+sync would hurt most. wam_tpu/xattr joins with the transformer/video
+subsystem: its estimator bodies (video SmoothGrad/IG, the attention tap
+gradients) and the temporal eval fan are jitted end to end, so the same
+one-fetch/no-hidden-sync rules apply.
 The wavelet core entered scope with the fused synthesis path: its matrix
 builders are host-side numpy BY DESIGN (lru_cached, static under jit), so
 the scan's traced-function detection — not a directory exclusion — is
@@ -61,6 +64,7 @@ import sys
 DEFAULT_DIRS = ("wam_tpu/core", "wam_tpu/evalsuite", "wam_tpu/serve",
                 "wam_tpu/pipeline", "wam_tpu/wavelets", "wam_tpu/obs",
                 "wam_tpu/testing", "wam_tpu/registry", "wam_tpu/pod",
+                "wam_tpu/xattr",
                 "wam_tpu/parallel/mesh.py", "wam_tpu/parallel/multihost.py",
                 "wam_tpu/parallel/halo.py", "wam_tpu/parallel/halo_modes.py",
                 "wam_tpu/parallel/seq_estimators.py")

@@ -1,0 +1,434 @@
+"""Transformer-native & temporal attribution tests (`wam_tpu/xattr/`):
+
+- capture_attn logit parity (the capture-is-free regression) + a numpy
+  tiny-ViT oracle for the captured softmax weights;
+- attention rollout / grad⊙attn numeric goldens vs numpy propagation and
+  finite-difference validation of the tap gradients;
+- patch-aligned level planning (224/384 × patch 16/32 geometry laws,
+  ctor errors on non-divisible inputs) and token-grid aggregation;
+- video transforms (anisotropic roundtrip), video attribution shapes, and
+  the temporal insertion/deletion fan under the one-fetch contract.
+"""
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from wam_tpu.evalsuite.fan import fetch_scope
+from wam_tpu.models.vit import vit_tiny_test
+from wam_tpu.xattr import (
+    VideoLevels,
+    WaveletAttributionVideo,
+    attention_weight_grads,
+    capture_attention_weights,
+    plan_patch_levels,
+    relevance_from_grads,
+    rollout_from_weights,
+    token_grid_map,
+    wavedec_video,
+    waverec_video,
+)
+from wam_tpu.xattr.video_eval import EvalVideoWAM
+
+N_CLASSES = 5
+
+
+@pytest.fixture(scope="module")
+def tiny_vit():
+    """Capture-capable tiny ViT + its variables + an input batch."""
+    model = vit_tiny_test(num_classes=N_CLASSES, capture_attn=True)
+    x_nhwc = jax.random.normal(jax.random.PRNGKey(0), (2, 32, 32, 3))
+    variables = model.init(jax.random.PRNGKey(1), x_nhwc)
+    x = jnp.transpose(x_nhwc, (0, 3, 1, 2))
+    y = jnp.array([1, 3])
+    return model, variables, x, y
+
+
+# -- capture parity + numpy oracle -------------------------------------------
+
+
+def test_capture_attn_logit_parity(tiny_vit):
+    """capture_attn=True must be free: same params, bit-equal logits."""
+    model_on, variables, x, _ = tiny_vit
+    model_off = vit_tiny_test(num_classes=N_CLASSES)
+    base = {k: v for k, v in variables.items() if k != "perturbations"}
+    inp = jnp.transpose(x, (0, 2, 3, 1))
+    off = model_off.apply(base, inp)
+    on = model_on.apply(base, inp)
+    np.testing.assert_array_equal(np.asarray(off), np.asarray(on))
+
+
+def _np_ln(x, p, eps=1e-6):
+    mu = x.mean(-1, keepdims=True)
+    var = x.var(-1, keepdims=True)
+    return (x - mu) / np.sqrt(var + eps) * np.asarray(p["scale"]) + np.asarray(p["bias"])
+
+
+def _np_softmax(z):
+    z = z - z.max(-1, keepdims=True)
+    e = np.exp(z)
+    return e / e.sum(-1, keepdims=True)
+
+
+def _np_gelu(x):
+    erf = np.vectorize(math.erf)
+    return 0.5 * x * (1.0 + erf(x / np.sqrt(2.0)))
+
+
+def _np_vit_forward(params, x_nhwc, patch, depth):
+    """Pure-numpy tiny-ViT forward returning (logits, attn (L, B, H, N, N))
+    — the oracle for the flax capture path."""
+    p = {k: jax.tree_util.tree_map(np.asarray, v) for k, v in params.items()}
+    x = np.asarray(x_nhwc, np.float64)
+    B, H, W, C = x.shape
+    k = p["patch_embed"]["kernel"]
+    x = x.reshape(B, H // patch, patch, W // patch, patch, C)
+    x = x.transpose(0, 1, 3, 2, 4, 5).reshape(B, H // patch, W // patch, -1)
+    x = x @ k.reshape(-1, k.shape[-1]) + p["patch_embed"]["bias"]
+    D = x.shape[-1]
+    x = x.reshape(B, -1, D)
+    x = np.concatenate([np.tile(p["cls_token"], (B, 1, 1)), x], axis=1)
+    x = x + p["pos_embed"]
+    attns = []
+    for i in range(depth):
+        blk = p[f"block{i}"]
+        y = _np_ln(x, blk["ln1"])
+        a = blk["attn"]
+        q = np.einsum("bnd,dhk->bnhk", y, a["query"]["kernel"]) + a["query"]["bias"]
+        kk = np.einsum("bnd,dhk->bnhk", y, a["key"]["kernel"]) + a["key"]["bias"]
+        v = np.einsum("bnd,dhk->bnhk", y, a["value"]["kernel"]) + a["value"]["bias"]
+        hd = q.shape[-1]
+        logits = np.einsum("bqhk,bnhk->bhqn", q / np.sqrt(hd), kk)
+        w = _np_softmax(logits)
+        attns.append(w)
+        o = np.einsum("bhqn,bnhk->bqhk", w, v)
+        o = np.einsum("bqhk,hkd->bqd", o, a["out"]["kernel"]) + a["out"]["bias"]
+        x = x + o
+        y = _np_ln(x, blk["ln2"])
+        h1 = _np_gelu(y @ blk["mlp"]["fc1"]["kernel"] + blk["mlp"]["fc1"]["bias"])
+        x = x + (h1 @ blk["mlp"]["fc2"]["kernel"] + blk["mlp"]["fc2"]["bias"])
+    x = _np_ln(x, p["ln"])
+    logits = x[:, 0] @ p["head"]["kernel"] + p["head"]["bias"]
+    return logits, np.stack(attns)
+
+
+def test_captured_weights_match_numpy_oracle(tiny_vit):
+    model, variables, x, _ = tiny_vit
+    weights = np.asarray(capture_attention_weights(model, variables, x))
+    inp = jnp.transpose(x, (0, 2, 3, 1))
+    ref_logits, ref_attn = _np_vit_forward(variables["params"], inp, patch=8, depth=2)
+    assert weights.shape == ref_attn.shape == (2, 2, 4, 17, 17)
+    np.testing.assert_allclose(weights, ref_attn, atol=2e-5)
+    base = {k: v for k, v in variables.items() if k != "perturbations"}
+    np.testing.assert_allclose(
+        np.asarray(model.apply(base, inp)), ref_logits, atol=1e-3
+    )
+
+
+# -- rollout / grad⊙attn goldens ---------------------------------------------
+
+
+def _np_rollout(attn, residual=0.5):
+    a = attn.mean(2)  # (L, B, N, N)
+    eye = np.eye(a.shape[-1])
+    a = (1 - residual) * a + residual * eye
+    a = a / a.sum(-1, keepdims=True)
+    r = np.broadcast_to(eye, a.shape[1:]).copy()
+    for layer in a:
+        r = layer @ r
+    return r[:, 0, 1:]
+
+
+def test_rollout_matches_numpy(tiny_vit):
+    model, variables, x, _ = tiny_vit
+    weights = capture_attention_weights(model, variables, x)
+    got = np.asarray(rollout_from_weights(weights))
+    ref = _np_rollout(np.asarray(weights)).reshape(2, 4, 4)
+    np.testing.assert_allclose(got, ref, atol=1e-5)
+    # row-stochastic composite: every patch relevance positive, and the
+    # full cls row (incl. the cls self-loop) sums to 1
+    assert (ref > 0).all()
+
+
+def _np_relevance(attn, grads):
+    abar = np.maximum((attn * grads).mean(2), 0.0)
+    eye = np.eye(abar.shape[-1])
+    r = np.broadcast_to(eye, abar.shape[1:]).copy()
+    for layer in abar:
+        r = r + layer @ r
+    return r[:, 0, 1:]
+
+
+def test_attention_gradient_matches_numpy(tiny_vit):
+    model, variables, x, y = tiny_vit
+    weights, grads = attention_weight_grads(model, variables, x, y)
+    got = np.asarray(relevance_from_grads(weights, grads))
+    ref = _np_relevance(np.asarray(weights), np.asarray(grads)).reshape(2, 4, 4)
+    np.testing.assert_allclose(got, ref, atol=1e-5)
+
+
+def test_tap_gradients_match_finite_differences(tiny_vit):
+    """∂(picked-logit sum)/∂A through the perturb tap vs central
+    differences of an explicit tap bump — validates the zero-tap gradient
+    route end to end."""
+    model, variables, x, y = tiny_vit
+    _, grads = attention_weight_grads(model, variables, x, y)
+    base = {k: v for k, v in variables.items() if k != "perturbations"}
+    inp = jnp.transpose(x, (0, 2, 3, 1))
+    shapes = jax.eval_shape(
+        lambda v: model.apply(v, inp, mutable=["perturbations", "intermediates"])[1][
+            "perturbations"
+        ],
+        base,
+    )
+    zeros = jax.tree_util.tree_map(lambda s: jnp.zeros(s.shape, s.dtype), shapes)
+
+    def picked(pert):
+        out, _ = model.apply(
+            {**base, "perturbations": pert}, inp, mutable=["intermediates"]
+        )
+        return float(jnp.take_along_axis(out, y[:, None], axis=1).sum())
+
+    h = 1e-2
+    for block, coord in [(0, (0, 1, 2, 3)), (1, (1, 3, 0, 5))]:
+        def bump(eps):
+            pert = jax.tree_util.tree_map(lambda z: z, zeros)
+            tap = pert[f"block{block}"]["attn"]["attention_weights"]
+            pert[f"block{block}"]["attn"]["attention_weights"] = (
+                tap.at[coord].set(eps)
+            )
+            return pert
+
+        fd = (picked(bump(h)) - picked(bump(-h))) / (2 * h)
+        analytic = float(grads[block][coord])
+        assert analytic == pytest.approx(fd, rel=2e-2, abs=1e-4), (block, coord)
+
+
+# -- patch-aligned level planning --------------------------------------------
+
+
+@pytest.mark.parametrize("image,patch", [(224, 16), (384, 16), (224, 32), (384, 32)])
+def test_plan_patch_levels_geometry(image, patch):
+    plan = plan_patch_levels(image, patch)
+    assert plan.J == int(math.log2(patch))
+    assert plan.tokens == image // patch
+    # every planned level's cell side divides the patch: each token is a
+    # whole number of coefficient cells at every level
+    for j in range(1, plan.J + 1):
+        assert patch % plan.level_cell_px(j) == 0
+    # and the deepest level is exactly token-granular
+    assert plan.level_cell_px(plan.J) == patch
+    assert plan.token_granular_levels() == (plan.J,)
+
+
+@pytest.mark.parametrize("image,patch", [(225, 16), (100, 16), (224, 12), (16, 32), (0, 16)])
+def test_plan_patch_levels_rejects(image, patch):
+    with pytest.raises(ValueError):
+        plan_patch_levels(image, patch)
+
+
+def test_wam2d_patch_plan_threading():
+    from wam_tpu.wam2d import WaveletAttribution2D
+
+    model_fn = lambda xx: jnp.zeros((xx.shape[0], 4))  # noqa: E731
+    ex = WaveletAttribution2D(model_fn, level_plan="patch", patch=16,
+                              image_size=224, J=99)  # J is ignored under the plan
+    assert ex.J == 4 and ex.patch_plan.tokens == 14
+    with pytest.raises(ValueError, match="not divisible"):
+        WaveletAttribution2D(model_fn, level_plan="patch", patch=16, image_size=100)
+    with pytest.raises(ValueError, match="requires image_size"):
+        WaveletAttribution2D(model_fn, level_plan="patch")
+    with pytest.raises(ValueError, match="level_plan"):
+        WaveletAttribution2D(model_fn, level_plan="tokens")
+
+
+def test_token_grid_map():
+    # block-constant map pools exactly
+    m = jnp.arange(4, dtype=jnp.float32).reshape(2, 2)
+    full = jnp.kron(m, jnp.ones((8, 8)))[None]
+    np.testing.assert_allclose(np.asarray(token_grid_map(full, 2))[0], np.asarray(m))
+    with pytest.raises(ValueError, match="token grid"):
+        token_grid_map(jnp.zeros((1, 15, 15)), 2)
+
+
+# -- video transforms & attribution ------------------------------------------
+
+
+@pytest.mark.parametrize("levels", [(3, 1), (2, 2), (2, 0)])
+def test_video_roundtrip(levels):
+    clip = jax.random.normal(jax.random.PRNGKey(2), (2, 1, 8, 16, 16))
+    coeffs = wavedec_video(clip, "haar", levels)
+    rec = waverec_video(coeffs, "haar")[..., :8, :16, :16]
+    np.testing.assert_allclose(np.asarray(rec), np.asarray(clip), atol=1e-4)
+    # structure: finest `temporal` levels are 3D dicts, the rest Detail2D
+    spatial, temporal = levels
+    details = coeffs[1:]  # coarsest..finest
+    kinds = [isinstance(d, dict) for d in details]
+    assert kinds == [False] * (spatial - temporal) + [True] * temporal
+
+
+def test_video_levels_validation():
+    with pytest.raises(ValueError):
+        VideoLevels(0, 0)
+    with pytest.raises(ValueError):
+        VideoLevels(2, 3)
+    assert VideoLevels(2, 2).uniform and not VideoLevels(2, 1).uniform
+
+
+@pytest.fixture(scope="module")
+def video_setup():
+    from wam_tpu.models.toy import toy_conv_model
+
+    toy = toy_conv_model(ndim=3, classes=4)
+    model_fn = lambda clip: toy(clip[:, 0])  # noqa: E731
+    clip = jax.random.normal(jax.random.PRNGKey(3), (2, 1, 8, 16, 16))
+    y = np.array([0, 2])
+    return model_fn, clip, y
+
+
+def test_video_attribution_shapes(video_setup):
+    model_fn, clip, y = video_setup
+    wam = WaveletAttributionVideo(model_fn, levels=(2, 1), n_samples=3,
+                                  sample_batch_size=None)
+    box = wam(clip, jnp.asarray(y))
+    assert box.shape == (2, 8, 16, 16)
+    assert bool(jnp.isfinite(box).all()) and bool((box >= 0).all())
+    assert wam.frame_scores(clip, jnp.asarray(y)).shape == (2, 8)
+
+    ig = WaveletAttributionVideo(model_fn, levels=(2, 1),
+                                 method="integratedgrad", n_samples=3,
+                                 sample_batch_size=None)
+    assert ig(clip, jnp.asarray(y)).shape == (2, 8, 16, 16)
+
+
+def test_video_mesh_gates(video_setup):
+    model_fn, _, _ = video_setup
+    with pytest.raises(ValueError, match="uniform levels"):
+        WaveletAttributionVideo(model_fn, levels=(2, 1), mesh=object())
+    with pytest.raises(ValueError, match="batch_axis"):
+        WaveletAttributionVideo(model_fn, levels=(2, 2), batch_axis="data")
+
+
+def test_video_temporal_auc_one_fetch(video_setup):
+    """Temporal insertion/deletion through the eval fan: exactly ONE result
+    fetch per metric call (the fan engine contract)."""
+    model_fn, clip, y = video_setup
+    wam = WaveletAttributionVideo(model_fn, levels=(2, 1), n_samples=3,
+                                  sample_batch_size=None)
+    ev = EvalVideoWAM(model_fn, wam, batch_size=32)
+    with fetch_scope() as fs:
+        ins = ev.insertion(clip, y, n_iter=4)
+    assert fs.count == 1
+    with fetch_scope() as fs:
+        dele = ev.deletion(clip, y, n_iter=4)
+    assert fs.count == 1
+    assert len(ins) == len(dele) == 2
+    assert all(np.isfinite(v) for v in ins + dele)
+    assert len(ev.insertion_curves) == 2
+    # curves span the 1 + (n_iter+1) fused forwards minus the reference col
+    assert np.asarray(ev.insertion_curves[0]).shape[-1] == 5
+
+    # frame-scores explainer (B, T) is accepted directly
+    ev2 = EvalVideoWAM(model_fn, lambda x, yy: wam.frame_scores(x, yy),
+                       batch_size=32)
+    with fetch_scope() as fs:
+        ins2 = ev2.insertion(clip, y, n_iter=4)
+    assert fs.count == 1 and len(ins2) == 2
+
+
+# -- evalsuite registration ---------------------------------------------------
+
+
+def test_eval_baselines_attention_methods_one_fetch(tiny_vit):
+    from wam_tpu.evalsuite.eval_baselines import IMAGE_METHODS, EvalImageBaselines
+
+    assert "rollout" in IMAGE_METHODS and "attngrad" in IMAGE_METHODS
+    model, variables, x, y = tiny_vit
+    y = np.asarray(y)
+    for method in ("rollout", "attngrad"):
+        ev = EvalImageBaselines(model, variables, method=method, batch_size=32)
+        with fetch_scope() as fs:
+            ins = ev.insertion(x, y, n_iter=4)
+        assert fs.count == 1, method
+        with fetch_scope() as fs:
+            mu = ev.mu_fidelity(x, y, grid_size=4, sample_size=8, subset_size=5)
+        assert fs.count == 1, method
+        assert len(ins) == 2 and np.asarray(mu).shape == (2,)
+
+
+def test_eval_baselines_require_capture(tiny_vit):
+    from wam_tpu.evalsuite.eval_baselines import EvalImageBaselines
+
+    _, variables, _, _ = tiny_vit
+    model_off = vit_tiny_test(num_classes=N_CLASSES)
+    with pytest.raises(ValueError, match="capture_attn"):
+        EvalImageBaselines(model_off, variables, method="attngrad")
+
+
+def test_patch_wam_eval_and_analyzer(tiny_vit):
+    from wam_tpu.analyzers import WAMAnalyzerViT
+    from wam_tpu.evalsuite.eval2d import Eval2DWAM
+    from wam_tpu.wam2d import WaveletAttribution2D
+
+    model, variables, x, y = tiny_vit
+    base = {k: v for k, v in variables.items() if k != "perturbations"}
+    model_fn = lambda xx: model.apply(base, jnp.transpose(xx, (0, 2, 3, 1)))  # noqa: E731
+    wam = WaveletAttribution2D(model_fn, level_plan="patch", patch=8,
+                               image_size=32, n_samples=3,
+                               sample_batch_size=None)
+    assert wam.J == 3  # planned from patch 8
+
+    an = WAMAnalyzerViT(wam)
+    tm = an.token_maps(x, y)
+    assert tm.shape == (2, 3, 4, 4)
+    assert an.token_importance(x, y).shape == (2, 4, 4)
+
+    ev = Eval2DWAM(model_fn, wam, J=wam.J, batch_size=32)
+    with fetch_scope() as fs:
+        ins = ev.insertion(x, np.asarray(y), n_iter=4)
+    assert fs.count == 1 and len(ins) == 2
+
+    plain = WaveletAttribution2D(model_fn, J=3)
+    with pytest.raises(ValueError, match="level_plan='patch'"):
+        WAMAnalyzerViT(plain)
+
+
+def test_tune_presets_registered():
+    from wam_tpu.tune.workloads import get_workload
+
+    wv = get_workload("wamvit2d")
+    assert wv.workload == "wam2d" and wv.shape == (3, 64, 64)
+    labels = [c.label() for c in wv.candidates]
+    assert any("nchw" in l for l in labels)
+    assert any("synth=matmul" in l for l in labels)
+    assert any("stream=on" in l for l in labels)
+
+    wd = get_workload("wamvid3d")
+    assert wd.workload == "wamvid3d" and wd.shape == (1, 8, 16, 16)
+    fn, args = wd.build(wd.candidates[0])
+    out = jax.block_until_ready(fn(*args))
+    assert out.shape == (wd.batch, 8, 16, 16)
+
+
+@pytest.mark.slow
+def test_video_mesh_smoothgrad_runs():
+    """Uniform-level video WAM composes with SeqShardedWam time sharding:
+    deterministic, finite, correctly shaped output on a 2-device mesh."""
+    from jax.sharding import Mesh
+
+    from wam_tpu.models.toy import toy_conv_model
+
+    toy = toy_conv_model(ndim=3, classes=4)
+    model_fn = lambda clip: toy(clip[:, 0])  # noqa: E731
+    mesh = Mesh(np.array(jax.devices()[:2]), ("data",))
+    wam = WaveletAttributionVideo(model_fn, levels=(2, 2), n_samples=3,
+                                  sample_batch_size=1, mesh=mesh)
+    clip = jax.random.normal(jax.random.PRNGKey(4), (2, 1, 16, 16, 16))
+    box = wam(clip, jnp.array([0, 1]))
+    assert box.shape == (2, 16, 16, 16)
+    assert bool(jnp.isfinite(box).all())
+    box2 = wam(clip, jnp.array([0, 1]))
+    np.testing.assert_array_equal(np.asarray(box), np.asarray(box2))

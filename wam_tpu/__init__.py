@@ -37,7 +37,18 @@ from wam_tpu.core import WamEngine, integrated_path, smoothgrad, target_loss
 from wam_tpu.wam1d import BaseWAM1D, VisualizerWAM1D, WaveletAttribution1D
 from wam_tpu.wam2d import BaseWAM2D, WaveletAttribution2D
 from wam_tpu.wam3d import BaseWAM3D, WaveletAttribution3D
-from wam_tpu.analyzers import WAMAnalyzer2D
+from wam_tpu.analyzers import WAMAnalyzer2D, WAMAnalyzerViT
+
+# Transformer-native & temporal attribution (wam_tpu.xattr)
+from wam_tpu.xattr import (
+    EvalVideoWAM,
+    VideoLevels,
+    WaveletAttributionVideo,
+    attention_gradient,
+    attention_rollout,
+    plan_patch_levels,
+    token_grid_map,
+)
 
 __version__ = "0.1.0"
 
@@ -69,4 +80,12 @@ __all__ = [
     "BaseWAM3D",
     "WaveletAttribution3D",
     "WAMAnalyzer2D",
+    "WAMAnalyzerViT",
+    "attention_rollout",
+    "attention_gradient",
+    "plan_patch_levels",
+    "token_grid_map",
+    "VideoLevels",
+    "WaveletAttributionVideo",
+    "EvalVideoWAM",
 ]

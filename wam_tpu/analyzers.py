@@ -27,6 +27,7 @@ __all__ = [
     "generate_partial_image",
     "generate_disentangled_images",
     "WAMAnalyzer2D",
+    "WAMAnalyzerViT",
 ]
 
 
@@ -79,6 +80,47 @@ def generate_disentangled_images(
     binary = (masks > (masks.min() + EPS)).astype(image.dtype)
     partial = _masked_rec(image, binary, J, wavelet)
     return partial, masks
+
+
+class WAMAnalyzerViT:
+    """Token-grid aggregation of patch-aligned WAM mosaics — the
+    transformer sibling of the CAM path's token-tap fold
+    (`evalsuite.baselines._acts_and_grads`).
+
+    ``explainer`` is a `WaveletAttribution2D` built with
+    ``level_plan="patch"`` (wam_tpu.xattr.planner plans the depth); its
+    plan fixes the token grid, and every per-level pixel map average-pools
+    EXACTLY onto it, so scale disentanglement reads off per token: which
+    tokens matter, and at which dyadic scale."""
+
+    def __init__(self, explainer):
+        plan = getattr(explainer, "patch_plan", None)
+        if plan is None:
+            raise ValueError(
+                "WAMAnalyzerViT needs an explainer constructed with "
+                "level_plan='patch' (WaveletAttribution2D) — an explicit-J "
+                "explainer carries no token grid to aggregate onto"
+            )
+        self.explainer = explainer
+        self.plan = plan
+
+    def token_maps(self, x, y=None) -> jax.Array:
+        """(B, J(+1), t, t): per-level token-grid importance — |mosaic|
+        reprojected to per-level pixel maps, pooled onto the plan's
+        token grid (the approximation band joins per the explainer's
+        ``approx_coeffs``)."""
+        from wam_tpu.ops.packing2d import reproject_mosaic
+        from wam_tpu.xattr.planner import token_grid_map
+
+        mosaic = self.explainer(x, y)
+        scales = reproject_mosaic(
+            jnp.abs(mosaic), self.plan.J, self.explainer.approx_coeffs
+        )
+        return token_grid_map(scales, self.plan.tokens)
+
+    def token_importance(self, x, y=None) -> jax.Array:
+        """(B, t, t): level-summed token importance."""
+        return self.token_maps(x, y).sum(axis=1)
 
 
 class WAMAnalyzer2D:

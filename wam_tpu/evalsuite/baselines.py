@@ -39,6 +39,8 @@ __all__ = [
     "make_eps_tap",
     "lrp_eps",
     "lrp",
+    "attention_rollout",
+    "attention_gradient",
 ]
 
 
@@ -297,3 +299,22 @@ def lrp(model, variables, x: jax.Array, y, eps: float = 1e-6,
     if isinstance(model, ResNet):
         return lrp_resnet(model, variables, x, y, eps=eps, nchw=nchw)
     return lrp_eps(model, variables, x, y, eps=eps, nchw=nchw)
+
+
+def attention_rollout(model, variables, x: jax.Array, y=None,
+                      nchw: bool = True) -> jax.Array:
+    """Attention rollout (Abnar & Zuidema 2020) for capture_attn ViTs —
+    registry delegation to `wam_tpu.xattr.attention` (the transformer
+    pillar lives there; this keeps one import site per method family)."""
+    from wam_tpu.xattr.attention import attention_rollout as impl
+
+    return impl(model, variables, x, y, nchw=nchw)
+
+
+def attention_gradient(model, variables, x: jax.Array, y,
+                       nchw: bool = True) -> jax.Array:
+    """grad⊙attn relevance (Chefer et al. 2021) for capture_attn ViTs —
+    registry delegation to `wam_tpu.xattr.attention`."""
+    from wam_tpu.xattr.attention import attention_gradient as impl
+
+    return impl(model, variables, x, y, nchw=nchw)

@@ -44,6 +44,10 @@ IMAGE_METHODS = (
     "guided_backprop",
     "gradxinput",
     "lrp",
+    # transformer-native (wam_tpu.xattr.attention; need a ViT built with
+    # capture_attn=True so the softmax weights materialize)
+    "rollout",
+    "attngrad",
 )
 AUDIO_METHODS = ("saliency", "integratedgrad", "smoothgrad", "gradcam")
 
@@ -71,6 +75,12 @@ class _BaseEvalBaselines:
             )
         if method not in methods:
             raise ValueError(f"Unknown method {method!r}; expected one of {methods}")
+        if method in ("rollout", "attngrad") and not getattr(model, "capture_attn", False):
+            raise ValueError(
+                f"method {method!r} reads per-block attention weights — build "
+                "the ViT with capture_attn=True (models/vit.py); the stock "
+                "attention body never materializes them"
+            )
         self.model = model
         # compute_dtype (e.g. jnp.bfloat16): cast float params/stats ONCE so
         # every path — the perturbation-fan model_fn AND the CAM/LRP routes
@@ -152,6 +162,10 @@ class _BaseEvalBaselines:
             return B.gradient_x_input(self.model_fn, x, y)
         if m == "lrp":
             return B.lrp(self.model, self.variables, x, y, nchw=self.nchw)
+        if m == "rollout":
+            return B.attention_rollout(self.model, self.variables, x, y, nchw=self.nchw)
+        if m == "attngrad":
+            return B.attention_gradient(self.model, self.variables, x, y, nchw=self.nchw)
         raise AssertionError(m)
 
     def precompute(self, x, y):

@@ -4,6 +4,15 @@ and a timm-zoo counterpart (`src/helpers.py:468-479`).
 
 Pre-norm encoder, learned position embeddings, class token. Sizes are
 constructor fields so tests can instantiate tiny variants.
+
+``capture_attn=True`` swaps the attention body for an intermediate-capturing
+variant (`capturing_attention`): per-block softmax weights are sown into the
+'intermediates' collection and tapped with a zero `perturb` for gradient
+capture — the transformer-native baselines (attention rollout, grad⊙attn)
+read both (`wam_tpu.xattr.attention`). The flag changes NO parameters and,
+when off, NO code path: the encoder calls the stock
+`nn.MultiHeadDotProductAttention` body exactly as before, so checkpoints
+ingest identically and logits are bit-equal (tests/test_xattr.py parity).
 """
 
 from __future__ import annotations
@@ -15,7 +24,36 @@ import jax.numpy as jnp
 
 from wam_tpu.models.patchconv import PatchConv
 
-__all__ = ["ViT", "vit_b16", "vit_tiny_test"]
+__all__ = ["ViT", "capturing_attention", "vit_b16", "vit_tiny_test"]
+
+
+def capturing_attention(query, key, value, dtype=None, precision=None,
+                        module=None):
+    """Drop-in `attention_fn` for `nn.MultiHeadDotProductAttention` that
+    exposes the softmax weights twice: sown into
+    ('intermediates', 'attention_weights') for the forward-only readers
+    (attention rollout), and routed through a zero `perturb` tap named
+    'attention_weights' so ∂logit/∂A materializes under a 'perturbations'
+    collection (grad⊙attn — the JAX analogue of Chefer et al.'s backward
+    hooks). Numerically identical to the stock path: the weights come from
+    flax's own `dot_product_attention_weights` and the value contraction is
+    the stock einsum, and both sow and perturb are identity when their
+    collections are absent."""
+    weights = nn.dot_product_attention_weights(
+        query, key, dtype=dtype, precision=precision
+    )
+    module.sow("intermediates", "attention_weights", weights)
+    # Tap only when the tap can exist: materialization passes (mutable
+    # 'perturbations') and gradient passes (tap variable supplied). A plain
+    # apply with init-time variables carries the ViT's 'tokens' tap but not
+    # these — `perturb` would raise on the missing name, so skip (identical
+    # forward either way; the tap adds zero).
+    if module.is_mutable_collection("perturbations") or module.scope.has_variable(
+        "perturbations", "attention_weights"
+    ):
+        weights = module.perturb("attention_weights", weights)
+    return jnp.einsum("...hqk,...khd->...qhd", weights, value,
+                      precision=precision)
 
 
 class MlpBlock(nn.Module):
@@ -33,11 +71,19 @@ class MlpBlock(nn.Module):
 class EncoderBlock(nn.Module):
     heads: int
     mlp_hidden: int
+    capture_attn: bool = False
 
     @nn.compact
     def __call__(self, x):
         y = nn.LayerNorm(name="ln1")(x)
-        y = nn.MultiHeadDotProductAttention(num_heads=self.heads, name="attn")(y, y)
+        # capture on: same params ({query,key,value,out} under 'attn'), same
+        # math — only the attention_fn differs, and it sows/taps the weights
+        attn = nn.MultiHeadDotProductAttention(
+            num_heads=self.heads, name="attn",
+            **({"attention_fn": capturing_attention} if self.capture_attn
+               else {}),
+        )
+        y = attn(y, y, sow_weights=self.capture_attn)
         x = x + y
         y = nn.LayerNorm(name="ln2")(x)
         return x + MlpBlock(self.mlp_hidden, name="mlp")(y)
@@ -50,6 +96,7 @@ class ViT(nn.Module):
     depth: int = 12
     heads: int = 12
     mlp_hidden: int = 3072
+    capture_attn: bool = False
 
     @nn.compact
     def __call__(self, x, train: bool = False):
@@ -67,7 +114,9 @@ class ViT(nn.Module):
         )
         x = x + pos
         for i in range(self.depth):
-            x = EncoderBlock(self.heads, self.mlp_hidden, name=f"block{i}")(x)
+            x = EncoderBlock(self.heads, self.mlp_hidden,
+                             capture_attn=self.capture_attn,
+                             name=f"block{i}")(x)
         self.sow("intermediates", "tokens", x)
         # Gradient tap for the GradCAM-family baselines (token-grid CAM):
         # no-op unless a 'perturbations' collection is passed

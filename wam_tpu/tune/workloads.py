@@ -21,6 +21,15 @@ Presets:
   slowest eval row).
 - ``fan2d`` — the insertion-AUC fan at production geometry, same two axes,
   persisted under the (n_iter+1)-row eval2d key every AUC metric resolves.
+- ``wamvit2d`` — patch-aligned ViT WAM (tiny capture-capable ViT, patch 8
+  on 64² inputs → the planner's J=3) at CPU-fast geometry, sweeping chunks,
+  stream_noise, an NCHW layout probe (the ViT is natively channel-last)
+  and the matmul synthesis probe; persists under the same ``wam2d`` cache
+  key family the engine resolves, at the ViT shape.
+- ``wamvid3d`` — video WAM (anisotropic space+time decomposition,
+  `xattr.video`) over a toy 3D conv, sweeping chunks, stream_noise and the
+  synthesis impl; persists under the ``wamvid3d`` key
+  `WaveletAttributionVideo(sample_batch_size="auto")` resolves.
 - ``wamseq1d`` / ``wamseq2d`` — the sequence-sharded long-context loops
   (`parallel.seq_estimators.SeqShardedWam`) over the largest power-of-two
   device mesh available, sweeping the sample chunk × the fused-vs-split
@@ -358,11 +367,121 @@ def _wamseq2d_workload(n_samples: int = 4, batch: int = 2,
                     candidates=_seq_candidates(), build=build)
 
 
+def _wamvit2d_workload(n_samples: int = 8, batch: int = 4,
+                       image: int = 64, patch: int = 8) -> Workload:
+    """Patch-aligned ViT WAM at CPU-fast geometry: the decomposition depth
+    comes from the planner (image 64 / patch 8 → J=3, token-granular level
+    3), the runner is the flagship's chunked-smoothgrad shape over the
+    capture-capable tiny ViT. Default layout is channel-last (the ViT's
+    native layout — the engine transposes once, outside the mapped chunk);
+    one NCHW probe checks the transpose placement actually pays."""
+    from wam_tpu.core.engine import WamEngine
+    from wam_tpu.models.vit import ViT
+    from wam_tpu.xattr.planner import plan_patch_levels
+
+    plan = plan_patch_levels(image, patch)
+    model = ViT(num_classes=8, patch=patch, dim=32, depth=2, heads=2,
+                mlp_hidden=64)
+    variables = model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, image, image, 3)))
+    base = {k: v for k, v in variables.items() if k != "perturbations"}
+    x = jax.random.normal(jax.random.PRNGKey(1), (batch, 3, image, image))
+    y = jnp.arange(batch, dtype=jnp.int32) % 8
+    key = jax.random.PRNGKey(42)
+
+    def build(cand: Candidate):
+        from wam_tpu.wavelets.transform import set_synth2_impl
+
+        set_synth2_impl(cand.synth_impl if cand.synth_impl is not None
+                        else "auto")
+        nchw = cand.layout == "nchw"
+        if nchw:
+            model_fn = lambda xx: model.apply(  # noqa: E731
+                base, jnp.transpose(xx, (0, 2, 3, 1)))
+        else:
+            model_fn = lambda xx: model.apply(base, xx)  # noqa: E731
+        engine = WamEngine(model_fn, ndim=2, wavelet="haar", level=plan.J,
+                           mode="reflect", channel_last=not nchw)
+        return _smoothgrad_runner(
+            engine, x, y, key, n_samples=n_samples, chunk=cand.sample_chunk,
+            stream=bool(cand.stream_noise), channel_last=not nchw,
+        )
+
+    chunks = chunk_candidates(batch, n_samples, targets=(8, 16))
+    cands = [Candidate(sample_chunk=c, stream_noise=False) for c in chunks]
+    cands.append(Candidate(sample_chunk=chunks[0], stream_noise=True))
+    cands.append(Candidate(sample_chunk=chunks[0], stream_noise=False,
+                           layout="nchw"))
+    cands.append(Candidate(sample_chunk=chunks[0], stream_noise=False,
+                           synth_impl="matmul"))
+    return Workload(name="wamvit2d", workload="wam2d",
+                    shape=(3, image, image), batch=batch, items=batch,
+                    candidates=cands, build=build)
+
+
+def _wamvid3d_workload(n_samples: int = 8, batch: int = 2, frames: int = 8,
+                       size: int = 16) -> Workload:
+    """Video WAM sweep (anisotropic 2-spatial/1-temporal decomposition over
+    a toy 3D conv). The runner is the `WaveletAttributionVideo` SmoothGrad
+    body inlined — raw transforms, no tuned-cache reads inside the sweep
+    (the same never-resolve-"auto" rule every preset follows); winners
+    persist under the ``wamvid3d`` key the engine's
+    ``sample_batch_size="auto"`` resolves."""
+    from wam_tpu.core.engine import target_loss
+    from wam_tpu.core.estimators import smoothgrad
+    from wam_tpu.models.toy import toy_conv_model
+    from wam_tpu.xattr.video import spacetime_map, wavedec_video, waverec_video
+
+    toy = toy_conv_model(ndim=3, classes=4)
+    model_fn = lambda clip: toy(clip[:, 0])  # noqa: E731
+    x = jax.random.normal(jax.random.PRNGKey(1), (batch, 1, frames, size, size))
+    y = jnp.arange(batch, dtype=jnp.int32) % 4
+    key = jax.random.PRNGKey(42)
+    levels = (2, 1)
+
+    def build(cand: Candidate):
+        from wam_tpu.wavelets.transform import set_synth2_impl
+
+        set_synth2_impl(cand.synth_impl if cand.synth_impl is not None
+                        else "auto")
+        chunk = cand.sample_chunk
+        stream = bool(cand.stream_noise)
+
+        @jax.jit
+        def run(x, key):
+            def step(noisy):
+                coeffs = wavedec_video(noisy, "haar", levels, "symmetric")
+
+                def loss(cs):
+                    rec = waverec_video(cs, "haar")[..., :frames, :size, :size]
+                    return target_loss(model_fn(rec), y)
+
+                grads = jax.grad(loss)(coeffs)
+                return spacetime_map(grads, (frames, size, size)).mean(axis=1)
+
+            return smoothgrad(step, x, key, n_samples=n_samples,
+                              stdev_spread=1e-4, batch_size=chunk,
+                              materialize_noise=not stream)
+
+        return run, (x, key)
+
+    chunks = chunk_candidates(batch, n_samples, targets=(4, 8))
+    cands = [Candidate(sample_chunk=c, stream_noise=False) for c in chunks]
+    cands.append(Candidate(sample_chunk=chunks[0], stream_noise=True))
+    cands.append(Candidate(sample_chunk=chunks[0], stream_noise=False,
+                           synth_impl="matmul"))
+    return Workload(name="wamvid3d", workload="wamvid3d",
+                    shape=(1, frames, size, size), batch=batch, items=batch,
+                    candidates=cands, build=build)
+
+
 WORKLOADS: dict[str, Callable[..., Workload]] = {
     "toy": _toy_workload,
     "flagship": _flagship_workload,
     "mu2d": _mu2d_workload,
     "fan2d": _fan2d_workload,
+    "wamvit2d": _wamvit2d_workload,
+    "wamvid3d": _wamvid3d_workload,
     "wamseq1d": _wamseq1d_workload,
     "wamseq2d": _wamseq2d_workload,
 }

@@ -62,9 +62,29 @@ class BaseWAM2D:
         approx_coeffs: bool = False,
         normalize_coeffs: bool = True,
         model_layout: str = "nchw",
+        level_plan: str = "explicit",
+        patch: int = 16,
+        image_size: int | None = None,
     ):
         if model_layout not in ("nchw", "nhwc"):
             raise ValueError(f"model_layout must be 'nchw' or 'nhwc', got {model_layout!r}")
+        if level_plan not in ("explicit", "patch"):
+            raise ValueError(
+                f"level_plan must be 'explicit' or 'patch', got {level_plan!r}")
+        # level_plan="patch": ignore J and plan the decomposition depth from
+        # the ViT patch grid (wam_tpu.xattr.planner) — levels align to token
+        # granularity (224/patch-16 → J=4, level-4 cells = 1 token). The
+        # geometry is validated HERE, at construction, so a non-divisible
+        # input size fails before any trace.
+        self.level_plan = level_plan
+        self.patch_plan = None
+        if level_plan == "patch":
+            from wam_tpu.xattr.planner import plan_patch_levels
+
+            if image_size is None:
+                raise ValueError("level_plan='patch' requires image_size=")
+            self.patch_plan = plan_patch_levels(image_size, patch, wavelet)
+            J = self.patch_plan.J
         self.wavelet = wavelet
         self.J = J
         self.mode = mode
@@ -213,6 +233,9 @@ class WaveletAttribution2D(BaseWAM2D):
         batch_axis: str | None = None,
         seq_fused: bool | str = "auto",
         donate_inputs: bool | None = None,
+        level_plan: str = "explicit",
+        patch: int = 16,
+        image_size: int | None = None,
     ):
         super().__init__(
             model_fn,
@@ -222,6 +245,9 @@ class WaveletAttribution2D(BaseWAM2D):
             approx_coeffs=approx_coeffs,
             normalize_coeffs=normalize_coeffs,
             model_layout=model_layout,
+            level_plan=level_plan,
+            patch=patch,
+            image_size=image_size,
         )
         # Long-context mode: mesh= shards the image ROW axis over seq_axis
         # end to end (decompose → model → grads → per-sample mosaic); see
@@ -243,7 +269,7 @@ class WaveletAttribution2D(BaseWAM2D):
                 seq_model,
                 ndim=2,
                 wavelet=wavelet,
-                level=J,
+                level=self.J,  # the planned depth under level_plan="patch"
                 mode=mode,
                 seq_axis=seq_axis,
                 post_fn=lambda g: mosaic2d(g, normalize_coeffs, 1),

@@ -1,0 +1,53 @@
+"""Transformer-native & temporal attribution (`wam_tpu/xattr/`).
+
+Three pillars on top of the conv-shaped core:
+
+- `xattr.attention` — attention rollout and grad⊙attn relevance from the
+  ViT's captured softmax weights (``capture_attn=True``), the standard
+  transformer baselines, under the evalsuite's (x, y) → (B, H, W)
+  contract;
+- `xattr.planner` — patch-aligned wavelet level planning
+  (``level_plan="patch"`` in `WaveletAttribution2D`) + token-grid
+  aggregation, so WAM's scale disentanglement maps onto ViT tokens;
+- `xattr.video` / `xattr.video_eval` — video WAM (2D space + time with
+  an anisotropic level spec) and temporal insertion/deletion through the
+  fan engine's one-fetch contract.
+"""
+
+from wam_tpu.xattr.attention import (
+    attention_gradient,
+    attention_rollout,
+    attention_weight_grads,
+    capture_attention_weights,
+    relevance_from_grads,
+    rollout_from_weights,
+)
+from wam_tpu.xattr.planner import PatchLevelPlan, plan_patch_levels, token_grid_map
+from wam_tpu.xattr.video import (
+    VideoLevels,
+    WaveletAttributionVideo,
+    frame_importance,
+    spacetime_map,
+    wavedec_video,
+    waverec_video,
+)
+from wam_tpu.xattr.video_eval import EvalVideoWAM
+
+__all__ = [
+    "attention_rollout",
+    "attention_gradient",
+    "attention_weight_grads",
+    "capture_attention_weights",
+    "rollout_from_weights",
+    "relevance_from_grads",
+    "PatchLevelPlan",
+    "plan_patch_levels",
+    "token_grid_map",
+    "VideoLevels",
+    "WaveletAttributionVideo",
+    "wavedec_video",
+    "waverec_video",
+    "spacetime_map",
+    "frame_importance",
+    "EvalVideoWAM",
+]

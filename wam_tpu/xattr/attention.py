@@ -1,0 +1,182 @@
+"""Transformer-native attribution baselines: attention rollout and
+grad⊙attn relevance propagation.
+
+Both read the per-block softmax weights that `models/vit.py` exposes when
+built with ``capture_attn=True``:
+
+- forward weights are **sown** into
+  ``intermediates/block{i}/attn/attention_weights`` — read with
+  ``mutable=["intermediates"]`` (`capture_attention_weights`);
+- the same tensors are routed through a zero **perturb tap** of the same
+  name, so ∂logit/∂A materializes exactly like the CAM taps do
+  (`wam_tpu.evalsuite.baselines._acts_and_grads`) — the JAX analogue of
+  Chefer et al.'s backward hooks.
+
+Methods (both map (x, y) → a (B, H, W) pixel-domain map, the
+`evalsuite/baselines.py` contract, and both are plain traced JAX — the
+evaluators jit ONE dispatch around them):
+
+- `attention_rollout` — Abnar & Zuidema (2020): per block, head-averaged
+  weights mixed with the residual identity (``0.5·A + 0.5·I``),
+  row-normalized, then matmul-composed input→output; the class-token row
+  of the composite is the per-patch relevance.
+- `attention_gradient` — the gradient-weighted variant of Chefer et al.
+  (2021, "generic attention explainability"): per block
+  ``Ā = ReLU(E_h[∂logit/∂A ⊙ A])``, propagated through the residual
+  stream as ``R ← R + Ā @ R`` from the first block up; class-token row
+  again.
+
+Token-grid maps are bilinearly resized to the input (H, W) so the fan
+evaluators perturb pixels exactly as they do for the CNN baselines.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "capture_attention_weights",
+    "attention_weight_grads",
+    "rollout_from_weights",
+    "relevance_from_grads",
+    "attention_rollout",
+    "attention_gradient",
+]
+
+
+def _require_capture(model) -> None:
+    if not getattr(model, "capture_attn", False):
+        raise ValueError(
+            "attention baselines need the ViT built with capture_attn=True "
+            "(models/vit.py) — the stock attention body never materializes "
+            "its softmax weights"
+        )
+
+
+def _block_stack(tree: dict, leaf: str) -> jax.Array:
+    """Stack ``block{i}/attn/{leaf}`` entries into (L, B, heads, N, N),
+    ordered by block index (dict order is insertion order = depth order,
+    but sort defensively)."""
+    names = sorted(
+        (k for k in tree if k.startswith("block")), key=lambda k: int(k[5:])
+    )
+    if not names:
+        raise ValueError(
+            "no block*/attn attention weights captured — was the model built "
+            "with capture_attn=True?"
+        )
+    leaves = []
+    for name in names:
+        v = tree[name]["attn"][leaf]
+        # sown values arrive as a 1-tuple (flax sow default reduce_fn)
+        leaves.append(v[0] if isinstance(v, tuple) else v)
+    return jnp.stack(leaves)
+
+
+def capture_attention_weights(model, variables, x, nchw: bool = True) -> jax.Array:
+    """One forward pass; returns the softmax stacks (L, B, heads, N, N)
+    including the class token (N = 1 + tokens)."""
+    _require_capture(model)
+    base = {k: v for k, v in variables.items() if k != "perturbations"}
+    inp = jnp.transpose(x, (0, 2, 3, 1)) if nchw else x
+    _, state = model.apply(base, inp, mutable=["intermediates"])
+    return _block_stack(state["intermediates"], "attention_weights")
+
+
+def attention_weight_grads(model, variables, x, y, nchw: bool = True):
+    """(weights, grads), each (L, B, heads, N, N): ∂(picked-logit sum)/∂A
+    through the zero perturb taps. Sum (not mean) of picked logits keeps
+    per-sample gradients batch-size independent, matching the CAM
+    convention (`evalsuite.baselines._acts_and_grads`)."""
+    _require_capture(model)
+    base = {k: v for k, v in variables.items() if k != "perturbations"}
+    inp = jnp.transpose(x, (0, 2, 3, 1)) if nchw else x
+    # Materialize zero taps at THIS batch's shapes (shape-only trace): the
+    # stored perturbation variables carry the init batch size.
+    pert_shapes = jax.eval_shape(
+        lambda v: model.apply(v, inp, mutable=["perturbations", "intermediates"])[1][
+            "perturbations"
+        ],
+        base,
+    )
+    perturbs = jax.tree_util.tree_map(
+        lambda s: jnp.zeros(s.shape, s.dtype), pert_shapes
+    )
+
+    def loss_fn(pert):
+        out, state = model.apply(
+            {**base, "perturbations": pert}, inp, mutable=["intermediates"]
+        )
+        out = out[0] if isinstance(out, tuple) else out
+        if y is None:
+            return out.sum(), state["intermediates"]
+        picked = jnp.take_along_axis(out, jnp.asarray(y)[:, None], axis=1)
+        return picked.sum(), state["intermediates"]
+
+    (_, inter), grads = jax.value_and_grad(loss_fn, has_aux=True)(perturbs)
+    weights = _block_stack(inter, "attention_weights")
+    gstack = _block_stack(grads, "attention_weights")
+    return weights, gstack
+
+
+def _cls_row_to_grid(rel_row: jax.Array) -> jax.Array:
+    """(B, N) class-token relevance row → (B, side, side) patch grid."""
+    n = rel_row.shape[-1] - 1
+    side = int(n**0.5)
+    if side * side != n:
+        raise ValueError(f"{n} patch tokens is not a square grid")
+    return rel_row[:, 1:].reshape(rel_row.shape[0], side, side)
+
+
+def rollout_from_weights(weights: jax.Array, residual: float = 0.5) -> jax.Array:
+    """Attention rollout over a (L, B, heads, N, N) stack → (B, s, s).
+
+    Head-average each block, mix in the residual identity, row-normalize,
+    then compose input→output; the class-token row of the composite is the
+    relevance of each patch token for the classification read-out."""
+    a = weights.mean(axis=2)  # (L, B, N, N)
+    eye = jnp.eye(a.shape[-1], dtype=a.dtype)
+    a = (1.0 - residual) * a + residual * eye
+    a = a / a.sum(axis=-1, keepdims=True)
+
+    def compose(carry, layer):
+        return layer @ carry, None
+
+    rollout, _ = jax.lax.scan(compose, jnp.broadcast_to(eye, a.shape[1:]), a)
+    return _cls_row_to_grid(rollout[:, 0, :])
+
+
+def relevance_from_grads(weights: jax.Array, grads: jax.Array) -> jax.Array:
+    """Chefer-style grad⊙attn relevance over (L, B, heads, N, N) stacks
+    → (B, s, s): per block ``Ā = ReLU(E_h[grad ⊙ A])``, accumulated
+    through the residual stream as ``R ← R + Ā @ R`` from block 0 up."""
+    abar = jax.nn.relu((grads * weights).mean(axis=2))  # (L, B, N, N)
+    eye = jnp.eye(abar.shape[-1], dtype=abar.dtype)
+
+    def accumulate(carry, layer):
+        return carry + layer @ carry, None
+
+    rel, _ = jax.lax.scan(accumulate, jnp.broadcast_to(eye, abar.shape[1:]), abar)
+    return _cls_row_to_grid(rel[:, 0, :])
+
+
+def _resize_to(grid: jax.Array, hw) -> jax.Array:
+    return jax.image.resize(grid, grid.shape[:-2] + tuple(hw), method="bilinear")
+
+
+def attention_rollout(model, variables, x, y=None, nchw: bool = True) -> jax.Array:
+    """Abnar & Zuidema rollout → (B, H, W). ``y`` is accepted (and
+    ignored) so the evaluator registry can call every method uniformly —
+    rollout is class-agnostic by construction."""
+    del y
+    weights = capture_attention_weights(model, variables, x, nchw=nchw)
+    return _resize_to(rollout_from_weights(weights), x.shape[-2:] if nchw else x.shape[1:3])
+
+
+def attention_gradient(model, variables, x, y, nchw: bool = True) -> jax.Array:
+    """Gradient-weighted attention relevance (grad⊙attn) → (B, H, W)."""
+    weights, grads = attention_weight_grads(model, variables, x, y, nchw=nchw)
+    return _resize_to(
+        relevance_from_grads(weights, grads), x.shape[-2:] if nchw else x.shape[1:3]
+    )

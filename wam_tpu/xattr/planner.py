@@ -1,0 +1,95 @@
+"""Patch-aligned wavelet level planning for ViT attribution.
+
+A ViT tokenizes an (S, S) image into an (S/p, S/p) grid of p×p patches.
+Dyadic wavelet level j has coefficient cells of side ``2**j`` pixels, so
+levels ``j ≥ log2(p)`` are **token-granular**: every coefficient cell
+covers a whole number of tokens and WAM's scale disentanglement maps
+cleanly onto the token grid (224/patch-16 → 14×14 tokens ⇒ level 4 cells
+= 1 token, level 5 = 2×2 tokens, …).
+
+`plan_patch_levels` picks ``J = log2(patch)`` — the deepest decomposition
+whose FINEST level is still sub-token (levels 1..J-1 localize within a
+patch, level J lands exactly on the token grid) — and validates the
+geometry: power-of-two patch, image divisible by the patch, and J within
+`dwt_max_level` for the wavelet. `WaveletAttribution2D` consumes this as
+``level_plan="patch"`` (wam_tpu/wam2d.py).
+
+`token_grid_map` is the aggregation half: average-pool any (…, S, S)
+pixel-domain map onto the (…, t, t) token grid, the bridge between WAM
+mosaics / rollout maps and per-token scores.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+
+from wam_tpu.wavelets import build_wavelet, dwt_max_level
+
+__all__ = ["PatchLevelPlan", "plan_patch_levels", "token_grid_map"]
+
+
+@dataclasses.dataclass(frozen=True)
+class PatchLevelPlan:
+    """Planned decomposition: ``J`` dyadic levels for ``image_size`` px
+    inputs on a ``patch`` px grid of ``tokens``×``tokens`` tokens."""
+
+    J: int
+    patch: int
+    image_size: int
+    tokens: int
+    wavelet: str = "haar"
+
+    def level_cell_px(self, j: int) -> int:
+        """Pixel side of one level-j coefficient cell (1 ≤ j ≤ J)."""
+        return 2**j
+
+    def token_granular_levels(self) -> tuple[int, ...]:
+        """Levels whose cells tile whole tokens — with J = log2(patch)
+        that is exactly (J,); kept as a tuple for forward-compat with
+        deeper plans."""
+        return tuple(j for j in range(1, self.J + 1) if 2**j >= self.patch)
+
+
+def plan_patch_levels(
+    image_size: int, patch: int = 16, wavelet: str = "haar"
+) -> PatchLevelPlan:
+    """Plan dyadic levels that respect the patch grid; raises ValueError
+    on any geometry the token mapping cannot honor."""
+    if patch < 2 or (patch & (patch - 1)) != 0:
+        raise ValueError(
+            f"patch={patch} is not a power of two ≥ 2 — dyadic wavelet "
+            "levels cannot align to it"
+        )
+    if image_size <= 0 or image_size % patch != 0:
+        raise ValueError(
+            f"image_size={image_size} is not divisible by patch={patch} — "
+            "no token grid exists (ViT would reject this input too)"
+        )
+    J = patch.bit_length() - 1  # log2(patch)
+    filt_len = len(build_wavelet(wavelet).dec_lo)
+    max_j = dwt_max_level(image_size, filt_len)
+    if J > max_j:
+        raise ValueError(
+            f"patch={patch} needs J={J} levels but wavelet {wavelet!r} "
+            f"supports at most {max_j} on {image_size}px inputs"
+        )
+    return PatchLevelPlan(
+        J=J, patch=patch, image_size=image_size,
+        tokens=image_size // patch, wavelet=wavelet,
+    )
+
+
+def token_grid_map(maps: jnp.ndarray, tokens: int) -> jnp.ndarray:
+    """Average-pool (…, S, S) pixel maps onto the (…, tokens, tokens)
+    token grid. Pure reshape-mean — exact when S % tokens == 0, which the
+    planner guarantees."""
+    *lead, h, w = maps.shape
+    if h % tokens or w % tokens:
+        raise ValueError(
+            f"map of {(h, w)} px does not tile a {tokens}×{tokens} token grid"
+        )
+    ph, pw = h // tokens, w // tokens
+    pooled = maps.reshape(*lead, tokens, ph, tokens, pw)
+    return pooled.mean(axis=(-3, -1))

@@ -1,0 +1,394 @@
+"""Video WAM: wavelet attribution over 2D space + time.
+
+Extends the volumetric `wam_tpu.wam3d` machinery to clips (B, C, T, H, W)
+with an **anisotropic level spec** — video statistics are anisotropic
+(spatial structure is far richer than frame-to-frame change), so
+`VideoLevels(spatial=J_s, temporal=J_t)` decomposes the finest ``J_t``
+levels with the separable 3D DWT (space AND time) and the remaining
+``J_s − J_t`` levels with the 2D DWT only (time rides as a batch axis at
+the decimated frame rate). ``VideoLevels(J, J)`` degenerates to the
+uniform `wavedec3` cube; ``VideoLevels(J, 0)`` is per-frame 2D WAM.
+
+Attribution mirrors `WaveletAttribution3D`: decompose → gradient of the
+target logit w.r.t. every coefficient through the reconstruction →
+aggregate. The aggregate here is `spacetime_map`: per-level |gradient|
+energy nearest-upsampled to the clip's (T, H, W) box and summed — the
+video analogue of `visualize_cube`'s per-level maps, collapsed. From it,
+`frame_importance` reduces to a (B, T) per-frame score that the temporal
+insertion/deletion fan perturbs (`wam_tpu.xattr.video_eval`).
+
+Long clips: ``mesh=`` composes with PR 9's `SeqShardedWam` — the TIME
+axis is halo-sharded across ``seq_axis`` exactly like volume depth
+(uniform levels + single-channel clips only; the anisotropic 2D tail
+would need a time-gather the halo layer doesn't provide).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from wam_tpu.core.engine import target_loss
+from wam_tpu.core.estimators import (
+    resolve_sample_chunk,
+    smoothgrad,
+    trapezoid,
+    validate_sample_batch_size,
+)
+from wam_tpu.wavelets import Detail2D, dwt2, dwt3, idwt2, idwt3
+from wam_tpu.wavelets.filters import build_wavelet
+
+__all__ = [
+    "VideoLevels",
+    "wavedec_video",
+    "waverec_video",
+    "spacetime_map",
+    "frame_importance",
+    "WaveletAttributionVideo",
+]
+
+
+@dataclasses.dataclass(frozen=True)
+class VideoLevels:
+    """Anisotropic decomposition depth: ``spatial`` total levels, of which
+    the finest ``temporal`` also decimate time."""
+
+    spatial: int
+    temporal: int
+
+    def __post_init__(self):
+        if self.spatial < 1:
+            raise ValueError(f"spatial={self.spatial} must be >= 1")
+        if not 0 <= self.temporal <= self.spatial:
+            raise ValueError(
+                f"temporal={self.temporal} must satisfy "
+                f"0 <= temporal <= spatial (={self.spatial})"
+            )
+
+    @property
+    def uniform(self) -> bool:
+        return self.temporal == self.spatial
+
+
+def _as_levels(levels) -> VideoLevels:
+    if isinstance(levels, VideoLevels):
+        return levels
+    s, t = levels
+    return VideoLevels(spatial=int(s), temporal=int(t))
+
+
+def wavedec_video(x: jax.Array, wavelet, levels, mode: str = "symmetric"):
+    """Anisotropic multi-level DWT over the last three axes (T, H, W).
+
+    Returns ``[cA, det_J, ..., det_1]`` coarsest-first like `wavedec3`;
+    a level's detail entry is a 7-key dict (3D level, finest ``temporal``
+    of them) or a `Detail2D` (spatial-only level — the decimated time axis
+    rides as a batch dim)."""
+    lv = _as_levels(levels)
+    coeffs = []
+    a = x
+    for j in range(lv.spatial):
+        if j < lv.temporal:
+            a, det = dwt3(a, wavelet, mode)
+        else:
+            # (..., T', H', W') → fold T' into the batch for the 2D kernel
+            a, det = dwt2(a, wavelet, mode)
+        coeffs.append(det)
+    coeffs.append(a)
+    return coeffs[::-1]
+
+
+def waverec_video(coeffs, wavelet):
+    """Inverse of `wavedec_video` (coarsest-first walk, trimming pads per
+    level exactly like `waverec3`/`waverec2`). The result may overshoot
+    the original (T, H, W) by boundary pads — callers trim."""
+    L = wavelet.filt_len if hasattr(wavelet, "filt_len") else build_wavelet(wavelet).filt_len
+    a = coeffs[0]
+    for det in coeffs[1:]:
+        if isinstance(det, dict):
+            tgt = det["ddd"].shape[-3:]
+            a = a[..., : tgt[0], : tgt[1], : tgt[2]]
+            a = idwt3(a, det, wavelet, out_shape=tuple(2 * s - L + 2 for s in tgt))
+        else:
+            tgt = det.horizontal.shape[-2:]
+            a = a[..., : tgt[0], : tgt[1]]
+            a = idwt2(a, det, wavelet, out_shape=(2 * tgt[0] - L + 2, 2 * tgt[1] - L + 2))
+    return a
+
+
+def spacetime_map(grads, shape, approx_coeffs: bool = False) -> jax.Array:
+    """Collapse a `wavedec_video` gradient pytree to one (..., T, H, W)
+    saliency box: per level, |gradient| energy of every orientation,
+    nearest-upsampled to ``shape`` and summed (the approximation band
+    joins only with ``approx_coeffs=True``, matching the 2D/3D engines'
+    convention)."""
+    shape = tuple(shape)
+
+    def up(g):
+        return jax.image.resize(
+            jnp.abs(g), g.shape[:-3] + shape, method="nearest"
+        )
+
+    total = None
+    entries = list(coeff_leaves(grads, approx_coeffs))
+    for g in entries:
+        total = up(g) if total is None else total + up(g)
+    return total
+
+
+def coeff_leaves(coeffs, include_approx: bool = True):
+    """Yield every (..., t, h, w) leaf of a video coefficient list —
+    Detail2D fields, 3D dict values, and (optionally) the approximation."""
+    if include_approx:
+        yield coeffs[0]
+    for det in coeffs[1:]:
+        if isinstance(det, dict):
+            yield from det.values()
+        else:
+            yield det.horizontal
+            yield det.vertical
+            yield det.diagonal
+
+
+def frame_importance(box: jax.Array) -> jax.Array:
+    """(..., T, H, W) saliency box → (..., T) per-frame scores (spatial
+    mean) — what the temporal insertion/deletion fan ranks."""
+    return box.mean(axis=(-2, -1))
+
+
+class WaveletAttributionVideo:
+    """SmoothGrad / IG WAM over clips (B, C, T, H, W).
+
+    The estimator bodies mirror `WaveletAttribution3D`: one jit per
+    (method, has_label), sample chunking through
+    `resolve_sample_chunk(workload="wamvid3d")`, tuned synthesis impl
+    applied at trace time. ``__call__`` returns the (B, T, H, W)
+    spacetime saliency box (channel-averaged); `frame_importance` of it
+    feeds the temporal eval fan.
+
+    IG is coefficient-domain like the 3D engine: attribution =
+    coeff ⊙ trapezoid(path of coefficient gradients), then aggregated —
+    not a path integral of the (lossy) aggregated maps.
+    """
+
+    def __init__(
+        self,
+        model_fn,
+        wavelet: str = "haar",
+        levels=(3, 1),
+        method: str = "smooth",
+        mode: str = "symmetric",
+        approx_coeffs: bool = False,
+        n_samples: int = 25,
+        stdev_spread: float = 1e-4,
+        random_seed: int = 42,
+        sample_batch_size: int | None | str = "auto",
+        stream_noise: bool = False,
+        mesh=None,
+        seq_axis: str = "data",
+        batch_axis: str | None = None,
+        seq_fused: bool | str = "auto",
+    ):
+        if method not in ("smooth", "integratedgrad"):
+            raise ValueError(f"Unknown method {method!r}")
+        validate_sample_batch_size(sample_batch_size)
+        self.model_fn = model_fn
+        self.wavelet = wavelet
+        self.levels = _as_levels(levels)
+        self.method = method
+        self.mode = mode
+        self.approx_coeffs = approx_coeffs
+        self.n_samples = n_samples
+        self.stdev_spread = stdev_spread
+        self.random_seed = random_seed
+        self.sample_batch_size = sample_batch_size
+        self.stream_noise = stream_noise
+        if mesh is not None and not self.levels.uniform:
+            raise ValueError(
+                "mesh= (long-clip time sharding) requires uniform levels "
+                f"(spatial == temporal); got {self.levels} — the halo layer "
+                "shards the axis every level decimates"
+            )
+        if mesh is None and batch_axis is not None:
+            raise ValueError("batch_axis= requires mesh=")
+        self.mesh = mesh
+        self.seq_axis = seq_axis
+        self.batch_axis = batch_axis
+        self.seq_fused = seq_fused
+        self.grads = None
+        self._jit_smooth = functools.cache(self._build_smooth)
+        self._jit_ig = functools.cache(self._build_ig)
+        self._seq_cache: dict = {}
+
+    # -- shared plumbing ---------------------------------------------------
+
+    def _resolve_chunk(self, clip_shape) -> int | None:
+        return resolve_sample_chunk(
+            self.sample_batch_size, clip_shape[0], self.n_samples,
+            workload="wamvid3d", shape=tuple(clip_shape[1:]),
+        )
+
+    def _apply_tuned_synth(self, clip_shape) -> None:
+        from wam_tpu.tune import apply_tuned_synth_impl
+
+        apply_tuned_synth_impl("wamvid3d", tuple(clip_shape[1:]), clip_shape[0])
+
+    def _decompose(self, clip):
+        return wavedec_video(clip, self.wavelet, self.levels, self.mode)
+
+    def _grad_step(self, clip, y):
+        """clip (B, C, T, H, W) → coefficient-gradient pytree."""
+        coeffs = self._decompose(clip)
+
+        def loss(cs):
+            rec = waverec_video(cs, self.wavelet)
+            t, h, w = clip.shape[-3:]
+            out = self.model_fn(rec[..., :t, :h, :w])
+            return target_loss(out, y)
+
+        return jax.grad(loss)(coeffs)
+
+    def _box_step(self, clip, y):
+        """clip → (B, T, H, W) channel-averaged spacetime saliency."""
+        grads = self._grad_step(clip, y)
+        box = spacetime_map(grads, clip.shape[-3:], self.approx_coeffs)
+        return box.mean(axis=1)
+
+    # -- SmoothGrad --------------------------------------------------------
+
+    def _smooth_impl(self, clip, y, key):
+        self._apply_tuned_synth(clip.shape)
+        return smoothgrad(
+            lambda noisy: self._box_step(noisy, y),
+            clip,
+            key,
+            n_samples=self.n_samples,
+            stdev_spread=self.stdev_spread,
+            batch_size=self._resolve_chunk(clip.shape),
+            materialize_noise=not self.stream_noise,
+        )
+
+    def _build_smooth(self, has_label: bool):
+        if has_label:
+            return jax.jit(self._smooth_impl)
+        return jax.jit(lambda clip, key: self._smooth_impl(clip, None, key))
+
+    def _get_seq(self, clip_shape):
+        """Lazy per-(T,H,W) SeqShardedWam: the aggregation post_fn bakes in
+        the clip geometry, which `__init__` doesn't know yet."""
+        key = tuple(clip_shape[-3:])
+        if key not in self._seq_cache:
+            from wam_tpu.parallel.seq_estimators import SeqShardedWam
+
+            def post_fn(grads):
+                return spacetime_map(grads, key, self.approx_coeffs)
+
+            self._seq_cache[key] = SeqShardedWam(
+                self.mesh,
+                lambda rec: self.model_fn(rec[:, None]),
+                ndim=3,
+                wavelet=self.wavelet,
+                level=self.levels.spatial,
+                mode=self.mode,
+                seq_axis=self.seq_axis,
+                post_fn=post_fn,
+                batch_axis=self.batch_axis,
+                fused=self.seq_fused,
+            )
+        return self._seq_cache[key]
+
+    def smooth(self, x, y=None):
+        clip = jnp.asarray(x)
+        key = jax.random.PRNGKey(self.random_seed)
+        if self.mesh is not None:
+            if clip.shape[1] != 1:
+                raise ValueError(
+                    "mesh= long-clip dispatch supports single-channel clips "
+                    f"(C=1); got C={clip.shape[1]}"
+                )
+            y_arr = None if y is None else jnp.asarray(y)
+            self.grads = self._get_seq(clip.shape).smoothgrad(
+                clip[:, 0], y_arr, key, n_samples=self.n_samples,
+                stdev_spread=self.stdev_spread,
+                sample_chunk=self._resolve_chunk(clip.shape),
+            )
+        elif y is None:
+            self.grads = self._jit_smooth(False)(clip, key)
+        else:
+            self.grads = self._jit_smooth(True)(clip, jnp.asarray(y), key)
+        return self.grads
+
+    # -- Integrated Gradients ----------------------------------------------
+
+    def _ig_impl(self, clip, y):
+        self._apply_tuned_synth(clip.shape)
+        coeffs = self._decompose(clip)
+        alphas = jnp.linspace(0.0, 1.0, self.n_samples, dtype=clip.dtype)
+
+        def one(alpha):
+            scaled = jax.tree_util.tree_map(lambda c: c * alpha, coeffs)
+
+            def loss(cs):
+                rec = waverec_video(cs, self.wavelet)
+                t, h, w = clip.shape[-3:]
+                return target_loss(self.model_fn(rec[..., :t, :h, :w]), y)
+
+            return jax.grad(loss)(scaled)
+
+        path = jax.lax.map(one, alphas, batch_size=self._resolve_chunk(clip.shape))
+        integral = jax.tree_util.tree_map(trapezoid, path)
+        attr = jax.tree_util.tree_map(jnp.multiply, coeffs, integral)
+        box = spacetime_map(attr, clip.shape[-3:], self.approx_coeffs)
+        return box.mean(axis=1)
+
+    def _build_ig(self, has_label: bool):
+        if has_label:
+            return jax.jit(self._ig_impl)
+        return jax.jit(lambda clip: self._ig_impl(clip, None))
+
+    def integrated_wam(self, x, y=None):
+        clip = jnp.asarray(x)
+        if self.mesh is not None:
+            raise ValueError(
+                "mesh= supports method='smooth' only for video — the IG "
+                "path's coefficient-domain multiply needs the gathered "
+                "pytree; run IG unsharded or via chunked batches"
+            )
+        if y is None:
+            self.grads = self._jit_ig(False)(clip)
+        else:
+            self.grads = self._jit_ig(True)(clip, jnp.asarray(y))
+        return self.grads
+
+    def __call__(self, x, y=None):
+        if self.method == "smooth":
+            return self.smooth(x, y)
+        return self.integrated_wam(x, y)
+
+    def frame_scores(self, x, y=None) -> jax.Array:
+        """(B, T) per-frame importance — `frame_importance(self(x, y))`."""
+        return frame_importance(self(x, y))
+
+    def serve_entry(self, donate: bool | None = None, on_trace=None,
+                    aot_key: str | None = None, with_health: bool = False):
+        """Batched serving entry ``(x, y) → (B, T, H, W)`` for the serve
+        worker (labeled-only, single device — same contract as
+        `WaveletAttribution3D.serve_entry`)."""
+        if self.mesh is not None:
+            raise ValueError(
+                "serve_entry() does not support mesh=; the serve worker owns "
+                "a single device — drive the sharded estimator directly")
+        from wam_tpu.serve.entry import jit_entry
+        from wam_tpu.wam2d import _synth_tagged
+
+        if self.method == "smooth":
+            key = jax.random.PRNGKey(self.random_seed)
+            impl = lambda x, y: self._smooth_impl(x, y, key)  # noqa: E731
+        else:
+            impl = self._ig_impl
+        return jit_entry(impl, donate=donate, on_trace=on_trace,
+                         aot_key=_synth_tagged(aot_key),
+                         with_health=with_health)

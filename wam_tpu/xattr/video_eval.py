@@ -1,0 +1,127 @@
+"""Temporal faithfulness metrics for video attribution — `EvalVideoWAM`.
+
+The video analogue of `evalsuite.eval2d.Eval2DWAM`, with the perturbation
+unit changed from pixels to FRAMES: the explainer's (B, T) per-frame
+scores rank the clip's frames, `generate_masks` builds the nested
+insert/delete families over that ranking, and each masked variant blanks
+whole frames of the clip. Scoring runs through the fan engine's one-fetch
+contract — `run_cached_auc` fuses all ``n_iter + 2`` perturbed forwards
+of a sample into one fan batch and fetches ONE (B, 1+n_iter+1) result per
+metric call (probe with `evalsuite.fan.fetch_scope`).
+
+Temporal insertion starts from a frozen clip (all frames blanked) and
+reveals frames most-important-first; deletion blanks them from the intact
+clip. "Blank" is the per-clip mean frame — the video counterpart of the
+gray-image baseline — so the model keeps seeing in-distribution
+luminance.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from wam_tpu.evalsuite.fan import FanPlan, plan_fan
+from wam_tpu.evalsuite.metrics import (
+    batch_fingerprint as _batch_fingerprint,
+    generate_masks,
+    run_cached_auc,
+)
+from wam_tpu.xattr.video import frame_importance
+
+__all__ = ["EvalVideoWAM"]
+
+
+class EvalVideoWAM:
+    """Temporal insertion/deletion AUC for clip explainers.
+
+    ``explainer`` maps ``(x, y) → attribution`` — either a (B, T, H, W)
+    spacetime box (`WaveletAttributionVideo`) or (B, T) frame scores; both
+    reduce to (B, T) via `frame_importance`. ``model_fn`` maps clips
+    (B, C, T, H, W) → logits. Constructor args are frozen config, as
+    everywhere in the evalsuite."""
+
+    def __init__(self, model_fn, explainer, batch_size: int | str = 64,
+                 mesh=None, data_axis: str = "data",
+                 donate_inputs: bool | None = None, aot_key: str | None = None):
+        self.model_fn = model_fn
+        self.explainer = explainer
+        self.batch_size = batch_size
+        self.mesh = mesh
+        self.data_axis = data_axis
+        self.donate_inputs = donate_inputs
+        self.aot_key = aot_key
+        self.explanations = None
+        self._expl_key = None
+        self.insertion_curves = []
+        self.deletion_curves = []
+        self._auc_runners: dict = {}
+
+    def precompute(self, x, y) -> jax.Array:
+        """(B, T) frame scores, cached per batch fingerprint (the
+        `Eval2DWAM.precompute` contract: a different batch recomputes,
+        directly-assigned explanations adopt the first fingerprint)."""
+        key = _batch_fingerprint(x, y)
+        if self.explanations is not None:
+            if self._expl_key is None or self._expl_key == key:
+                self._expl_key = key
+                return self.explanations
+        expl = self.explainer(x, y)
+        expl = jnp.asarray(expl)
+        if expl.ndim > 2:
+            expl = frame_importance(expl)
+        self.explanations = expl
+        self._expl_key = key
+        return self.explanations
+
+    def reset(self):
+        self.explanations = None
+        self._expl_key = None
+
+    def _fan_plan(self, fan: int) -> FanPlan:
+        return plan_fan(self.batch_size, fan, workload="evalvid3d")
+
+    def _perturb(self, clip, scores, mode: str, n_iter: int):
+        """clip (C, T, H, W), scores (T,) → (n_iter+1, C, T, H, W) masked
+        variants; revealed frames keep their pixels, hidden frames collapse
+        to the clip's mean frame."""
+        ins, dele = generate_masks(n_iter, scores)
+        masks = ins if mode == "insertion" else dele  # (n_iter+1, T)
+        blank = clip.mean(axis=1, keepdims=True)  # (C, 1, H, W)
+        m = masks[:, None, :, None, None]
+        return clip[None] * m + blank[None] * (1.0 - m)
+
+    def evaluate_auc(self, x, y, mode: str, n_iter: int = 16):
+        """Per-sample AUC of class probability along the nested frame
+        reveal/blank family. One fused fan dispatch + one fetch per call
+        (`run_cached_auc`); with ``mesh=`` the clip batch is sharded over
+        ``data_axis`` inside the same runner."""
+        x = jnp.asarray(x)
+        y = np.asarray(y)
+        scores = self.precompute(x, y)
+        return run_cached_auc(
+            self._auc_runners,
+            (mode, tuple(scores.shape[1:])),
+            lambda clip, s: self._perturb(clip, s, mode, n_iter),
+            self.model_fn,
+            self._fan_plan(n_iter + 1),
+            n_iter,
+            x,
+            scores,
+            y,
+            mesh=self.mesh,
+            data_axis=self.data_axis,
+            donate=self.donate_inputs,
+            aot_key=self.aot_key,
+        )
+
+    def insertion(self, x, y, n_iter: int = 16):
+        scores, curves = self.evaluate_auc(x, y, "insertion", n_iter)
+        self.insertion_curves = curves
+        return scores
+
+    def deletion(self, x, y, n_iter: int = 16):
+        scores, curves = self.evaluate_auc(x, y, "deletion", n_iter)
+        self.deletion_curves = curves
+        return scores
