@@ -74,6 +74,12 @@ Cold-start modes (`wam_tpu.registry`):
 Runs end-to-end on CPU with the toy model — the same path
 tests/test_serve.py and tests/test_fleet.py smoke — and on TPU with
 ``--device tpu`` (donated input buffers, compilation cache).
+
+The invariants this bench measures dynamically (no per-call retraces, no
+hidden host syncs, donated buffers never re-read, lock-guarded server
+state) are gated statically by ``python -m wam_tpu.lint --all`` — run it
+first; it is <1 s and catches the regressions that would otherwise show
+up here as a mystery latency cliff.
 """
 
 import argparse

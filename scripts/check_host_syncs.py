@@ -1,206 +1,33 @@
 #!/usr/bin/env python
-"""Static lint: host-sync calls inside traced (jitted/vmapped) functions.
+"""DEPRECATED shim: host-sync lint moved to `wam_tpu.lint`.
 
-`np.asarray(...)`, `.item()`, and `float(...)`/`int(...)` on a traced
-value force a device→host transfer; inside a function that jax traces
-they either fail at trace time (ConcretizationTypeError) or — worse, in
-shapes that happen to be concrete — silently sync the device per call.
-The streaming pipeline makes these bugs expensive: one hidden sync stalls
-the overlapped H2D stage for the whole batch.
+This entry point is kept for CI lines and muscle memory; it delegates to
+the `host-sync` rule of the static-analysis subsystem
+(``python -m wam_tpu.lint --rules host-sync``) through the
+compatibility layer, which reproduces the original output byte for byte:
+absolute-path findings in sorted-file order, the
+``check_host_syncs: N files, M findings`` summary, exit 1 on any
+finding. New code (and new CI) should call the module CLI instead —
+it runs five more rules, understands ``# wamlint: disable=...`` pragmas,
+and can emit JSON/SARIF:
 
-Two-tier AST scan, no imports of the scanned code:
+    python -m wam_tpu.lint --all
 
-  1. Find TRACED functions: defs decorated with a jit-family decorator,
-     or referenced by name (or `self.<name>` / bare attribute) as an
-     argument to a jit-family call — jax.jit, jax.vmap, jax.lax.map,
-     shard_map, jax.grad/value_and_grad, plus this repo's wrappers
-     (make_sharded_runner, jit_entry, cached_jit, cached_entry,
-     donating_jit, smoothgrad). Defs nested inside a traced def are
-     traced too.
-  2. Flag host-sync calls inside traced code: `np.asarray` /
-     `numpy.asarray` / `onp.asarray`, `<expr>.item()`,
-     `float(x)`/`int(x)` where x is a name/attribute/call (constants are
-     fine), and `jax.device_get` / `device_fetch` — a result fetch INSIDE
-     a fan step would break the fan engine's one-fetch-per-metric
-     contract (`wam_tpu.evalsuite.fan`: fetches happen in `run_fan`,
-     after the jitted body returns, never inside it), and wall-clock
-     reads — `time.time()` / `time.perf_counter()` / `time.monotonic()` —
-     which freeze into trace-time constants inside a jitted body: the
-     span looks instrumented but reports the same timestamp forever
-     (obs timing belongs OUTSIDE the traced function, in `obs.tracing`
-     spans around the dispatch).
-
-Scope: wam_tpu/{core,evalsuite,serve,pipeline,wavelets,obs,testing,xattr} plus
-the fleet's mesh plumbing (wam_tpu/parallel/{mesh,multihost}.py) and the
-long-context path the fleet's sequence-sharded oversize route runs through
-(wam_tpu/parallel/{halo,halo_modes,seq_estimators}.py). serve/ covers the
-resilience layer (serve/supervisor.py, serve/retry.py); wam_tpu/testing is
-in scope because the chaos entries WRAP traced serving entries — a hidden
-sync in the fault layer would skew every latency the chaos bench reports. halo.py and
-halo_modes.py used to be excluded for their `int(np.prod(...))` static
-shape products inside shard_map bodies (legal — shapes are concrete under
-trace — but indistinguishable from real syncs here); those are
-`math.prod` on shape tuples now, so the exclusion is lifted — the
-one-fused-dispatch estimator loops are exactly where a hidden per-sample
-sync would hurt most. wam_tpu/xattr joins with the transformer/video
-subsystem: its estimator bodies (video SmoothGrad/IG, the attention tap
-gradients) and the temporal eval fan are jitted end to end, so the same
-one-fetch/no-hidden-sync rules apply.
-The wavelet core entered scope with the fused synthesis path: its matrix
-builders are host-side numpy BY DESIGN (lru_cached, static under jit), so
-the scan's traced-function detection — not a directory exclusion — is
-what keeps them legal. Zero findings is the contract — the verify skill
-runs this; exit 1 on any finding.
-
-Usage: python scripts/check_host_syncs.py [paths...]
+Usage (unchanged): python scripts/check_host_syncs.py [paths...]
 """
 
 from __future__ import annotations
 
-import ast
 import os
 import sys
 
-DEFAULT_DIRS = ("wam_tpu/core", "wam_tpu/evalsuite", "wam_tpu/serve",
-                "wam_tpu/pipeline", "wam_tpu/wavelets", "wam_tpu/obs",
-                "wam_tpu/testing", "wam_tpu/registry", "wam_tpu/pod",
-                "wam_tpu/xattr",
-                "wam_tpu/parallel/mesh.py", "wam_tpu/parallel/multihost.py",
-                "wam_tpu/parallel/halo.py", "wam_tpu/parallel/halo_modes.py",
-                "wam_tpu/parallel/seq_estimators.py")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-# wall-clock reads that become trace-time constants inside a jitted body
-CLOCK_CALLS = {"time", "perf_counter", "monotonic", "monotonic_ns",
-               "perf_counter_ns", "time_ns"}
-
-# call targets whose function-valued arguments get traced
-TRACING_CALLS = {
-    "jit", "vmap", "pmap", "grad", "value_and_grad", "checkpoint", "remat",
-    "map", "scan", "shard_map", "make_sharded_runner", "jit_entry",
-    "cached_jit", "cached_entry", "donating_jit", "smoothgrad",
-    "fan_runner",
-}
-NP_MODULES = {"np", "numpy", "onp"}
-
-
-def _tail_name(node: ast.AST) -> str | None:
-    """`jax.jit` → "jit", `lax.map` → "map", `jit` → "jit"."""
-    if isinstance(node, ast.Attribute):
-        return node.attr
-    if isinstance(node, ast.Name):
-        return node.id
-    return None
-
-
-def _ref_names(node: ast.AST) -> set[str]:
-    """Function names referenced by an argument expression: bare names,
-    `self._method` / `obj.method` attributes, and the same inside a
-    `functools.partial(...)` first argument."""
-    out: set[str] = set()
-    if isinstance(node, ast.Name):
-        out.add(node.id)
-    elif isinstance(node, ast.Attribute):
-        out.add(node.attr)
-    elif isinstance(node, ast.Call) and _tail_name(node.func) == "partial":
-        if node.args:
-            out |= _ref_names(node.args[0])
-    return out
-
-
-def _collect_traced_names(tree: ast.AST) -> set[str]:
-    traced: set[str] = set()
-    for node in ast.walk(tree):
-        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            for dec in node.decorator_list:
-                target = dec.func if isinstance(dec, ast.Call) else dec
-                if _tail_name(target) in TRACING_CALLS:
-                    traced.add(node.name)
-        elif isinstance(node, ast.Call):
-            name = _tail_name(node.func)
-            # "map"/"scan" are tracing calls only off lax — otherwise
-            # ThreadPoolExecutor.map / plain iterables collide
-            if name in ("map", "scan") and not (
-                isinstance(node.func, ast.Attribute)
-                and _tail_name(node.func.value) == "lax"
-            ):
-                continue
-            if name in TRACING_CALLS:
-                for arg in list(node.args) + [kw.value for kw in node.keywords]:
-                    traced |= _ref_names(arg)
-    return traced
-
-
-def _sync_findings(fn: ast.AST, path: str) -> list[str]:
-    found = []
-    for node in ast.walk(fn):
-        if not isinstance(node, ast.Call):
-            continue
-        loc = f"{path}:{node.lineno}"
-        f = node.func
-        if (isinstance(f, ast.Attribute) and f.attr == "asarray"
-                and isinstance(f.value, ast.Name) and f.value.id in NP_MODULES):
-            found.append(f"{loc}: np.asarray() in traced function")
-        elif isinstance(f, ast.Attribute) and f.attr == "item" and not node.args:
-            found.append(f"{loc}: .item() in traced function")
-        elif (isinstance(f, ast.Name) and f.id in ("float", "int")
-              and len(node.args) == 1
-              and isinstance(node.args[0], (ast.Name, ast.Attribute, ast.Call))):
-            found.append(f"{loc}: {f.id}() on a value in traced function")
-        elif _tail_name(f) in ("device_get", "device_fetch"):
-            found.append(f"{loc}: {_tail_name(f)}() in traced function "
-                         "(fetches belong in run_fan, after the fan step)")
-        elif (isinstance(f, ast.Attribute) and f.attr in CLOCK_CALLS
-              and isinstance(f.value, ast.Name) and f.value.id == "time"):
-            found.append(f"{loc}: time.{f.attr}() in traced function "
-                         "(freezes to a trace-time constant; time spans "
-                         "outside the jitted body)")
-    return found
-
-
-def check_file(path: str) -> list[str]:
-    with open(path, "r", encoding="utf-8") as f:
-        src = f.read()
-    try:
-        tree = ast.parse(src, filename=path)
-    except SyntaxError as e:
-        return [f"{path}: syntax error: {e}"]
-    traced = _collect_traced_names(tree)
-    findings: list[str] = []
-    seen: set[int] = set()
-    for node in ast.walk(tree):
-        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
-            continue
-        name = getattr(node, "name", None)
-        if name not in traced or id(node) in seen:
-            continue
-        # nested defs share the traced body; mark them visited so they
-        # are not double-reported
-        for sub in ast.walk(node):
-            if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                seen.add(id(sub))
-        findings.extend(_sync_findings(node, path))
-    return findings
+from wam_tpu.lint.compat import legacy_host_sync_main  # noqa: E402
 
 
 def main(argv=None) -> int:
-    args = (argv if argv is not None else sys.argv[1:]) or list(DEFAULT_DIRS)
-    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    files: list[str] = []
-    for a in args:
-        p = a if os.path.isabs(a) else os.path.join(root, a)
-        if os.path.isfile(p):
-            files.append(p)
-        else:
-            for dirpath, _, names in os.walk(p):
-                files.extend(os.path.join(dirpath, n)
-                             for n in sorted(names) if n.endswith(".py"))
-    findings: list[str] = []
-    for f in sorted(files):
-        findings.extend(check_file(f))
-    for line in findings:
-        print(line)
-    print(f"check_host_syncs: {len(files)} files, {len(findings)} findings")
-    return 1 if findings else 0
+    return legacy_host_sync_main(argv)
 
 
 if __name__ == "__main__":

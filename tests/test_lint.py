@@ -1,0 +1,701 @@
+"""Static-analysis subsystem (`wam_tpu.lint`): per-rule fixture corpora
+(a bad file each rule MUST flag — these tests fail if detection is
+disabled — and a good twin it must stay silent on), pragma and
+baseline-ratchet semantics, the JSON/SARIF emitter schemas, the
+`scripts/check_host_syncs.py` shim's byte-level output contract, the
+env-knob audit gate, and the live-tree gates (`--all` exits 0; shim
+parity against the modern host-sync rule on the real checkout).
+
+Everything here is pure-AST — no fixture module is ever imported — so
+the tests run identically with or without a device."""
+
+import ast
+import importlib.util
+import json
+import os
+import textwrap
+
+import pytest
+
+from wam_tpu.lint import compat, core, knobs
+from wam_tpu.lint.__main__ import main as lint_main
+from wam_tpu.lint.emitters import emit_json, emit_sarif, emit_text
+from wam_tpu.lint.registry import all_rules, get_rule, rule_ids
+from wam_tpu.lint.rules.host_sync import LEGACY_SCOPE
+
+REPO = core.repo_root()
+
+ALL_RULE_IDS = {"donation-safety", "host-sync", "lock-discipline",
+                "precision-flow", "retrace-risk", "schema-drift"}
+
+
+def _src(source, rel="wam_tpu/fixture.py"):
+    text = textwrap.dedent(source)
+    tree, err = None, None
+    try:
+        tree = ast.parse(text)
+    except SyntaxError as e:
+        err = e
+    return core.SourceFile(path="/fix/" + rel, rel=rel, text=text,
+                           tree=tree, error=err)
+
+
+def _run(source, rule_id, config=None, rel="wam_tpu/fixture.py",
+         apply_pragmas=True):
+    """Run one rule over one in-memory fixture; returns the LintResult."""
+    ctx = core.LintContext(root=REPO, config=config or {})
+    rule = get_rule(rule_id)(ctx.rule_config(rule_id))
+    return core.run_rules([rule], [_src(source, rel)], ctx,
+                          respect_scope=False, apply_pragmas=apply_pragmas)
+
+
+def _lines(result):
+    return sorted((f.rule, f.line) for f in result.findings)
+
+
+# -- registry ----------------------------------------------------------------
+
+def test_registry_has_all_rules():
+    assert set(rule_ids()) == ALL_RULE_IDS
+    for cls in all_rules():
+        assert cls.description, cls.id
+        assert cls.severity in ("error", "warning")
+
+
+# -- host-sync ---------------------------------------------------------------
+
+HOST_SYNC_BAD = '''\
+import time
+import numpy as np
+import jax
+
+@jax.jit
+def traced(x):
+    a = np.asarray(x)          # line 7
+    b = x.item()               # line 8
+    c = float(x)               # line 9
+    d = jax.device_get(x)      # line 10
+    t = time.perf_counter()    # line 11
+    return a, b, c, d, t
+'''
+
+HOST_SYNC_GOOD = '''\
+import numpy as np
+import jax
+
+def untraced(x):
+    return float(np.asarray(x))   # host code: fine
+
+@jax.jit
+def traced(x):
+    return x * 2.0
+'''
+
+
+def test_host_sync_bad_fixture():
+    res = _run(HOST_SYNC_BAD, "host-sync")
+    assert _lines(res) == [("host-sync", n) for n in (7, 8, 9, 10, 11)]
+    msgs = {f.line: f.message for f in res.findings}
+    assert msgs[7] == "np.asarray() in traced function"
+    assert msgs[8] == ".item() in traced function"
+    assert msgs[9] == "float() on a value in traced function"
+    assert "device_get()" in msgs[10] and "run_fan" in msgs[10]
+    assert msgs[11].startswith("time.perf_counter()")
+
+
+def test_host_sync_good_fixture():
+    assert _run(HOST_SYNC_GOOD, "host-sync").findings == []
+
+
+def test_host_sync_traced_by_reference_and_partial():
+    src = '''\
+    from functools import partial
+    import numpy as np
+
+    def step(x):
+        return np.asarray(x)       # line 5: traced via jit(partial(step))
+
+    w = jit(partial(step, 1))
+    '''
+    res = _run(src, "host-sync")
+    assert _lines(res) == [("host-sync", 5)]
+
+
+def test_host_sync_nested_def_reported_once():
+    src = '''\
+    import numpy as np
+    import jax
+
+    @jax.jit
+    def outer(x):
+        def inner(y):
+            return np.asarray(y)   # line 7: inside the traced body
+        return inner(x)
+    '''
+    res = _run(src, "host-sync")
+    assert _lines(res) == [("host-sync", 7)]
+
+
+# -- retrace-risk ------------------------------------------------------------
+
+RETRACE_BAD = '''\
+import jax
+import jax.numpy as jnp
+
+def serve_loop(batches, f):
+    for b in batches:
+        g = jax.jit(f)             # line 6: wrapper rebuilt per iteration
+        yield g(b)
+
+def per_call(f, x):
+    return jax.jit(f)(x)           # line 10: construct-and-invoke
+
+@jax.jit
+def traced(x, w=jnp.zeros(3)):     # line 13: array default on traced fn
+    return x + w
+'''
+
+RETRACE_GOOD = '''\
+import jax
+
+g = jax.jit(lambda x: x * 2)       # module-level: cached once
+
+def serve(batches):
+    return [g(b) for b in batches]
+'''
+
+
+def test_retrace_bad_fixture():
+    res = _run(RETRACE_BAD, "retrace-risk")
+    assert _lines(res) == [("retrace-risk", n) for n in (6, 10, 13)]
+
+
+def test_retrace_good_fixture():
+    assert _run(RETRACE_GOOD, "retrace-risk").findings == []
+
+
+def test_retrace_no_double_report_in_loop():
+    src = '''\
+    import jax
+
+    def f(batches, fn):
+        for b in batches:
+            y = jax.jit(fn)(b)     # ONE finding, not two
+        return y
+    '''
+    res = _run(src, "retrace-risk")
+    assert _lines(res) == [("retrace-risk", 5)]
+
+
+# -- donation-safety ---------------------------------------------------------
+
+DONATION_BAD = '''\
+def bad(f, x):
+    g = donating_jit(f)
+    out = g(x)
+    return x + out                 # line 4: x was donated on line 3
+
+def bad_inline(f, x):
+    y = jit(f, donate_argnums=(0,))(x)
+    return x - y                   # line 8
+'''
+
+DONATION_GOOD = '''\
+from wam_tpu.pipeline.donation import donation_safe
+
+def rebind(f, x):
+    x = donating_jit(f)(x)         # donate + rebind in ONE statement
+    return x                       # fresh buffer: fine
+
+def chained(f, x):
+    w = jit(f, donate_argnums=(0,))
+    x = w(x)
+    x = w(x)                       # each call donates the rebound x
+    return x
+
+def safe(f, x):
+    g = donating_jit(f)
+    out = g(donation_safe(x))      # sanctioned keep-alive wrapper
+    return x + out
+
+def no_donation(f, x):
+    g = jit(f, donate_argnums=())  # empty tuple donates nothing
+    out = g(x)
+    return x + out
+'''
+
+
+def test_donation_bad_fixture():
+    res = _run(DONATION_BAD, "donation-safety")
+    assert _lines(res) == [("donation-safety", 4), ("donation-safety", 8)]
+    assert "donated" in res.findings[0].message
+    assert "donation_safe" in res.findings[0].message
+
+
+def test_donation_good_fixture():
+    assert _run(DONATION_GOOD, "donation-safety").findings == []
+
+
+def test_donation_reports_once_per_donation():
+    src = '''\
+    def f(g, x):
+        w = donating_jit(g)
+        y = w(x)
+        a = x + 1                  # line 4: first read -> finding
+        b = x + 2                  # same donation: not re-reported
+        return a, b, y
+    '''
+    res = _run(src, "donation-safety")
+    assert _lines(res) == [("donation-safety", 4)]
+
+
+# -- lock-discipline ---------------------------------------------------------
+
+LOCKS_BAD = '''\
+import threading
+
+class Server:
+    _GUARDED_BY = {"_queue": "_lock", "_closed": "_lock"}
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._queue = []           # __init__ is exempt (happens-before)
+        self._closed = False
+
+    def submit(self, item):
+        self._queue.append(item)   # line 12: mutator without the lock
+
+    def close(self):
+        self._closed = True        # line 15: assign without the lock
+'''
+
+LOCKS_GOOD = '''\
+import threading
+
+class Server:
+    _GUARDED_BY = {"_queue": "_lock", "_closed": "_lock"}
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._queue = []
+        self._closed = False
+
+    def submit(self, item):
+        with self._lock:
+            self._queue.append(item)
+
+    def close(self):
+        with self._lock:
+            self._closed = True
+
+    def unrelated(self):
+        self._scratch = 1          # not in _GUARDED_BY: fine
+'''
+
+
+def test_locks_bad_fixture():
+    res = _run(LOCKS_BAD, "lock-discipline")
+    assert _lines(res) == [("lock-discipline", 12), ("lock-discipline", 15)]
+    assert "_GUARDED_BY" in res.findings[0].message
+    assert "self._lock" in res.findings[0].message
+
+
+def test_locks_good_fixture():
+    assert _run(LOCKS_GOOD, "lock-discipline").findings == []
+
+
+def test_locks_nested_def_does_not_inherit_lock():
+    src = '''\
+    import threading
+
+    class S:
+        _GUARDED_BY = {"_rows": "_lock"}
+
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._rows = []
+
+        def spawn(self):
+            with self._lock:
+                def cb():
+                    self._rows.append(1)   # line 13: closure outlives block
+                return cb
+    '''
+    res = _run(src, "lock-discipline")
+    assert _lines(res) == [("lock-discipline", 13)]
+
+
+# -- precision-flow ----------------------------------------------------------
+
+PRECISION_BAD = '''\
+import jax.numpy as jnp
+
+def kernel(x, w):
+    xb = x.astype(jnp.bfloat16)
+    return jnp.matmul(xb, w)       # line 5: bf16 contraction, no f32 accum
+
+def op(x, w):
+    xb = x.astype(jnp.bfloat16)
+    return xb @ w                  # line 9: @ cannot request an accumulator
+'''
+
+PRECISION_GOOD = '''\
+import jax.numpy as jnp
+
+def kernel(x, w):
+    xb = x.astype(jnp.bfloat16)
+    return jnp.matmul(xb, w, preferred_element_type=jnp.float32)
+
+def upcast_clears(x, w):
+    xb = x.astype(jnp.bfloat16)
+    xf = xb.astype(jnp.float32)    # back to f32: taint cleared
+    return jnp.matmul(xf, w)
+
+def f32_only(x, w):
+    return jnp.matmul(x, w)        # no bf16 in sight
+'''
+
+
+def test_precision_bad_fixture():
+    res = _run(PRECISION_BAD, "precision-flow")
+    assert _lines(res) == [("precision-flow", 5), ("precision-flow", 9)]
+    assert "preferred_element_type" in res.findings[0].message
+
+
+def test_precision_good_fixture():
+    assert _run(PRECISION_GOOD, "precision-flow").findings == []
+
+
+def test_precision_taint_flows_through_branches():
+    src = '''\
+    import jax.numpy as jnp
+
+    def f(x, w, flag):
+        xb = x.astype(jnp.bfloat16)
+        if flag:
+            return jnp.dot(xb, w)          # line 6
+        return jnp.dot(xb, w, preferred_element_type=jnp.float32)
+    '''
+    res = _run(src, "precision-flow")
+    assert _lines(res) == [("precision-flow", 6)]
+
+
+# -- schema-drift ------------------------------------------------------------
+
+SCHEMA_CONFIG = {"schema-drift": {
+    "metric_names": ["wam_tpu_good_total"],
+    "row_types": ["good_row"],
+}}
+
+SCHEMA_BAD = '''\
+def report(obs):
+    obs.counter("wam_tpu_rogue_total", 1)      # line 2: undeclared metric
+    obs.ledger({"metric": "rogue_row", "v": 1})  # line 3: undeclared row
+'''
+
+SCHEMA_GOOD = '''\
+def report(obs):
+    obs.counter("wam_tpu_good_total", 1)
+    obs.gauge("wam_tpu_good_total", 2.0)
+    obs.counter("other_prefix_total", 1)       # not a wam_tpu_ metric
+    obs.ledger({"metric": "good_row", "v": 1})
+'''
+
+
+def test_schema_drift_bad_fixture():
+    res = _run(SCHEMA_BAD, "schema-drift", config=SCHEMA_CONFIG)
+    assert _lines(res) == [("schema-drift", 2), ("schema-drift", 3)]
+
+
+def test_schema_drift_good_fixture():
+    assert _run(SCHEMA_GOOD, "schema-drift",
+                config=SCHEMA_CONFIG).findings == []
+
+
+def test_schema_registry_parses_from_live_tree():
+    """The declared registry (wam_tpu/obs/schema.py) AST-parses without
+    importing and is non-trivially populated."""
+    from wam_tpu.lint.rules.precision import _load_declared
+    ctx = core.LintContext(root=REPO, config={})
+    metrics, rows = _load_declared(ctx)
+    assert len(metrics) >= 40 and len(rows) >= 10
+    assert all(m.startswith("wam_tpu_") for m in metrics)
+
+
+# -- parse errors ------------------------------------------------------------
+
+def test_parse_error_becomes_finding(tmp_path):
+    bad = tmp_path / "broken.py"
+    bad.write_text("def oops(:\n")
+    files = core.load_files([str(bad)], root=str(tmp_path))
+    ctx = core.LintContext(root=str(tmp_path))
+    res = core.run_rules([get_rule("host-sync")()], files, ctx,
+                         respect_scope=False)
+    assert [f.rule for f in res.findings] == ["parse-error"]
+    assert "syntax error" in res.findings[0].message
+
+
+# -- pragmas -----------------------------------------------------------------
+
+def test_pragma_same_line_suppresses():
+    src = HOST_SYNC_BAD.replace(
+        "a = np.asarray(x)          # line 7",
+        "a = np.asarray(x)  # wamlint: disable=host-sync")
+    res = _run(src, "host-sync")
+    assert ("host-sync", 7) not in _lines(res)
+    # a pragma covers its own line AND the line below (the "line above"
+    # placement seen from line 8's side) — so .item() on 8 is covered too
+    assert ("host-sync", 8) not in _lines(res)
+    assert res.suppressed == 2
+    assert len(res.findings) == 3
+
+
+def test_pragma_line_above_suppresses():
+    src = '''\
+    import numpy as np
+
+    @jit
+    def traced(x):
+        # wamlint: disable=host-sync
+        return np.asarray(x)
+    '''
+    res = _run(src, "host-sync")
+    assert res.findings == [] and res.suppressed == 1
+
+
+def test_pragma_disable_file():
+    src = "# wamlint: disable-file=host-sync\n" + HOST_SYNC_BAD
+    res = _run(src, "host-sync")
+    assert res.findings == [] and res.suppressed == 5
+
+
+def test_pragma_only_disables_named_rule():
+    src = HOST_SYNC_BAD.replace(
+        "a = np.asarray(x)          # line 7",
+        "a = np.asarray(x)  # wamlint: disable=retrace-risk")
+    res = _run(src, "host-sync")
+    assert ("host-sync", 7) in _lines(res) and res.suppressed == 0
+
+
+# -- baseline ratchet --------------------------------------------------------
+
+def test_baseline_roundtrip_and_ratchet(tmp_path):
+    res = _run(HOST_SYNC_BAD, "host-sync")
+    assert len(res.findings) == 5
+    path = str(tmp_path / "baseline.json")
+    core.write_baseline(path, res.findings)
+    baseline = core.load_baseline(path)
+    assert sum(baseline.values()) == 5
+
+    # everything baselined -> nothing reported
+    kept, absorbed = core.apply_baseline(res.findings, baseline)
+    assert kept == [] and absorbed == 5
+
+    # ratchet: the same key may absorb only up to its recorded count —
+    # a file getting WORSE than its baseline is reported
+    doubled = res.findings + res.findings
+    kept, absorbed = core.apply_baseline(doubled, baseline)
+    assert absorbed == 5 and len(kept) == 5
+
+    # keys are line-number-free: shifting the finding down keeps it absorbed
+    import dataclasses
+    shifted = [dataclasses.replace(f, line=f.line + 100)
+               for f in res.findings]
+    kept, absorbed = core.apply_baseline(shifted, baseline)
+    assert kept == [] and absorbed == 5
+
+
+def test_checked_in_baseline_is_valid_and_empty():
+    """The live tree is clean; the committed ratchet must stay empty (it
+    may only ever shrink — new findings are fixed, not baselined)."""
+    path = os.path.join(REPO, core.DEFAULT_BASELINE)
+    with open(path) as f:
+        data = json.load(f)
+    assert data["version"] == 1
+    assert data["findings"] == {}
+
+
+# -- emitters ----------------------------------------------------------------
+
+def _result():
+    return _run(HOST_SYNC_BAD, "host-sync")
+
+
+def test_text_emitter_summary():
+    out = emit_text(_result())
+    assert out.splitlines()[-1] == (
+        "wam_tpu.lint: 1 files, 5 findings (0 pragma-suppressed, "
+        "0 baselined)")
+    assert "wam_tpu/fixture.py:7: [host-sync] np.asarray()" in out
+
+
+def test_json_emitter_schema():
+    doc = json.loads(emit_json(_result()))
+    assert doc["version"] == 1
+    assert doc["files"] == 1
+    assert doc["suppressed"] == 0 and doc["baselined"] == 0
+    assert len(doc["findings"]) == 5
+    for f in doc["findings"]:
+        assert set(f) == {"rule", "severity", "path", "line", "message"}
+        assert f["rule"] == "host-sync" and f["severity"] == "error"
+        assert f["path"] == "wam_tpu/fixture.py"
+
+
+def test_sarif_emitter_schema():
+    doc = json.loads(emit_sarif(_result()))
+    assert doc["version"] == "2.1.0"
+    assert "sarif-schema-2.1.0" in doc["$schema"]
+    run = doc["runs"][0]
+    assert {r["id"] for r in run["tool"]["driver"]["rules"]} == ALL_RULE_IDS
+    assert len(run["results"]) == 5
+    r0 = run["results"][0]
+    assert r0["ruleId"] == "host-sync" and r0["level"] == "error"
+    loc = r0["locations"][0]["physicalLocation"]
+    assert loc["artifactLocation"]["uriBaseId"] == "SRCROOT"
+    assert loc["region"]["startLine"] >= 1
+
+
+# -- legacy shim parity ------------------------------------------------------
+
+def _load_shim():
+    p = os.path.join(REPO, "scripts", "check_host_syncs.py")
+    spec = importlib.util.spec_from_file_location("check_host_syncs", p)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_shim_output_contract_on_fixture(tmp_path, capsys):
+    bad = tmp_path / "mod.py"
+    bad.write_text(HOST_SYNC_BAD)
+    shim = _load_shim()
+    rc = shim.main([str(bad)])
+    out = capsys.readouterr().out.splitlines()
+    assert rc == 1
+    # legacy format: absolute paths, `path:line: message`, trailing summary
+    assert out[0] == f"{bad}:7: np.asarray() in traced function"
+    assert len(out) == 6
+    assert out[-1] == "check_host_syncs: 1 files, 5 findings"
+
+    good = tmp_path / "ok.py"
+    good.write_text(HOST_SYNC_GOOD)
+    rc = shim.main([str(good)])
+    out = capsys.readouterr().out.splitlines()
+    assert rc == 0
+    assert out == ["check_host_syncs: 1 files, 0 findings"]
+
+
+def test_shim_interleaves_syntax_errors(tmp_path, capsys):
+    (tmp_path / "a_broken.py").write_text("def oops(:\n")
+    (tmp_path / "b_bad.py").write_text(HOST_SYNC_BAD)
+    shim = _load_shim()
+    rc = shim.main([str(tmp_path)])
+    out = capsys.readouterr().out.splitlines()
+    assert rc == 1
+    assert out[0].startswith(f"{tmp_path / 'a_broken.py'}: syntax error:")
+    assert out[1].startswith(f"{tmp_path / 'b_bad.py'}:7:")
+    assert out[-1] == "check_host_syncs: 2 files, 6 findings"
+
+
+def test_live_tree_parity_shim_vs_rule():
+    """The shim and the modern host-sync rule must agree finding-for-
+    finding on the real checkout (pragma/baseline filtering excluded —
+    the legacy contract predates both)."""
+    legacy_lines, nfiles = compat.legacy_host_sync_lines(None)
+    assert nfiles > 50  # the legacy scope really was walked
+
+    files = core.load_files(list(LEGACY_SCOPE), root=REPO)
+    ctx = core.LintContext(root=REPO)
+    res = core.run_rules([get_rule("host-sync")()], files, ctx,
+                         respect_scope=True, apply_pragmas=False)
+    modern = [f"{f.abspath}:{f.line}: {f.message}" for f in res.findings
+              if f.rule == "host-sync"]
+    assert sorted(modern) == sorted(legacy_lines)
+
+
+# -- knob audit --------------------------------------------------------------
+
+def test_knob_scan_finds_direct_and_const_reads(tmp_path):
+    pkg = tmp_path / "wam_tpu"
+    pkg.mkdir()
+    (pkg / "m.py").write_text(textwrap.dedent('''\
+        import os
+        KEY_ENV = "WAM_TPU_FIXTURE_KEY"
+        a = os.getenv("WAM_TPU_FIXTURE_DIRECT")
+        b = os.environ.get(KEY_ENV)
+        c = os.environ["WAM_TPU_FIXTURE_SUB"]
+    '''))
+    reads = knobs.scan_knob_reads(str(tmp_path))
+    assert set(reads) == {"WAM_TPU_FIXTURE_DIRECT", "WAM_TPU_FIXTURE_KEY",
+                          "WAM_TPU_FIXTURE_SUB"}
+    assert reads["WAM_TPU_FIXTURE_KEY"] == ["wam_tpu/m.py:4"]
+
+
+def test_knob_audit_clean_on_live_tree():
+    problems, report = knobs.audit(REPO, write_docs=False)
+    assert problems == []
+    assert len(report) >= 10  # the knob surface really was scanned
+    for knob in knobs.scan_knob_reads(REPO):
+        assert knob in knobs.KNOB_DOCS, knob
+
+
+def test_knob_table_write_roundtrip(tmp_path):
+    (tmp_path / "README.md").write_text(
+        f"# x\n\n{knobs.BEGIN_MARK}\nstale\n{knobs.END_MARK}\n\ntail\n")
+    pkg = tmp_path / "wam_tpu"
+    pkg.mkdir()
+    (pkg / "m.py").write_text(
+        'import os\nv = os.getenv("WAM_TPU_AOT_CACHE")\n')
+    table = knobs.render_table(knobs.scan_knob_reads(str(tmp_path)))
+    assert knobs.write_table(str(tmp_path), table)
+    assert knobs.current_table(str(tmp_path)) == table
+    assert "WAM_TPU_AOT_CACHE" in table
+    assert knobs.KNOB_DOCS["WAM_TPU_AOT_CACHE"] in table
+
+
+# -- CLI ---------------------------------------------------------------------
+
+def test_cli_all_clean_on_live_tree(capsys):
+    """THE gate: every rule over its own scope, current checkout, zero
+    non-baselined findings."""
+    rc = lint_main(["--all"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "0 findings" in out.splitlines()[-1]
+
+
+def test_cli_explicit_path_json(tmp_path, capsys):
+    bad = tmp_path / "wam_tpu_fixture.py"
+    bad.write_text(RETRACE_BAD)
+    rc = lint_main([str(bad), "--rules", "retrace-risk", "--format", "json",
+                    "--no-baseline"])
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert [f["line"] for f in doc["findings"]] == [6, 10, 13]
+
+
+def test_cli_baseline_write_then_absorb(tmp_path, capsys):
+    bad = tmp_path / "wam_tpu_fixture.py"
+    bad.write_text(RETRACE_BAD)
+    base = str(tmp_path / "baseline.json")
+    rc = lint_main([str(bad), "--rules", "retrace-risk",
+                    "--write-baseline", "--baseline", base])
+    capsys.readouterr()
+    assert rc == 0
+    rc = lint_main([str(bad), "--rules", "retrace-risk",
+                    "--baseline", base])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "3 baselined" in out.splitlines()[-1]
+
+
+def test_cli_list_rules(capsys):
+    assert lint_main(["--list-rules"]) == 0
+    out = capsys.readouterr().out
+    for rid in ALL_RULE_IDS:
+        assert rid in out
+
+
+def test_cli_unknown_rule_errors():
+    with pytest.raises(KeyError):
+        lint_main(["--rules", "nonesuch"])

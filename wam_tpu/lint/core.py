@@ -1,0 +1,351 @@
+"""Shared analysis core for `wam_tpu.lint`.
+
+Everything the rules have in common lives here so a new rule is ~one
+class: the module loader (parse once, share the AST), the traced-function
+detection generalized out of the original ``scripts/check_host_syncs.py``
+(jit-family decorator or referenced-by-name in a jit-family call, nested
+defs inherit), the finding model (rule id + severity + file:line),
+inline ``# wamlint: disable=<rule>`` pragma resolution, and the baseline
+ratchet (pre-existing findings are *capped*, never bulk-suppressed: the
+count per (path, rule, message) key may only go down).
+
+No module under analysis is ever imported — the whole subsystem is a
+static AST scan, so it is safe to run on broken trees and in CI without
+a device or a jax install... almost: `wam_tpu.lint` itself only needs
+the stdlib.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+import re
+from dataclasses import dataclass, field, replace
+
+__all__ = [
+    "Finding", "SourceFile", "LintContext", "LintResult",
+    "repo_root", "load_files", "tail_name", "ref_names",
+    "collect_traced_names", "iter_traced_functions", "TRACING_CALLS",
+    "suppressed_by_pragma", "load_baseline", "apply_baseline",
+    "baseline_key", "write_baseline", "DEFAULT_BASELINE",
+]
+
+SEVERITIES = ("error", "warning")
+
+# call targets whose function-valued arguments get traced (the repo's
+# jit-family surface; kept in one place so host-sync, retrace-risk and
+# donation-safety agree on what "traced" means)
+TRACING_CALLS = {
+    "jit", "vmap", "pmap", "grad", "value_and_grad", "checkpoint", "remat",
+    "map", "scan", "shard_map", "make_sharded_runner", "jit_entry",
+    "cached_jit", "cached_entry", "donating_jit", "smoothgrad",
+    "fan_runner",
+}
+
+DEFAULT_BASELINE = os.path.join("wam_tpu", "lint", "baseline.json")
+
+_PRAGMA_RE = re.compile(r"#\s*wamlint:\s*disable=([A-Za-z0-9_,\-]+)")
+_PRAGMA_FILE_RE = re.compile(r"#\s*wamlint:\s*disable-file=([A-Za-z0-9_,\-]+)")
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One rule violation at a file:line."""
+
+    rule: str
+    severity: str
+    path: str       # repo-relative, "/" separators (stable across hosts)
+    line: int
+    message: str
+    abspath: str = ""  # as-loaded path (legacy-parity emitters want it)
+
+    def location(self) -> str:
+        return f"{self.path}:{self.line}"
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+
+@dataclass
+class SourceFile:
+    """One parsed module. ``tree`` is None when the file failed to parse
+    (``error`` carries the SyntaxError) — rules skip those; the driver
+    reports a ``parse-error`` finding so broken files fail the gate."""
+
+    path: str               # absolute
+    rel: str                # repo-relative, "/" separators
+    text: str = ""
+    tree: ast.AST | None = None
+    error: SyntaxError | None = None
+    _pragma_cache: dict | None = None
+
+    @property
+    def lines(self) -> list[str]:
+        return self.text.splitlines()
+
+    def pragmas(self) -> tuple[dict[int, set[str]], set[str]]:
+        """(line -> disabled rule ids, file-wide disabled rule ids)."""
+        if self._pragma_cache is None:
+            per_line: dict[int, set[str]] = {}
+            whole: set[str] = set()
+            for i, line in enumerate(self.lines, start=1):
+                m = _PRAGMA_RE.search(line)
+                if m:
+                    per_line.setdefault(i, set()).update(
+                        r.strip() for r in m.group(1).split(",") if r.strip())
+                m = _PRAGMA_FILE_RE.search(line)
+                if m:
+                    whole.update(
+                        r.strip() for r in m.group(1).split(",") if r.strip())
+            self._pragma_cache = (per_line, whole)
+        return self._pragma_cache
+
+
+@dataclass
+class LintContext:
+    """Run-wide state shared by every rule: the repo root (README/DESIGN
+    and the schema registry are resolved against it) and per-rule config
+    overrides keyed by rule id (tests inject fixture schemas here)."""
+
+    root: str
+    config: dict = field(default_factory=dict)
+
+    def rule_config(self, rule_id: str) -> dict:
+        return self.config.get(rule_id, {})
+
+
+@dataclass
+class LintResult:
+    findings: list[Finding]
+    files: list[SourceFile]
+    suppressed: int = 0      # dropped by inline pragmas
+    baselined: int = 0       # absorbed by the baseline ratchet
+
+
+def repo_root() -> str:
+    """The checkout root: two levels above this file (wam_tpu/lint/)."""
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
+def load_files(paths, root: str | None = None) -> list[SourceFile]:
+    """Resolve files/dirs (relative paths against ``root``) into parsed
+    `SourceFile`s, sorted by path — the same walk order as the legacy
+    host-sync script so finding order is reproducible."""
+    root = root if root is not None else repo_root()
+    out: list[str] = []
+    for a in paths:
+        p = a if os.path.isabs(a) else os.path.join(root, a)
+        if os.path.isfile(p):
+            out.append(p)
+        else:
+            for dirpath, _, names in os.walk(p):
+                out.extend(os.path.join(dirpath, n)
+                           for n in sorted(names) if n.endswith(".py"))
+    files: list[SourceFile] = []
+    for p in sorted(out):
+        rel = os.path.relpath(p, root).replace(os.sep, "/")
+        try:
+            with open(p, "r", encoding="utf-8") as f:
+                text = f.read()
+        except OSError as e:
+            files.append(SourceFile(p, rel, "", None,
+                                    SyntaxError(str(e))))
+            continue
+        try:
+            tree = ast.parse(text, filename=p)
+            files.append(SourceFile(p, rel, text, tree))
+        except SyntaxError as e:
+            files.append(SourceFile(p, rel, text, None, e))
+    return files
+
+
+# -- traced-function detection (generalized from check_host_syncs.py) -------
+
+def tail_name(node: ast.AST) -> str | None:
+    """`jax.jit` -> "jit", `lax.map` -> "map", `jit` -> "jit"."""
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def ref_names(node: ast.AST) -> set[str]:
+    """Function names referenced by an argument expression: bare names,
+    `self._method` / `obj.method` attributes, and the same inside a
+    `functools.partial(...)` first argument."""
+    out: set[str] = set()
+    if isinstance(node, ast.Name):
+        out.add(node.id)
+    elif isinstance(node, ast.Attribute):
+        out.add(node.attr)
+    elif isinstance(node, ast.Call) and tail_name(node.func) == "partial":
+        if node.args:
+            out |= ref_names(node.args[0])
+    return out
+
+
+def is_tracing_call(node: ast.Call) -> bool:
+    """Whether this call traces its function-valued arguments. "map" /
+    "scan" count only off `lax` — otherwise ThreadPoolExecutor.map and
+    plain builtins collide."""
+    name = tail_name(node.func)
+    if name in ("map", "scan"):
+        return (isinstance(node.func, ast.Attribute)
+                and tail_name(node.func.value) == "lax")
+    return name in TRACING_CALLS
+
+
+def collect_traced_names(tree: ast.AST) -> set[str]:
+    """Names of functions that jax traces in this module: defs decorated
+    with a jit-family decorator, or referenced (incl. `self.<name>` /
+    `partial(<name>, ...)`) as an argument to a jit-family call."""
+    traced: set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                target = dec.func if isinstance(dec, ast.Call) else dec
+                if tail_name(target) in TRACING_CALLS:
+                    traced.add(node.name)
+        elif isinstance(node, ast.Call) and is_tracing_call(node):
+            for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                traced |= ref_names(arg)
+    return traced
+
+
+def iter_traced_functions(tree: ast.AST):
+    """Yield each outermost traced function def exactly once (nested defs
+    share the traced body and are not yielded separately) — the shared
+    traversal under host-sync and friends."""
+    traced = collect_traced_names(tree)
+    seen: set[int] = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+            continue
+        name = getattr(node, "name", None)
+        if name not in traced or id(node) in seen:
+            continue
+        for sub in ast.walk(node):
+            if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                seen.add(id(sub))
+        yield node
+
+
+# -- pragma + baseline plumbing ---------------------------------------------
+
+def suppressed_by_pragma(finding: Finding, src: SourceFile) -> bool:
+    """True when an inline pragma disables this finding: file-wide
+    ``# wamlint: disable-file=<rule>``, or ``# wamlint: disable=<rule>``
+    on the finding's line or the line directly above it."""
+    per_line, whole = src.pragmas()
+    if finding.rule in whole:
+        return True
+    for ln in (finding.line, finding.line - 1):
+        if finding.rule in per_line.get(ln, set()):
+            return True
+    return False
+
+
+def baseline_key(f: Finding) -> str:
+    """Line-number-free identity so unrelated edits above a baselined
+    finding do not churn the file."""
+    return f"{f.path}::{f.rule}::{f.message}"
+
+
+def load_baseline(path: str) -> dict[str, int]:
+    if not os.path.isfile(path):
+        return {}
+    with open(path, "r", encoding="utf-8") as f:
+        data = json.load(f)
+    return {str(k): int(v) for k, v in data.get("findings", {}).items()}
+
+
+def apply_baseline(findings: list[Finding],
+                   baseline: dict[str, int]) -> tuple[list[Finding], int]:
+    """Ratchet semantics: each baseline key absorbs up to its recorded
+    count of matching findings; everything beyond that (new findings, or
+    a file getting WORSE than its baseline) is reported. Returns
+    (non-baselined findings, absorbed count)."""
+    budget = dict(baseline)
+    kept: list[Finding] = []
+    absorbed = 0
+    for f in findings:
+        k = baseline_key(f)
+        if budget.get(k, 0) > 0:
+            budget[k] -= 1
+            absorbed += 1
+        else:
+            kept.append(f)
+    return kept, absorbed
+
+
+def write_baseline(path: str, findings: list[Finding]) -> dict:
+    counts: dict[str, int] = {}
+    for f in findings:
+        counts[baseline_key(f)] = counts.get(baseline_key(f), 0) + 1
+    data = {
+        "version": 1,
+        "comment": ("wam_tpu.lint baseline — pre-existing findings ratcheted "
+                    "here; counts may only decrease. Regenerate with "
+                    "`python -m wam_tpu.lint --all --write-baseline`."),
+        "findings": dict(sorted(counts.items())),
+    }
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(data, f, indent=1, sort_keys=False)
+        f.write("\n")
+    return data
+
+
+def parse_error_findings(files: list[SourceFile]) -> list[Finding]:
+    out = []
+    for src in files:
+        if src.error is not None:
+            out.append(Finding(
+                rule="parse-error", severity="error", path=src.rel,
+                line=getattr(src.error, "lineno", 1) or 1,
+                message=f"syntax error: {src.error}", abspath=src.path))
+    return out
+
+
+def _rel_in_scope(rel: str, scope) -> bool:
+    if scope is None:
+        return True
+    for s in scope:
+        s = s.rstrip("/")
+        if rel == s or rel.startswith(s + "/"):
+            return True
+    return False
+
+
+def run_rules(rules, files: list[SourceFile], ctx: LintContext,
+              respect_scope: bool = True,
+              apply_pragmas: bool = True) -> LintResult:
+    """Drive ``rules`` over ``files``. Scope filtering keeps each rule on
+    its curated directory set when the caller ran with the default scope;
+    explicit path runs pass ``respect_scope=False`` (the legacy
+    check_host_syncs contract: you asked for this file, you get scanned)."""
+    findings: list[Finding] = list(parse_error_findings(files))
+    for rule in rules:
+        scope = rule.scope if respect_scope else None
+        for src in files:
+            if src.tree is None or not _rel_in_scope(src.rel, scope):
+                continue
+            for f in rule.check_file(src, ctx):
+                findings.append(replace(
+                    f, rule=rule.id, severity=rule.severity,
+                    path=src.rel, abspath=src.path))
+    suppressed = 0
+    if apply_pragmas:
+        by_rel = {src.rel: src for src in files}
+        kept = []
+        for f in findings:
+            src = by_rel.get(f.path)
+            if src is not None and suppressed_by_pragma(f, src):
+                suppressed += 1
+            else:
+                kept.append(f)
+        findings = kept
+    return LintResult(findings=findings, files=files, suppressed=suppressed)

@@ -1,0 +1,226 @@
+"""Env-knob audit (`python -m wam_tpu.lint --knobs`).
+
+Every ``WAM_TPU_*`` environment variable read in ``wam_tpu/`` or
+``scripts/`` is an operational surface: kill switches, cache locations,
+kernel-impl overrides. This mode AST-scans for the reads
+(``os.environ[...]`` / ``.get`` / ``.setdefault`` / ``.pop`` /
+``os.getenv``, including reads through a module-level ``FOO_ENV =
+"WAM_TPU_..."`` constant), cross-references them against README.md /
+DESIGN.md, and regenerates the knob table README carries between the
+``<!-- wamlint-knobs:begin/end -->`` markers.
+
+Exit-1 conditions: a knob read in code but undocumented (no README/DESIGN
+mention AND no curated description here), a doc-mentioned knob that no
+code reads (dead — stale docs), or a stale generated table.
+``--knobs --write-docs`` rewrites the table in place.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+
+from wam_tpu.lint.core import load_files, repo_root, tail_name
+
+KNOB_RE = re.compile(r"\bWAM_TPU_[A-Z0-9_]+\b")
+
+BEGIN_MARK = "<!-- wamlint-knobs:begin -->"
+END_MARK = "<!-- wamlint-knobs:end -->"
+
+SCAN_DIRS = ("wam_tpu", "scripts")
+DOC_FILES = ("README.md", "DESIGN.md")
+
+# curated one-liners for the generated README table; the audit fails on a
+# knob read in code that has no entry here (add one when adding a knob)
+KNOB_DOCS = {
+    "WAM_TPU_AOT_CACHE":
+        "AOT executable cache directory (default `~/.cache/wam_tpu/aot`)",
+    "WAM_TPU_NO_AOT_CACHE":
+        "`1` disables AOT export/import entirely (kill switch)",
+    "WAM_TPU_SCHEDULE_CACHE":
+        "tuner schedule-cache path (default "
+        "`~/.cache/wam_tpu/schedules.json`)",
+    "WAM_TPU_NO_SCHEDULE_CACHE":
+        "`1` disables schedule-cache lookups (law-only tuning)",
+    "WAM_TPU_CACHE_DIR":
+        "XLA persistent compilation-cache directory (default "
+        "`~/.cache/wam_tpu/xla`)",
+    "WAM_TPU_NO_REGISTRY":
+        "`1` skips compile-artifact registry hydration (kill switch)",
+    "WAM_TPU_NO_RESULT_CACHE":
+        "`1` bypasses the serve result cache; read per call, so it can "
+        "be flipped live",
+    "WAM_TPU_DWT2_IMPL":
+        "2-D DWT backend override (`auto`/`conv`/`matmul`/`pallas`)",
+    "WAM_TPU_DWT1_IMPL":
+        "1-D DWT backend override (`auto`/`conv`/`folded`/`folded_nhc`)",
+    "WAM_TPU_SYNTH2_IMPL":
+        "2-D synthesis backend override (`auto`/`conv`/`matmul`/`pallas`)",
+    "WAM_TPU_SYNTH_COLLAPSE":
+        "level-collapse tile crossover for fused synthesis (default 128 "
+        "= one lane width)",
+    "WAM_TPU_STFT_IMPL":
+        "STFT backend override for the audio path "
+        "(`auto`/`fft`/`matmul`)",
+    "WAM_TPU_FUSED_RELU_IMPL":
+        "fused-ReLU backend override (`auto`/`xla`/`pallas`)",
+    "WAM_TPU_POD_AUTHKEY":
+        "hex connection auth key the pod router hands to worker "
+        "processes (set by the router; workers refuse to start without "
+        "it)",
+}
+
+_ENV_METHODS = {"get", "setdefault", "pop"}
+
+
+def _is_environ(node: ast.AST) -> bool:
+    return ((isinstance(node, ast.Attribute) and node.attr == "environ")
+            or (isinstance(node, ast.Name) and node.id == "environ"))
+
+
+def _module_env_consts(tree: ast.AST) -> dict[str, str]:
+    """Module-level ``NAME = "WAM_TPU_..."`` constants (e.g. the pod's
+    AUTHKEY_ENV) so reads through the name still count."""
+    out: dict[str, str] = {}
+    for node in tree.body:
+        if (isinstance(node, ast.Assign)
+                and isinstance(node.value, ast.Constant)
+                and isinstance(node.value.value, str)
+                and KNOB_RE.fullmatch(node.value.value)):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    out[t.id] = node.value.value
+    return out
+
+
+def _key_name(node: ast.AST, consts: dict[str, str]) -> str | None:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value if KNOB_RE.fullmatch(node.value) else None
+    if isinstance(node, ast.Name):
+        return consts.get(node.id)
+    return None
+
+
+def scan_knob_reads(root: str | None = None) -> dict[str, list[str]]:
+    """knob name -> sorted read sites ("path:line") across SCAN_DIRS.
+    Reads through imported constants count at the import-site module too
+    when the key is re-exported by name (the pod router's AUTHKEY_ENV
+    write is a set, not a read, and is ignored)."""
+    root = root if root is not None else repo_root()
+    reads: dict[str, set[str]] = {}
+    for src in load_files(SCAN_DIRS, root=root):
+        if src.tree is None:
+            continue
+        consts = _module_env_consts(src.tree)
+        for node in ast.walk(src.tree):
+            key = None
+            if isinstance(node, ast.Call):
+                f = node.func
+                if tail_name(f) == "getenv" and node.args:
+                    key = _key_name(node.args[0], consts)
+                elif (isinstance(f, ast.Attribute)
+                        and f.attr in _ENV_METHODS
+                        and _is_environ(f.value) and node.args):
+                    key = _key_name(node.args[0], consts)
+            elif (isinstance(node, ast.Subscript)
+                    and isinstance(node.ctx, ast.Load)
+                    and _is_environ(node.value)):
+                key = _key_name(node.slice, consts)
+            if key is not None:
+                reads.setdefault(key, set()).add(
+                    f"{src.rel}:{node.lineno}")
+    return {k: sorted(v) for k, v in sorted(reads.items())}
+
+
+def doc_mentions(root: str | None = None) -> dict[str, set[str]]:
+    """knob name -> doc files mentioning it."""
+    root = root if root is not None else repo_root()
+    out: dict[str, set[str]] = {}
+    for doc in DOC_FILES:
+        p = os.path.join(root, doc)
+        if not os.path.isfile(p):
+            continue
+        with open(p, "r", encoding="utf-8") as f:
+            for m in KNOB_RE.finditer(f.read()):
+                out.setdefault(m.group(0), set()).add(doc)
+    return out
+
+
+def render_table(reads: dict[str, list[str]]) -> str:
+    lines = [
+        BEGIN_MARK,
+        "<!-- generated by `python -m wam_tpu.lint --knobs --write-docs`"
+        " — do not edit by hand -->",
+        "| Knob | Read in | Meaning |",
+        "| --- | --- | --- |",
+    ]
+    for knob, sites in reads.items():
+        mods = sorted({s.rsplit(":", 1)[0] for s in sites})
+        shown = ", ".join(f"`{m}`" for m in mods[:2])
+        if len(mods) > 2:
+            shown += f" (+{len(mods) - 2} more)"
+        desc = KNOB_DOCS.get(knob, "*(undocumented)*")
+        lines.append(f"| `{knob}` | {shown} | {desc} |")
+    lines.append(END_MARK)
+    return "\n".join(lines)
+
+
+def current_table(root: str) -> str | None:
+    p = os.path.join(root, "README.md")
+    if not os.path.isfile(p):
+        return None
+    with open(p, "r", encoding="utf-8") as f:
+        text = f.read()
+    b, e = text.find(BEGIN_MARK), text.find(END_MARK)
+    if b < 0 or e < 0:
+        return None
+    return text[b:e + len(END_MARK)]
+
+
+def write_table(root: str, table: str) -> bool:
+    p = os.path.join(root, "README.md")
+    with open(p, "r", encoding="utf-8") as f:
+        text = f.read()
+    b, e = text.find(BEGIN_MARK), text.find(END_MARK)
+    if b < 0 or e < 0:
+        return False
+    new = text[:b] + table + text[e + len(END_MARK):]
+    with open(p, "w", encoding="utf-8") as f:
+        f.write(new)
+    return True
+
+
+def audit(root: str | None = None, write_docs: bool = False):
+    """Returns (problem lines, report lines). Non-empty problems => exit 1."""
+    root = root if root is not None else repo_root()
+    reads = scan_knob_reads(root)
+    docs = doc_mentions(root)
+    problems: list[str] = []
+    report: list[str] = []
+    for knob, sites in reads.items():
+        where = sites[0] + (f" (+{len(sites) - 1} more)"
+                            if len(sites) > 1 else "")
+        report.append(f"{knob}: read at {where}; documented in "
+                      f"{sorted(docs.get(knob, set())) or 'nowhere'}")
+        if knob not in KNOB_DOCS:
+            problems.append(
+                f"undocumented knob {knob} (read at {where}): add a "
+                "KNOB_DOCS entry in wam_tpu/lint/knobs.py and regenerate "
+                "the README table")
+    for knob, places in sorted(docs.items()):
+        if knob not in reads:
+            problems.append(
+                f"dead knob {knob}: mentioned in {sorted(places)} but no "
+                "code under wam_tpu/ or scripts/ reads it")
+    table = render_table(reads)
+    if write_docs:
+        if not write_table(root, table):
+            problems.append(
+                "README.md has no wamlint-knobs markers to write the "
+                "table between")
+    elif current_table(root) != table:
+        problems.append(
+            "README knob table is stale (or missing): run "
+            "`python -m wam_tpu.lint --knobs --write-docs`")
+    return problems, report

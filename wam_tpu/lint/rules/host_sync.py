@@ -1,0 +1,88 @@
+"""host-sync: device→host transfers inside traced (jitted/vmapped) code.
+
+The port of ``scripts/check_host_syncs.py`` (which is now a shim over
+this rule). `np.asarray(...)`, `.item()`, `float(...)`/`int(...)` on a
+traced value force a device→host transfer; inside a function jax traces
+they either fail at trace time or — in shapes that happen to be
+concrete — silently sync the device per call. `jax.device_get` /
+`device_fetch` inside a fan step would break the fan engine's
+one-fetch-per-metric contract, and wall-clock reads freeze into
+trace-time constants.
+
+Finding messages are byte-identical to the legacy script's so the
+`scripts/check_host_syncs.py` shim keeps its output contract
+(tests/test_lint.py pins the parity on the live tree).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from wam_tpu.lint.core import (Finding, LintContext, SourceFile,
+                               iter_traced_functions, tail_name)
+from wam_tpu.lint.registry import Rule, register
+
+# the curated hot-path scope inherited from the legacy script: every
+# directory whose traced bodies sit on a serving/eval/bench hot path
+LEGACY_SCOPE = (
+    "wam_tpu/core", "wam_tpu/evalsuite", "wam_tpu/serve",
+    "wam_tpu/pipeline", "wam_tpu/wavelets", "wam_tpu/obs",
+    "wam_tpu/testing", "wam_tpu/registry", "wam_tpu/pod",
+    "wam_tpu/xattr",
+    "wam_tpu/parallel/mesh.py", "wam_tpu/parallel/multihost.py",
+    "wam_tpu/parallel/halo.py", "wam_tpu/parallel/halo_modes.py",
+    "wam_tpu/parallel/seq_estimators.py",
+)
+
+# wall-clock reads that become trace-time constants inside a jitted body
+CLOCK_CALLS = {"time", "perf_counter", "monotonic", "monotonic_ns",
+               "perf_counter_ns", "time_ns"}
+
+NP_MODULES = {"np", "numpy", "onp"}
+
+
+def sync_messages(fn: ast.AST) -> list[tuple[int, str]]:
+    """(line, legacy message) pairs for host-sync calls inside ``fn`` —
+    kept message-for-message identical to check_host_syncs.py."""
+    found: list[tuple[int, str]] = []
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        if (isinstance(f, ast.Attribute) and f.attr == "asarray"
+                and isinstance(f.value, ast.Name) and f.value.id in NP_MODULES):
+            found.append((node.lineno, "np.asarray() in traced function"))
+        elif isinstance(f, ast.Attribute) and f.attr == "item" and not node.args:
+            found.append((node.lineno, ".item() in traced function"))
+        elif (isinstance(f, ast.Name) and f.id in ("float", "int")
+              and len(node.args) == 1
+              and isinstance(node.args[0], (ast.Name, ast.Attribute, ast.Call))):
+            found.append((node.lineno,
+                          f"{f.id}() on a value in traced function"))
+        elif tail_name(f) in ("device_get", "device_fetch"):
+            found.append((node.lineno,
+                          f"{tail_name(f)}() in traced function "
+                          "(fetches belong in run_fan, after the fan step)"))
+        elif (isinstance(f, ast.Attribute) and f.attr in CLOCK_CALLS
+              and isinstance(f.value, ast.Name) and f.value.id == "time"):
+            found.append((node.lineno,
+                          f"time.{f.attr}() in traced function "
+                          "(freezes to a trace-time constant; time spans "
+                          "outside the jitted body)"))
+    return found
+
+
+@register
+class HostSyncRule(Rule):
+    id = "host-sync"
+    severity = "error"
+    scope = LEGACY_SCOPE
+    description = ("host-sync calls (np.asarray/.item()/float()/device_get/"
+                   "wall-clock reads) inside traced functions")
+
+    def check_file(self, src: SourceFile, ctx: LintContext) -> list[Finding]:
+        out: list[Finding] = []
+        for fn in iter_traced_functions(src.tree):
+            for line, msg in sync_messages(fn):
+                out.append(self.finding(line, msg))
+        return out

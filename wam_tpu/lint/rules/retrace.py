@@ -1,0 +1,107 @@
+"""retrace-risk: jit wrappers whose construction pattern defeats the
+compile-artifact registry's zero-compile contract.
+
+Three shapes:
+
+1. A jit-family wrapper (`jax.jit` / `cached_jit` / `jit_entry` /
+   `donating_jit` / `cached_entry` / `pjit`) constructed inside a loop —
+   every iteration builds a fresh wrapper with an empty jit cache, so
+   every iteration retraces and the AOT/registry hydration can never hit.
+2. The same wrapper constructed AND invoked in one expression inside a
+   function body (``jax.jit(f)(x)``): the wrapper is garbage after the
+   call, so each call of the enclosing function retraces.
+3. An array-valued default argument (`jnp.zeros(...)`, `np.array(...)`,
+   ...) on a function that jax traces: the default is captured into the
+   jitted closure; arrays are unhashable / compared by id, so the jit
+   cache misses per construction and the "same" entry silently recompiles.
+
+Module-level one-shot constructions are fine (they run once per process)
+and are not flagged.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from wam_tpu.lint.core import (Finding, LintContext, SourceFile,
+                               collect_traced_names, tail_name)
+from wam_tpu.lint.registry import Rule, register
+
+# wrapper constructors: a call to one of these BUILDS a compiled-callable
+# wrapper (vs. invoking one)
+JIT_WRAPPERS = {"jit", "pjit", "cached_jit", "cached_entry", "jit_entry",
+                "donating_jit"}
+
+ARRAY_CTORS = {"array", "asarray", "zeros", "ones", "full", "arange",
+               "linspace", "eye"}
+ARRAY_MODULES = {"np", "numpy", "onp", "jnp"}
+
+_LOOPS = (ast.For, ast.AsyncFor, ast.While)
+_FUNCS = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+
+
+def _is_wrapper_construction(node: ast.Call) -> bool:
+    return tail_name(node.func) in JIT_WRAPPERS
+
+
+def _is_array_default(node: ast.AST) -> bool:
+    return (isinstance(node, ast.Call)
+            and tail_name(node.func) in ARRAY_CTORS
+            and isinstance(node.func, ast.Attribute)
+            and isinstance(node.func.value, ast.Name)
+            and node.func.value.id in ARRAY_MODULES)
+
+
+@register
+class RetraceRiskRule(Rule):
+    id = "retrace-risk"
+    severity = "error"
+    scope = ("wam_tpu",)
+    description = ("jit wrappers constructed per loop iteration / per call, "
+                   "or array-valued defaults captured into jitted closures")
+
+    def check_file(self, src: SourceFile, ctx: LintContext) -> list[Finding]:
+        out: list[Finding] = []
+        self._visit(src.tree, in_loop=False, in_func=False, out=out)
+        traced = collect_traced_names(src.tree)
+        for node in ast.walk(src.tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            decorated = any(
+                tail_name(d.func if isinstance(d, ast.Call) else d)
+                in JIT_WRAPPERS for d in node.decorator_list)
+            if node.name not in traced and not decorated:
+                continue
+            defaults = list(node.args.defaults) + [
+                d for d in node.args.kw_defaults if d is not None]
+            for d in defaults:
+                if _is_array_default(d):
+                    out.append(self.finding(
+                        d.lineno,
+                        f"array-valued default argument on traced function "
+                        f"'{node.name}' is captured into the jitted closure "
+                        "(unhashable default -> jit cache miss per "
+                        "construction)"))
+        return out
+
+    def _visit(self, node: ast.AST, in_loop: bool, in_func: bool, out) -> None:
+        for child in ast.iter_child_nodes(node):
+            child_in_loop = in_loop or isinstance(child, _LOOPS)
+            child_in_func = in_func or isinstance(child, _FUNCS)
+            if isinstance(child, ast.Call):
+                if _is_wrapper_construction(child) and in_loop:
+                    out.append(self.finding(
+                        child.lineno,
+                        f"{tail_name(child.func)}(...) constructed inside a "
+                        "loop: every iteration rebuilds the wrapper and "
+                        "retraces (hoist it, or cache by shape)"))
+                elif (isinstance(child.func, ast.Call)
+                      and _is_wrapper_construction(child.func) and in_func
+                      and not in_loop):  # in-loop: the inner call reports
+                    out.append(self.finding(
+                        child.lineno,
+                        f"{tail_name(child.func.func)}(f)(...) constructed "
+                        "and invoked in one expression inside a function "
+                        "body: the wrapper (and its jit cache) is discarded "
+                        "after the call -> retrace per call"))
+            self._visit(child, child_in_loop, child_in_func, out)

@@ -161,6 +161,17 @@ class PodRouter:
         pod_autoscale / pod_summary rows) written at close.
     """
 
+    # checked by the lock-discipline lint rule: mutations outside __init__
+    # must hold self._lock (heartbeat, acceptor, supervisor, and client
+    # threads all touch these)
+    _GUARDED_BY = {
+        "_closed": "_lock",
+        "_started": "_lock",
+        "_workers": "_lock",
+        "_threads": "_lock",
+        "_spawn_ema_s": "_lock",
+    }
+
     def __init__(
         self,
         worker_argv,
@@ -233,7 +244,8 @@ class PodRouter:
         t = threading.Thread(target=self._accept_loop, daemon=True,
                              name="wam-pod-accept")
         t.start()
-        self._threads.append(t)
+        with self._lock:
+            self._threads.append(t)
         # first bring-up: spawn everything, then wait — warmups overlap
         pending = [self._spawn_worker(next(self._wid_counter))
                    for _ in range(self.n_initial)]
@@ -242,10 +254,12 @@ class PodRouter:
         t = threading.Thread(target=self._heartbeat_loop, daemon=True,
                              name="wam-pod-heartbeat")
         t.start()
-        self._threads.append(t)
+        with self._lock:
+            self._threads.append(t)
         if self._autoscaler is not None:
             self._autoscaler.start()
-        self._started = True
+        with self._lock:
+            self._started = True
         return self
 
     def _worker_env(self) -> dict:
@@ -276,7 +290,8 @@ class PodRouter:
                 f"pod worker {w.wid} (pid {w.proc.pid}) did not become "
                 f"ready within {self.ready_timeout_s:g}s")
         spawn_s = time.perf_counter() - w.t_spawn
-        self._spawn_ema_s = 0.7 * self._spawn_ema_s + 0.3 * spawn_s
+        with self._lock:
+            self._spawn_ema_s = 0.7 * self._spawn_ema_s + 0.3 * spawn_s
         self.metrics.note_worker_ready(w.wid, w.incarnation, w.snapshot,
                                        spawn_s=spawn_s)
 
@@ -317,7 +332,8 @@ class PodRouter:
                                  daemon=True,
                                  name=f"wam-pod-recv-{wid}")
             t.start()
-            self._threads.append(t)
+            with self._lock:
+                self._threads.append(t)
             w.ready.set()
 
     def close(self, emit_metrics: bool = True) -> None:
@@ -356,7 +372,8 @@ class PodRouter:
 
             self.metrics.emit(JsonlWriter(self.metrics_path),
                               config=self.describe(), workers=workers)
-        self._started = False
+        with self._lock:
+            self._started = False
 
     def __enter__(self):
         return self.start()

@@ -42,6 +42,14 @@ class PodSupervisor:
     daemon respawn thread (deaths are rare — thread-per-event keeps the
     router's routing path free of supervision machinery)."""
 
+    # checked by the lock-discipline lint rule
+    _GUARDED_BY = {
+        "_history": "_lock",
+        "_permanent": "_lock",
+        "_pending_eta": "_lock",
+        "_threads": "_lock",
+    }
+
     def __init__(self, respawn, metrics, config: SupervisorConfig | None = None):
         self._respawn = respawn  # callable wid -> None, blocks until warm
         self._metrics = metrics

@@ -26,6 +26,13 @@ A server started afterwards (same config, same caches) deserializes its
 bucket compiles in well under a second instead of compiling. Run
 ``python -m wam_tpu.tune`` first if you want a freshly tuned schedule
 rather than the pinned defaults. Prints ONE JSON summary line.
+
+The zero-compile contract this prewarm buys is only as good as the code
+it warms: a jit wrapper rebuilt per call or an array-valued default
+invalidates the cache key no matter how warm the caches are. The
+``retrace-risk`` rule of ``python -m wam_tpu.lint --all`` gates exactly
+those patterns statically — keep it green before chasing cold-start
+regressions here.
 """
 
 from __future__ import annotations

@@ -228,6 +228,14 @@ class FleetServer:
         the entry factory's ``__name__``).
     """
 
+    # checked by the lock-discipline lint rule: mutations outside __init__
+    # must hold the mapped lock
+    _GUARDED_BY = {
+        "_closed": "_lock",
+        "_started": "_lock",
+        "_seq_entry": "_os_lock",
+    }
+
     def __init__(
         self,
         entry_factory,
@@ -432,7 +440,8 @@ class FleetServer:
                 max_workers=len(live), thread_name_prefix="wam-fleet-start"
             ) as pool:
                 list(pool.map(lambda r: r.server.start(), live))
-        self._started = True
+        with self._lock:
+            self._started = True
         return self
 
     def close(self, emit_metrics: bool = True) -> None:
@@ -462,7 +471,8 @@ class FleetServer:
 
             stop_metrics_server(self.prom_server)
             self.prom_server = None
-        self._started = False
+        with self._lock:
+            self._started = False
 
     def __enter__(self):
         return self.start()

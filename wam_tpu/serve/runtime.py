@@ -331,6 +331,17 @@ class AttributionServer:
         `ResultCache` instance must distinguish entries.
     """
 
+    # checked by the lock-discipline lint rule: these attributes may only
+    # be mutated inside `with self._cond:` outside __init__
+    _GUARDED_BY = {
+        "_queues": "_cond",
+        "_popped": "_cond",
+        "_active": "_cond",
+        "_pending": "_cond",
+        "_closed": "_cond",
+        "_started": "_cond",
+    }
+
     def __init__(
         self,
         entry,
@@ -525,7 +536,8 @@ class AttributionServer:
         self._worker = threading.Thread(
             target=self._worker_loop, name="wam-serve-worker", daemon=True
         )
-        self._started = True
+        with self._cond:
+            self._started = True
         self._worker.start()
         return self
 
@@ -546,7 +558,8 @@ class AttributionServer:
             if self.registry_report is not None:
                 writer.write(self.registry_report.row())
             self.metrics.emit(writer, config=self.describe())
-        self._started = False
+        with self._cond:
+            self._started = False
 
     def __enter__(self):
         return self.start()

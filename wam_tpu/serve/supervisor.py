@@ -72,6 +72,13 @@ class ReplicaSupervisor:
     one daemon restart thread (deaths are rare — thread-per-event keeps
     the fleet's hot path free of supervisor machinery)."""
 
+    # checked by the lock-discipline lint rule
+    _GUARDED_BY = {
+        "_history": "_lock",
+        "_permanent": "_lock",
+        "_threads": "_lock",
+    }
+
     def __init__(self, fleet, config: SupervisorConfig | None = None):
         self._fleet = fleet
         self.config = config if config is not None else SupervisorConfig()
