@@ -124,6 +124,75 @@ class _FakeEntry:
         return np.zeros(shape, np.float32)
 
 
+class _FakeAnytimeEntry:
+    """Anytime-protocol fake for the open-loop A/B: the same fixed total
+    service time as `_FakeEntry` (``ms`` covers all ``n_total`` samples)
+    but spent stride-by-stride, with the conf vector converging at 40% of
+    the sample budget — the empirical plateau point the anytime design
+    targets (most inputs converge well before n=25). Early exit therefore
+    buys a genuine ~2.5x capacity multiple at identical per-sample cost,
+    which is the effect the goodput gate measures."""
+
+    wam_anytime = True
+
+    def __init__(self, metrics, ms: float, *, n_total: int = 20,
+                 stride: int = 4, plateau_tol: float = 5e-3):
+        self._metrics = metrics
+        self._seen = set()
+        self._lock = threading.Lock()
+        self._step_s = (ms / 1e3) * (stride / n_total)
+        self.n_total = n_total
+        self.stride = stride
+        self.plateau_tol = plateau_tol
+        self._converge_at = max(stride, int(0.4 * n_total))
+
+    def _conf(self, batch: int, count: int):
+        import numpy as np
+
+        from wam_tpu.anytime.state import (
+            ANYTIME_VEC_SIZE, SLOT_CONFIDENCE, SLOT_COUNT, SLOT_DELTA,
+            SLOT_REL_SEM)
+
+        cv = np.zeros((batch, ANYTIME_VEC_SIZE), np.float32)
+        rel = 1.0 / max(count, 1)
+        delta = (1.0 if count <= self.stride
+                 else (self.plateau_tol * 0.1
+                       if count >= self._converge_at else 0.5))
+        cv[:, SLOT_COUNT] = count
+        cv[:, SLOT_REL_SEM] = rel
+        cv[:, SLOT_DELTA] = delta
+        cv[:, SLOT_CONFIDENCE] = 1.0 / (1.0 + rel + delta)
+        return cv
+
+    def begin(self, xs, ys):
+        shape = tuple(int(d) for d in xs.shape)
+        with self._lock:
+            if shape not in self._seen:
+                self._seen.add(shape)
+                self._metrics.note_compile()
+        return {"shape": shape, "count": 0}
+
+    def step(self, state, xs, ys):
+        time.sleep(self._step_s)
+        return {"shape": state["shape"],
+                "count": min(state["count"] + self.stride, self.n_total)}
+
+    def confidence(self, state):
+        return self._conf(state["shape"][0], state["count"])
+
+    def finalize(self, state):
+        import numpy as np
+
+        return (np.zeros(state["shape"], np.float32),
+                self._conf(state["shape"][0], state["count"]))
+
+    def __call__(self, xs, ys):  # full-n sync fallback (warmup parity)
+        state = self.begin(xs, ys)
+        while state["count"] < self.n_total:
+            state = self.step(state, xs, ys)
+        return self.finalize(state)[0]
+
+
 def run_bench(cfg, args, n_fleet: int):
     """One bench point: build the server (fleet when n_fleet > 1), drive it
     with closed-loop clients, return (summary, fleet_summary|None)."""
@@ -649,6 +718,21 @@ def run_open_loop(cfg, args) -> int:
     the brim inside the window (dispatch-on-full, so the window is a cap
     rather than the cadence). Both the occupancy and the interactive-p99
     win are therefore REAL capacity effects, not generator artifacts.
+
+    ``--anytime`` (round 16) adds a third arm over the SAME trace and
+    puts every arm under an explicit per-request deadline contract
+    (``--anytime-deadline-ms``, default 100 — inside the round-13
+    coalescing window, so deadline pressure is real): the round-13 arms
+    submit with ``deadline_ms`` and shed expired requests as
+    `DeadlineExceededError`, while the anytime arm serves a
+    `_FakeAnytimeEntry` (identical per-sample cost, convergence at 40%
+    of the sample budget) and delivers best-so-far maps with confidence
+    instead of failing. The headline metric is **goodput**: maps
+    delivered at ≥ ``--anytime-floor`` confidence per second of arm
+    wall time (full maps count at confidence 1.0; an anytime partial
+    counts only when it clears the floor). Gates: anytime zero
+    lost/rejected AND anytime goodput strictly above both round-13
+    arms'.
     """
     from concurrent.futures import wait as _futures_wait
 
@@ -685,11 +769,20 @@ def run_open_loop(cfg, args) -> int:
     ]
     pool_y = [r % 4 for r in range(pool_n)]
 
-    def _arm(label: str, coalesce_ms: float, arm_cache_mb: float) -> dict:
+    anytime_ab = bool(getattr(args, "anytime", False))
+    floor = (args.anytime_floor if args.anytime_floor is not None else 0.85)
+    arm_deadline_ms = (args.anytime_deadline_ms
+                       if args.anytime_deadline_ms is not None
+                       else (150.0 if toy else 100.0)) if anytime_ab else None
+
+    def _arm(label: str, coalesce_ms: float, arm_cache_mb: float,
+             anytime: bool = False) -> dict:
         obs.reset()
         metrics = ServeMetrics()
+        entry = (_FakeAnytimeEntry(metrics, fake_ms) if anytime
+                 else _FakeEntry(metrics, fake_ms))
         server = AttributionServer(
-            _FakeEntry(metrics, fake_ms),
+            entry,
             [shape],
             max_batch=max_batch,
             max_wait_ms=cfg.max_wait_ms,
@@ -705,6 +798,10 @@ def run_open_loop(cfg, args) -> int:
         )
         lat: dict[str, list[float]] = {"interactive": [], "batch": []}
         lat_lock = threading.Lock()
+        # goodput numerator: maps delivered at >= the confidence floor
+        # (full maps are confidence 1.0; anytime partials must clear it)
+        good = [0]
+        confs: list[float] = []
         futures = []
         rejected = 0
         t0 = time.perf_counter()
@@ -717,15 +814,24 @@ def run_open_loop(cfg, args) -> int:
             qos = qos_tags[i]
             t_sub = time.perf_counter()
             try:
-                fut = server.submit(pool_x[ranks[i]], pool_y[ranks[i]], qos=qos)
+                fut = server.submit(
+                    pool_x[ranks[i]], pool_y[ranks[i]], qos=qos,
+                    deadline_ms=arm_deadline_ms,
+                    **({"min_confidence": floor} if anytime else {}))
             except QueueFullError:
                 rejected += 1  # open loop sheds, it does not retry
                 continue
 
             def _done(f, q=qos, t=t_sub):
                 if f.exception() is None:
+                    res = f.result()
+                    c = float(getattr(res, "confidence", 1.0))
+                    ok = c >= floor or bool(getattr(res, "complete", True))
                     with lat_lock:
                         lat[q].append(time.perf_counter() - t)
+                        confs.append(c)
+                        if ok:
+                            good[0] += 1
 
             fut.add_done_callback(_done)
             futures.append(fut)
@@ -741,6 +847,8 @@ def run_open_loop(cfg, args) -> int:
             "arm": label,
             "coalesce_ms": coalesce_ms,
             "cache_mb": arm_cache_mb,
+            "anytime": anytime,
+            "deadline_ms": arm_deadline_ms,
             "rps_offered": rps,
             "rps_achieved": round(n_requests / gen_s, 2),
             "occupancy_mean": occupancy,
@@ -756,20 +864,42 @@ def run_open_loop(cfg, args) -> int:
                 }
                 for q, s in sorted(lat.items())
             },
+            "delivered": len(confs),
+            "delivered_ok": good[0],
+            "goodput_rps": round(good[0] / gen_s, 2),
+            "confidence_mean": (round(sum(confs) / len(confs), 4)
+                                if confs else None),
             "rejected": rejected,
             "resolved_error": errors,
             "lost": len(not_done),
         }
+        if anytime:
+            point["anytime_stats"] = summary.get("anytime")
         print(json.dumps(point, indent=2))
         return point
 
     base = _arm("baseline", 0.0, 0.0)
     coal = _arm("coalesced", window_ms, cache_mb)
+    anyt = _arm("anytime", 0.0, 0.0, anytime=True) if anytime_ab else None
 
     hit_rate = (coal["cache"] or {}).get("hit_rate", 0.0)
     gates: dict[str, bool] = {"coalesced_zero_lost": coal["lost"] == 0,
                               "nonzero_hit_rate": hit_rate > 0.0}
-    if toy:
+    if anytime_ab:
+        gates["anytime_zero_lost"] = anyt["lost"] == 0
+        gates["anytime_zero_rejected"] = anyt["rejected"] == 0
+        if toy:
+            # smoke: plumbing only — under-capacity toy load cannot show
+            # a goodput separation, so gate on every map clearing the floor
+            gates["anytime_all_confident"] = (
+                anyt["delivered"] > 0
+                and anyt["delivered_ok"] == anyt["delivered"])
+        else:
+            gates["anytime_goodput_gt_baseline"] = (
+                anyt["goodput_rps"] > base["goodput_rps"])
+            gates["anytime_goodput_gt_coalesced"] = (
+                anyt["goodput_rps"] > coal["goodput_rps"])
+    elif toy:
         gates["baseline_zero_lost"] = base["lost"] == 0
         gates["occupancy_improved"] = (
             base["occupancy_mean"] is not None
@@ -797,7 +927,9 @@ def run_open_loop(cfg, args) -> int:
         "open_window_ms": window_ms,
         "open_cache_mb": cache_mb,
         "seed": args.seed,
-        "arms": [base, coal],
+        "deadline_ms": arm_deadline_ms,
+        "confidence_floor": floor if anytime_ab else None,
+        "arms": [base, coal] + ([anyt] if anyt is not None else []),
         "gates": gates,
     }
     if args.emit:
@@ -1121,6 +1253,20 @@ def main():
     parser.add_argument("--open-window-ms", type=float, default=None,
                         help="open-loop coalesced-arm admission window "
                              "(default 100)")
+    parser.add_argument("--anytime", action="store_true",
+                        help="open-loop third arm: anytime entry serving "
+                             "best-so-far maps under a per-request "
+                             "deadline applied to ALL arms; reports "
+                             "goodput (maps delivered at >= the "
+                             "confidence floor per second) and gates the "
+                             "anytime arm above both round-13 arms")
+    parser.add_argument("--anytime-deadline-ms", type=float, default=None,
+                        help="per-request deadline for every --anytime "
+                             "A/B arm (default 100; --toy 150)")
+    parser.add_argument("--anytime-floor", type=float, default=None,
+                        help="confidence floor for --anytime goodput "
+                             "accounting and min_confidence submits "
+                             "(default 0.85)")
     parser.add_argument("--open-cache-mb", type=float, default=None,
                         help="open-loop coalesced-arm result-cache budget "
                              "(default 1.0; --toy 0.05)")

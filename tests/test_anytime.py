@@ -1,0 +1,511 @@
+"""Anytime attribution (`wam_tpu.anytime`): progressive refinement with
+confidence-gated deadline serving.
+
+Pins the three contracts the subsystem is built on:
+- **bit-equal checkpoints** — the checkpointed estimators reuse the exact
+  fused dispatch chain, so at completion (any stride, including k=n) the
+  map is bit-identical to the non-checkpointed path (1D/2D/3D ×
+  SmoothGrad/IG);
+- **zero-extra-fetch** — per-stride progress is a control-plane
+  `device_get` of the tiny conf vector; the attribution crosses host-ward
+  exactly once per request (`fetch_scope` count == 1);
+- **deadline semantics** — `submit(deadline_ms=, min_confidence=)` on an
+  anytime server delivers best-so-far `AnytimeResult`s instead of raising
+  `DeadlineExceededError`, zero/negative deadlines fail at admission with
+  a typed error on both runtime and fleet, and convergence early exit
+  stays rank-correlated ≥ 0.99 with the full-n oracle.
+"""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from conftest import need_devices as _need_devices
+from wam_tpu.parallel.mesh import make_mesh
+
+
+# -- shared toy fixtures (the test_seq_estimators conventions) ----------------
+
+
+def _pool_model_2d(n_classes=5, channels=3, shape=(64, 32), seed=0):
+    w = jax.random.normal(jax.random.PRNGKey(seed),
+                          (n_classes, channels) + shape)
+
+    def model(x):  # (B, C, H, W)
+        return jnp.einsum("bchw,kchw->bk", x, w)
+
+    return model
+
+
+def _pool_model_3d(n_classes=4, seed=0):
+    w = jax.random.normal(jax.random.PRNGKey(seed), (8, n_classes))
+
+    def model(x):  # (B, 1, D, H, W)
+        pooled = x[:, 0].mean(axis=(2, 3))  # (B, D)
+        feat = pooled.reshape(pooled.shape[0], 8, -1).mean(axis=-1)
+        return feat @ w
+
+    return model
+
+
+def _put_seq(x, mesh, ndim):
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    spec = [None] * x.ndim
+    spec[x.ndim - ndim] = "data"
+    return jax.device_put(x, NamedSharding(mesh, P(*spec)))
+
+
+def _seq_case(ndim):
+    from wam_tpu.models.audio import toy_wave_model
+
+    if ndim == 1:
+        return (toy_wave_model(jax.random.PRNGKey(0)),
+                jax.random.normal(jax.random.PRNGKey(1), (2, 2048)),
+                jnp.array([1, 3]), 2, "db3", "symmetric")
+    if ndim == 2:
+        return (_pool_model_2d(),
+                jax.random.normal(jax.random.PRNGKey(1), (2, 3, 64, 32)),
+                jnp.array([1, 4]), 2, "db2", "reflect")
+    return (_pool_model_3d(),
+            jax.random.normal(jax.random.PRNGKey(1), (2, 1, 32, 8, 8)),
+            jnp.array([1, 3]), 1, "db2", "symmetric")
+
+
+def _grad_sample_fn(model, key, sigma=0.05):
+    """SmoothGrad-style per-sample contribution for `make_anytime_entry`."""
+
+    def sample_fn(x, y, i):
+        k = jax.random.fold_in(key, i)
+        noisy = x + sigma * jax.random.normal(k, x.shape, x.dtype)
+
+        def loss(v):
+            return model(v)[jnp.arange(v.shape[0]), y].sum()
+
+        return jax.grad(loss)(noisy)
+
+    return sample_fn
+
+
+def _assert_tree_bitequal(got, want):
+    ga = jax.tree_util.tree_leaves(got)
+    wa = jax.tree_util.tree_leaves(want)
+    assert len(ga) == len(wa)
+    for a, b in zip(ga, wa):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+# -- bit-equal checkpoints (the tentpole invariant) ---------------------------
+
+
+@pytest.mark.parametrize("ndim", [1, 2, 3])
+def test_checkpointed_bitequal_smooth_and_ig(ndim):
+    """k=n (one checkpoint at completion) AND a mid-run stride must both
+    finish bit-identical to the non-checkpointed fused path: the
+    checkpointed loops replay the SAME jitted dispatch chain, the M2/conf
+    side-channel never re-enters the accumulator graph."""
+    _need_devices(8)
+    from wam_tpu.anytime.state import ANYTIME_VEC_SIZE, SLOT_COUNT
+    from wam_tpu.parallel.seq_estimators import SeqShardedWam
+
+    model, x_host, y, level, wavelet, mode = _seq_case(ndim)
+    mesh = make_mesh({"data": 8})
+    x = _put_seq(x_host, mesh, ndim)
+    key = jax.random.PRNGKey(7)
+    sw = SeqShardedWam(mesh, model, ndim=ndim, wavelet=wavelet, level=level,
+                       mode=mode, fused=True)
+    n = 4
+
+    plain = sw.smoothgrad(x, y, key, n_samples=n, stdev_spread=0.1)
+    for stride in (n, 2):  # k=n pinned, plus mid-run checkpoints
+        ck, info = sw.smoothgrad_checkpointed(
+            x, y, key, n_samples=n, stdev_spread=0.1, stride=stride)
+        _assert_tree_bitequal(ck, plain)
+        assert info["complete"] and info["n_used"] == n
+        assert info["conf"].shape == (x.shape[0], ANYTIME_VEC_SIZE)
+        assert int(info["conf"][0, SLOT_COUNT]) == n
+
+    _, ig_plain = sw.integrated(x, y, n_steps=n)
+    for stride in (n, 2):
+        _, ig_ck, info = sw.integrated_checkpointed(
+            x, y, n_steps=n, stride=stride)
+        _assert_tree_bitequal(ig_ck, ig_plain)
+        assert info["complete"] and info["n_used"] == n
+
+
+def test_smoothgrad_checkpointed_early_exit_and_floor():
+    """Plateau convergence stops the loop early and frees the remaining
+    samples; an unreachable confidence floor vetoes the same early exit."""
+    _need_devices(8)
+    from wam_tpu.models.audio import toy_wave_model
+    from wam_tpu.parallel.seq_estimators import SeqShardedWam
+
+    mesh = make_mesh({"data": 8})
+    sw = SeqShardedWam(mesh, toy_wave_model(jax.random.PRNGKey(0)), ndim=1,
+                       wavelet="db2", level=2, mode="symmetric", fused=True)
+    x = _put_seq(jax.random.normal(jax.random.PRNGKey(1), (2, 2048)), mesh, 1)
+    y = jnp.array([1, 3])
+    key = jax.random.PRNGKey(9)
+
+    seen = []
+    _, info = sw.smoothgrad_checkpointed(
+        x, y, key, n_samples=24, stdev_spread=0.1, stride=4,
+        plateau_tol=10.0, on_checkpoint=lambda c, conf: seen.append(c))
+    assert info["converged"] and not info["complete"]
+    assert info["n_used"] == 4 and seen == [4]  # tol above the pinned 1.0
+
+    # a tol under the pinned first-checkpoint delta (exactly 1.0) cannot
+    # fire until a REAL delta exists: converges at the second checkpoint
+    seen2 = []
+    _, info_b = sw.smoothgrad_checkpointed(
+        x, y, key, n_samples=24, stdev_spread=0.1, stride=4,
+        plateau_tol=0.99, on_checkpoint=lambda c, conf: seen2.append(c))
+    assert info_b["converged"] and seen2 == [4, 8]
+
+    _, info2 = sw.smoothgrad_checkpointed(
+        x, y, key, n_samples=12, stdev_spread=0.1, stride=4,
+        plateau_tol=10.0, min_confidence=1.0)
+    assert not info2["converged"] and info2["n_used"] == 12
+
+
+# -- stride resolution and the tune sweep axis --------------------------------
+
+
+@pytest.fixture
+def sched_cache(tmp_path, monkeypatch):
+    from wam_tpu.tune import invalidate_process_cache
+
+    monkeypatch.setenv("WAM_TPU_SCHEDULE_CACHE",
+                       str(tmp_path / "schedules.json"))
+    monkeypatch.delenv("WAM_TPU_NO_SCHEDULE_CACHE", raising=False)
+    invalidate_process_cache()
+    yield
+    invalidate_process_cache()
+
+
+def test_resolve_checkpoint_stride(sched_cache):
+    from wam_tpu.core.estimators import resolve_checkpoint_stride
+    from wam_tpu.tune import record_schedule
+
+    assert resolve_checkpoint_stride(3, 25) == 3
+    assert resolve_checkpoint_stride(100, 25) == 25  # clamp to n
+    assert resolve_checkpoint_stride("7", 25) == 7
+    with pytest.raises(ValueError, match="stride"):
+        resolve_checkpoint_stride(0, 25)
+    with pytest.raises(ValueError, match="stride"):
+        resolve_checkpoint_stride(-2, 25)
+    # auto: built-in default, clamped
+    assert resolve_checkpoint_stride("auto", 25) == 5
+    assert resolve_checkpoint_stride("auto", 3) == 3
+    # auto + a tuned anytime_stride entry for the identified workload
+    record_schedule("wam2d", (3, 32, 32), 4, {"anytime_stride": 2})
+    assert resolve_checkpoint_stride(
+        "auto", 25, workload="wam2d", shape=(3, 32, 32), batch=4) == 2
+    # unknown workload keys fall back to the default
+    assert resolve_checkpoint_stride(
+        "auto", 25, workload="wam2d", shape=(3, 8, 8), batch=4) == 5
+
+
+def test_tune_candidate_anytime_stride_axis():
+    from wam_tpu.tune.autotuner import Candidate
+    from wam_tpu.tune.workloads import _seq_candidates
+
+    c = Candidate(sample_chunk=1, seq_fused=True, anytime_stride=3)
+    assert "k=3" in c.label()
+    assert c.entry()["anytime_stride"] == 3
+    assert "anytime_stride" not in Candidate(sample_chunk=1).entry()
+    strides = [c.anytime_stride for c in _seq_candidates()
+               if c.anytime_stride is not None]
+    assert strides, "seq sweep space must carry anytime stride candidates"
+
+
+# -- checkpoint math ----------------------------------------------------------
+
+
+def test_m2_and_conf_stats_match_numpy():
+    """`m2_update` over consecutive SUM accumulators reproduces the
+    population M2 of the per-sample stream; `conf_stats` slots match the
+    hand-computed rel-SEM / delta / confidence."""
+    from wam_tpu.anytime.state import (
+        SLOT_CONFIDENCE, SLOT_COUNT, SLOT_DELTA, SLOT_REL_SEM, conf_stats,
+        m2_update)
+
+    rng = np.random.RandomState(0)
+    g = rng.randn(6, 3, 10).astype(np.float32)  # n samples × (B, D)
+    acc = jnp.zeros((3, 10), jnp.float32)
+    m2 = jnp.zeros((3,), jnp.float32)
+    for i in range(g.shape[0]):
+        acc_new = acc + g[i]
+        m2 = m2_update(m2, acc, acc_new, jnp.asarray(i, jnp.float32))
+        acc = acc_new
+    want_m2 = (g - g.mean(axis=0)).reshape(6, 3, 10) ** 2
+    np.testing.assert_allclose(np.asarray(m2), want_m2.sum(axis=(0, 2)),
+                               rtol=2e-4)
+
+    prev = jnp.asarray(g[:4].sum(axis=0))
+    cv = np.asarray(conf_stats(acc, m2, 6.0, prev, 4.0))
+    assert cv.shape == (3, 4)
+    np.testing.assert_allclose(cv[:, SLOT_COUNT], 6.0)
+    mean = np.asarray(acc) / 6.0
+    rms = np.sqrt((mean ** 2).mean(axis=1))
+    sem = np.sqrt(np.asarray(m2) / 5.0 / 10.0 / 6.0)
+    np.testing.assert_allclose(cv[:, SLOT_REL_SEM], sem / rms, rtol=1e-4)
+    move = np.sqrt(((mean - np.asarray(prev) / 4.0) ** 2).mean(axis=1))
+    np.testing.assert_allclose(cv[:, SLOT_DELTA], move / rms, rtol=1e-4)
+    np.testing.assert_allclose(
+        cv[:, SLOT_CONFIDENCE],
+        1.0 / (1.0 + cv[:, SLOT_REL_SEM] + cv[:, SLOT_DELTA]), rtol=1e-6)
+
+    # first sample contributes zero M2 (textbook Welford), delta pins at
+    # 1.0 with no previous checkpoint -> confidence can never exceed 0.5
+    z = jnp.zeros((3, 10), jnp.float32)
+    np.testing.assert_allclose(
+        np.asarray(m2_update(jnp.zeros((3,)), z, z + 1.0, 0.0)), 0.0)
+    cv0 = np.asarray(conf_stats(acc, m2, 6.0, z, 0.0))
+    np.testing.assert_allclose(cv0[:, SLOT_DELTA], 1.0)
+    assert (cv0[:, SLOT_CONFIDENCE] <= 0.5).all()
+
+
+# -- entries, the stride driver, and the one-fetch contract -------------------
+
+
+def test_anytime_entry_driver_and_fetch_contract():
+    from wam_tpu.anytime import make_anytime_entry, run_anytime
+    from wam_tpu.evalsuite.fan import fetch_scope
+    from wam_tpu.models.audio import toy_wave_model
+
+    model = toy_wave_model(jax.random.PRNGKey(0))
+    ent = make_anytime_entry(
+        _grad_sample_fn(model, jax.random.PRNGKey(5)), n_total=11, stride=4)
+    assert ent.wam_anytime and ent.n_strides() == 3
+    xs = jax.random.normal(jax.random.PRNGKey(2), (2, 256))
+    ys = jnp.array([0, 1])
+
+    res = run_anytime(ent, xs, ys)
+    assert res.complete and res.n_used == 11 and res.strides == 3
+    # the non-dividing tail stride is weight-masked: count stops at n_total
+    _assert_tree_bitequal(res.out, ent(xs, ys))
+
+    with fetch_scope() as fs:
+        run_anytime(ent, xs, ys)
+    assert fs.count == 1  # conf reads are control syncs, not fetches
+
+    # convergence early exit frees the remaining strides
+    lax_ent = make_anytime_entry(
+        _grad_sample_fn(model, jax.random.PRNGKey(5)), n_total=40, stride=4,
+        plateau_tol=10.0)
+    res2 = run_anytime(lax_ent, xs, ys)
+    assert res2.converged and res2.n_used < 40
+
+    with pytest.raises(ValueError, match="stride"):
+        make_anytime_entry(lambda x, y, i: x, n_total=4, stride=5)
+    with pytest.raises(ValueError, match="n_total"):
+        make_anytime_entry(lambda x, y, i: x, n_total=0)
+
+
+def test_convergence_fidelity_rank_correlation():
+    """Early-exit fidelity gate: the converged best-so-far map must rank
+    features like the full-n oracle (Spearman >= 0.99 per row)."""
+    from wam_tpu.anytime import make_anytime_entry, run_anytime
+
+    w = jax.random.normal(jax.random.PRNGKey(0), (32, 4))
+
+    def model(v):
+        return jnp.tanh(v) @ w
+
+    sample_fn = _grad_sample_fn(model, jax.random.PRNGKey(5), sigma=0.1)
+    xs = jax.random.normal(jax.random.PRNGKey(2), (2, 32))
+    ys = jnp.array([0, 3])
+    n = 64
+
+    oracle = run_anytime(
+        make_anytime_entry(sample_fn, n_total=n, stride=8, plateau_tol=0.0),
+        xs, ys)
+    assert oracle.complete and not oracle.converged
+
+    early = run_anytime(
+        make_anytime_entry(sample_fn, n_total=n, stride=8,
+                           plateau_tol=5e-2),
+        xs, ys)
+    assert early.converged and early.n_used < n
+
+    def _ranks(v):
+        return np.argsort(np.argsort(v))
+
+    for row in range(xs.shape[0]):
+        a = _ranks(np.asarray(early.out)[row].ravel())
+        b = _ranks(np.asarray(oracle.out)[row].ravel())
+        rho = np.corrcoef(a, b)[0, 1]
+        assert rho >= 0.99, rho
+
+
+# -- serving semantics --------------------------------------------------------
+
+
+def _linear_entry_model(x):
+    w = jnp.arange(np.prod(x.shape[1:]), dtype=jnp.float32).reshape(
+        x.shape[1:])
+    return jnp.stack([(x * w).sum(axis=tuple(range(1, x.ndim))),
+                      (x * (w + 1.0)).sum(axis=tuple(range(1, x.ndim)))],
+                     axis=1)
+
+
+def test_serve_anytime_results_partials_and_ledger(tmp_path):
+    from wam_tpu.anytime import AnytimeResult, make_anytime_entry
+    from wam_tpu.evalsuite.fan import fetch_count
+    from wam_tpu.serve import AttributionServer
+
+    ent = make_anytime_entry(
+        _grad_sample_fn(_linear_entry_model, jax.random.PRNGKey(5)),
+        n_total=20, stride=5)
+    ledger = tmp_path / "anytime.jsonl"
+    srv = AttributionServer(ent, [(16,)], max_batch=2, max_wait_ms=1.0,
+                            warmup=True, metrics_path=str(ledger))
+    try:
+        f0 = fetch_count()
+        res = srv.attribute(np.ones(16, np.float32), 1)
+        assert isinstance(res, AnytimeResult)
+        # linear model: constant grads -> converges at the second checkpoint
+        assert res.converged and res.n_used == 10 and res.n_total == 20
+        assert res.meets(0.9) and not res.complete
+        assert fetch_count() - f0 == 1  # one harvest per served request
+
+        # a ~zero window still delivers the first stride, never raises
+        res2 = srv.attribute(np.ones(16, np.float32) * 2.0, 1,
+                             deadline_ms=0.001)
+        assert isinstance(res2, AnytimeResult)
+        assert 0 < res2.n_used < res2.n_total
+    finally:
+        srv.close()
+
+    snap = srv.metrics.snapshot()["anytime"]
+    assert snap["batches"] == 2 and snap["early_exits"] >= 1
+    assert snap["deadline_partials"] >= 1
+    assert 0.0 < snap["samples_fraction_mean"] < 1.0
+
+    rows = [json.loads(line) for line in open(ledger)]
+    partial = [r for r in rows if r.get("metric") == "partial_result"]
+    assert partial, "partial deliveries must land v2 ledger rows"
+    for r in partial:
+        assert r["schema_version"] == 2
+        assert r["n_used"] < r["n_total"]
+        assert 0.0 < r["confidence_mean"] <= 1.0
+        assert {"bucket", "samples_fraction", "converged",
+                "deadline_hit"} <= set(r)
+
+
+def test_invalid_deadline_typed_admission_runtime_and_fleet():
+    """Satellite bugfix: zero/negative deadlines die AT ADMISSION with a
+    typed error carrying the offending value — runtime and fleet."""
+    from wam_tpu.serve import (
+        AttributionServer, FleetServer, InvalidDeadlineError, ServeError)
+
+    srv = AttributionServer(lambda xs, ys: xs * 2.0, [(4,)], max_batch=1,
+                            max_wait_ms=0.0, warmup=False)
+    try:
+        for bad in (0, -5.0):
+            with pytest.raises(InvalidDeadlineError) as ei:
+                srv.submit(np.ones(4, np.float32), 1, deadline_ms=bad)
+            assert ei.value.deadline_ms == bad
+            assert isinstance(ei.value, ValueError)
+            assert isinstance(ei.value, ServeError)
+        # min_confidence needs an anytime entry behind the server
+        with pytest.raises(ValueError, match="anytime"):
+            srv.submit(np.ones(4, np.float32), 1, min_confidence=0.5)
+        with pytest.raises(ValueError, match="min_confidence"):
+            srv.submit(np.ones(4, np.float32), 1, min_confidence=1.5)
+    finally:
+        srv.close()
+
+    fleet = FleetServer(lambda rid, m: (lambda xs, ys: xs * 2.0), [(4,)],
+                        replicas=1, max_batch=1, max_wait_ms=0.0,
+                        warmup=False)
+    try:
+        with pytest.raises(InvalidDeadlineError) as ei:
+            fleet.submit(np.ones(4, np.float32), 1, deadline_ms=0)
+        assert ei.value.deadline_ms == 0
+    finally:
+        fleet.close()
+
+
+def test_anytime_kill_switch(monkeypatch):
+    from wam_tpu.anytime import AnytimeResult, make_anytime_entry
+    from wam_tpu.serve import AttributionServer
+
+    ent = make_anytime_entry(
+        _grad_sample_fn(_linear_entry_model, jax.random.PRNGKey(5)),
+        n_total=8, stride=4)
+    monkeypatch.setenv("WAM_TPU_NO_ANYTIME", "1")
+    srv = AttributionServer(ent, [(8,)], max_batch=1, max_wait_ms=0.0,
+                            warmup=False)
+    try:
+        res = srv.attribute(np.ones(8, np.float32), 1)
+        assert not isinstance(res, AnytimeResult)  # full-n fallback rows
+        assert res.shape == (8,)
+    finally:
+        srv.close()
+
+
+# -- SLO confidence objectives ------------------------------------------------
+
+
+def test_slo_confidence_objective_and_burn():
+    from wam_tpu.obs.slo import SLOTracker, parse_slo
+
+    policy = parse_slo("*@interactive:min_confidence=0.9,window_s=60")
+    assert policy["*@interactive"].min_confidence == 0.9
+    with pytest.raises(ValueError, match="unknown SLO objective"):
+        parse_slo("*:confidence=0.9")
+
+    t = SLOTracker(policy)
+    now = 100.0
+    for c in (0.95, 0.97, 0.4):  # one delivery under the floor
+        t.note("1x32x32", latency_s=0.01, confidence=c, qos="interactive",
+               now=now)
+    st = t.bucket_stats("1x32x32@interactive", now=now)
+    assert st["n"] == 3
+    np.testing.assert_allclose(st["mean_confidence"],
+                               (0.95 + 0.97 + 0.4) / 3)
+    # 1/3 under floor against the 1% budget
+    np.testing.assert_allclose(st["burn_rate"], (1 / 3) / 0.01)
+
+    # errors deliver nothing: confidence 0, and they burn via error paths
+    t2 = SLOTracker(parse_slo("*:min_confidence=0.5"))
+    t2.note("k", confidence=0.8, now=now)
+    t2.note_error("k", now=now)
+    st2 = t2.bucket_stats("k", now=now)
+    assert st2["mean_confidence"] == 0.8  # only ok samples carry confidence
+    assert st2["error_rate"] == 0.5
+
+
+# -- engine surface -----------------------------------------------------------
+
+
+def test_wam2d_anytime_serve_entry():
+    from wam_tpu.anytime import run_anytime
+    from wam_tpu.models.toy import toy_conv_model
+    from wam_tpu.wam2d import WaveletAttribution2D
+
+    toy = toy_conv_model(jax.random.PRNGKey(0), ndim=2)
+    wam = WaveletAttribution2D(lambda x: toy(x.mean(axis=1)), J=2,
+                               n_samples=6, random_seed=3)
+    ent = wam.anytime_serve_entry(stride=3)
+    assert ent.n_total == 6 and ent.stride == 3
+
+    x = jax.random.normal(jax.random.PRNGKey(1), (2, 1, 16, 16))
+    y = jnp.array([1, 2])
+    res = run_anytime(ent, x, y)
+    assert res.complete and res.n_used == 6
+    assert np.asarray(res.out).shape == (2, 16, 16)  # the serving mosaic
+    _assert_tree_bitequal(res.out, ent(x, y))  # full-n determinism
+
+    ig = WaveletAttribution2D(lambda x: toy(x.mean(axis=1)), J=2,
+                              method="integratedgrad")
+    with pytest.raises(ValueError, match="smooth"):
+        ig.anytime_serve_entry()
+    wam.mesh = object()
+    with pytest.raises(ValueError, match="mesh"):
+        wam.anytime_serve_entry()

@@ -1,0 +1,61 @@
+"""Anytime attribution: progressive refinement with confidence-gated
+deadline serving (DESIGN.md "Anytime attribution").
+
+WAM's smoothing estimators are running means, which makes them anytime
+algorithms by construction: the fused accumulator loops (round 9,
+`parallel.seq_estimators`) already carry a running sum that is a
+bit-equal checkpoint of the final map at every sample count. This package
+surfaces, scores, and serves those partial results:
+
+- `anytime.state` — the checkpoint math: Welford-style M2 reconstructed
+  from consecutive sum accumulators (never touching the accumulator
+  chain) and the fixed-size per-row confidence vector.
+- `anytime.entry.make_anytime_entry` — checkpointed serving entries:
+  begin/step/finalize jits with the conf vector fused into the stride
+  graph (one health-vector-style extra output leaf, zero extra fetches).
+- `anytime.driver` — the shared stride-loop policy (complete / converged
+  / deadline) driving an entry; `run_anytime` for direct callers, the
+  serve worker embeds `drive_anytime`.
+- `anytime.result.AnytimeResult` — what anytime-server futures resolve
+  to: best-so-far map + confidence instead of `DeadlineExceededError`.
+
+`SeqShardedWam.smoothgrad_checkpointed` / `integrated_checkpointed` are
+the sequence-sharded checkpointed estimators (same module as the fused
+loops they wrap); `WaveletAttribution2D.anytime_serve_entry` builds the
+single-device serving entry. ``WAM_TPU_NO_ANYTIME=1`` makes anytime
+servers treat their entry as a plain full-n one (kill switch).
+"""
+
+from wam_tpu.anytime.driver import AnytimeOutcome, drive_anytime, run_anytime
+from wam_tpu.anytime.entry import (
+    DEFAULT_PLATEAU_TOL,
+    AnytimeEntry,
+    make_anytime_entry,
+)
+from wam_tpu.anytime.result import AnytimeResult
+from wam_tpu.anytime.state import (
+    ANYTIME_VEC_SIZE,
+    SLOT_CONFIDENCE,
+    SLOT_COUNT,
+    SLOT_DELTA,
+    SLOT_REL_SEM,
+    conf_stats,
+    m2_update,
+)
+
+__all__ = [
+    "ANYTIME_VEC_SIZE",
+    "SLOT_COUNT",
+    "SLOT_REL_SEM",
+    "SLOT_DELTA",
+    "SLOT_CONFIDENCE",
+    "DEFAULT_PLATEAU_TOL",
+    "AnytimeEntry",
+    "AnytimeOutcome",
+    "AnytimeResult",
+    "conf_stats",
+    "drive_anytime",
+    "m2_update",
+    "make_anytime_entry",
+    "run_anytime",
+]

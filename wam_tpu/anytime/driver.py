@@ -1,0 +1,122 @@
+"""The stride-loop policy: drive an `AnytimeEntry` until complete,
+converged, or out of deadline.
+
+This is the one place the serving semantics live — the serve worker
+(`serve.runtime`) and direct callers (tests, benches) share it, so the
+policy cannot drift between them:
+
+- always run at least one stride (a deadline-pressed request gets a real
+  best-so-far map, never nothing);
+- stop when every sample is in (``complete``);
+- stop early when the batch has CONVERGED — every row's checkpoint delta
+  under the entry's ``plateau_tol`` — and every row clears the requested
+  confidence floor (the early exit that frees the batch slot);
+- stop when the next stride cannot land before the deadline (projected
+  from an EMA of observed stride seconds), delivering the running mean.
+
+Per-stride progress reads the tiny conf vector with a raw
+``jax.device_get`` — a control-plane sync that also serves as the
+stride's completion barrier. The RESULT crosses host-ward exactly once,
+through `evalsuite.fan.device_fetch` (`run_anytime`; the serve worker
+fetches at its existing single-harvest point instead), so `fetch_scope`
+probes count one fetch per request with checkpointing on — the same
+zero-extra-fetch contract the health plane rides.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from typing import Any
+
+import jax
+
+from wam_tpu.anytime.state import SLOT_CONFIDENCE, SLOT_COUNT, SLOT_DELTA
+
+__all__ = ["drive_anytime", "run_anytime", "AnytimeOutcome"]
+
+
+@dataclass
+class AnytimeOutcome:
+    """`run_anytime`'s host-side result (one batch)."""
+
+    out: Any  # finalized attribution tree (host)
+    conf: Any  # (B, ANYTIME_VEC_SIZE) confidence vector (host)
+    n_used: int
+    n_total: int
+    complete: bool
+    converged: bool
+    strides: int
+    deadline_hit: bool
+
+
+def drive_anytime(entry, xs, ys, *, deadline: float | None = None,
+                  min_confidence: float = 0.0, n_rows: int | None = None):
+    """Run the stride loop (policy above); returns ``(out_dev, conf_dev,
+    info)`` with the finalized attribution and conf vector still ON DEVICE
+    (the caller owns the single result fetch) and ``info`` a dict of
+    ``n_used/n_total/complete/converged/strides/deadline_hit``.
+
+    ``deadline`` is an absolute `time.perf_counter` timestamp (None = run
+    to convergence or completion); ``min_confidence`` the floor every row
+    must clear for the convergence early exit; ``n_rows`` limits the
+    policy to the first rows of the batch (the serve worker's real rows —
+    pad rows replicate row 0 and must not hold the batch open)."""
+    state = entry.begin(xs, ys)
+    n_total = entry.n_total
+    tol = entry.plateau_tol
+    strides = 0
+    ema_stride_s: float | None = None
+    converged = False
+    deadline_hit = False
+    count = 0
+    while True:
+        t0 = time.perf_counter()
+        state = entry.step(state, xs, ys)
+        # control-plane sync: blocks until the stride lands, so the wall
+        # delta is an honest per-stride service time for the projection
+        cv = jax.device_get(entry.confidence(state))
+        dt = time.perf_counter() - t0
+        ema_stride_s = dt if ema_stride_s is None else 0.5 * (ema_stride_s + dt)
+        strides += 1
+        rows = cv[:n_rows] if n_rows else cv
+        count = int(rows[0, SLOT_COUNT])
+        if count >= n_total:
+            break
+        converged = (tol > 0.0
+                     and float(rows[:, SLOT_DELTA].max()) <= tol
+                     and float(rows[:, SLOT_CONFIDENCE].min())
+                     >= min_confidence)
+        if converged:
+            break
+        now = time.perf_counter()
+        if deadline is not None and now + ema_stride_s > deadline:
+            deadline_hit = True
+            break
+    out_dev, conf_dev = entry.finalize(state)
+    info = {
+        "n_used": count,
+        "n_total": n_total,
+        "complete": count >= n_total,
+        "converged": converged,
+        "strides": strides,
+        "deadline_hit": deadline_hit,
+    }
+    return out_dev, conf_dev, info
+
+
+def run_anytime(entry, xs, ys, *, deadline_ms: float | None = None,
+                min_confidence: float = 0.0,
+                n_rows: int | None = None) -> AnytimeOutcome:
+    """`drive_anytime` plus THE one result fetch
+    (`evalsuite.fan.device_fetch` — the counted, scoped fetch). Direct
+    drive for tests and benches; ``deadline_ms`` is relative to now."""
+    from wam_tpu.evalsuite.fan import device_fetch
+
+    deadline = (time.perf_counter() + deadline_ms / 1e3
+                if deadline_ms is not None else None)
+    out_dev, conf_dev, info = drive_anytime(
+        entry, xs, ys, deadline=deadline,
+        min_confidence=min_confidence, n_rows=n_rows)
+    out, conf = device_fetch((out_dev, conf_dev))
+    return AnytimeOutcome(out=out, conf=conf, **info)

@@ -1,0 +1,168 @@
+"""Checkpointed serving entries: the progressive-refinement counterpart of
+`serve.entry.jit_entry`.
+
+A plain serving entry is one jitted ``entry(x, y) -> attribution``; an
+*anytime* entry splits the same estimator into three jitted pieces the
+serve worker drives stride-by-stride:
+
+- ``begin(x, y) -> state``         zero state (sum accumulator, Welford
+                                   M2, checkpoint snapshot, conf vector)
+- ``step(state, x, y) -> state``   ONE dispatch accumulating ``stride``
+                                   samples (a masked `lax.fori_loop`, so a
+                                   non-dividing n_total never re-compiles)
+- ``finalize(state) -> (attr, conf)``  the running mean through the
+                                   caller's finalize plus the
+                                   (B, ANYTIME_VEC_SIZE) confidence vector
+
+``confidence(state)`` is a zero-dispatch field read: the worker's
+per-stride progress check `jax.device_get`s that tiny array — a
+control-plane sync, NOT a result fetch; the attribution itself crosses
+once, in the worker's single existing harvest (the zero-extra-fetch
+contract, `evalsuite.fan.device_fetch`).
+
+The entry object also answers ``entry(x, y)``: the full-n synchronous
+path (drive every stride, return the finalized attribution alone), which
+is what a server with ``WAM_TPU_NO_ANYTIME=1`` — or a plain warmup —
+sees. Like `jit_entry(with_health=...)`, the marker attribute
+(``wam_anytime``) rides a plain-object shell because jit callables reject
+attribute assignment.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+from wam_tpu.anytime.state import conf_stats, m2_update
+from wam_tpu.obs import sentinel as obs_sentinel
+
+__all__ = ["AnytimeEntry", "make_anytime_entry", "DEFAULT_PLATEAU_TOL"]
+
+# relative per-checkpoint motion below which an input counts as converged
+# (the early-exit trigger); ~half a percent of the map's RMS per stride
+DEFAULT_PLATEAU_TOL = 5e-3
+
+
+class AnytimeEntry:
+    """The begin/step/confidence/finalize bundle (module docstring). Built
+    by `make_anytime_entry`; consumed by the serve worker via
+    `anytime.driver.drive_anytime` or called directly as ``entry(x, y)``
+    for the non-anytime full-n path."""
+
+    wam_anytime = True
+
+    def __init__(self, begin, step, finalize, *, n_total: int, stride: int,
+                 plateau_tol: float, name: str):
+        self.begin = begin
+        self.step = step
+        self.finalize = finalize
+        self.n_total = int(n_total)
+        self.stride = int(stride)
+        self.plateau_tol = float(plateau_tol)
+        self.__name__ = name
+
+    def confidence(self, state):
+        """The state's live conf vector — a device-array field read, no
+        dispatch; the worker's per-stride control sync reads this."""
+        return state[-1]
+
+    def n_strides(self) -> int:
+        return -(-self.n_total // self.stride)
+
+    def __call__(self, x, y):
+        """Full-n synchronous entry: every stride, finalized attribution
+        only — the `WAM_TPU_NO_ANYTIME` / plain-server compatibility
+        surface (confidence is computed and dropped)."""
+        state = self.begin(x, y)
+        for _ in range(self.n_strides()):
+            state = self.step(state, x, y)
+        out, _conf = self.finalize(state)
+        return out
+
+
+def make_anytime_entry(
+    sample_fn: Callable,
+    finalize_fn: Callable | None = None,
+    *,
+    n_total: int,
+    stride: int = 5,
+    plateau_tol: float = DEFAULT_PLATEAU_TOL,
+    on_trace: Callable[[], None] | None = None,
+    obs_kind: str = "serve",
+    name: str = "anytime_entry",
+) -> AnytimeEntry:
+    """Build an `AnytimeEntry` from a per-sample estimator step.
+
+    ``sample_fn(x, y, i) -> g`` is sample ``i``'s contribution (leading
+    batch axis on every leaf; e.g. a SmoothGrad draw's mosaic) whose mean
+    over ``n_total`` samples is the attribution; ``finalize_fn(mean) ->
+    attr`` post-processes the mean (identity when None). ``stride`` is the
+    checkpoint cadence k — samples per `step` dispatch; the remainder of a
+    non-dividing ``n_total`` is weight-masked inside the same compiled
+    graph, so every stride shares one executable. Each of the three jits
+    reports its trace to the compile sentinel under ``obs_kind`` and fires
+    ``on_trace`` (the serve ledger's compile counter), exactly like
+    `serve.entry.jit_entry` — an anytime bucket warms at 3 compiles
+    (begin/step/finalize), not 1."""
+    if n_total < 1:
+        raise ValueError(f"n_total must be >= 1, got {n_total}")
+    if not 1 <= stride <= n_total:
+        raise ValueError(
+            f"stride must be in [1, n_total={n_total}], got {stride}")
+    if finalize_fn is None:
+        finalize_fn = lambda mean: mean  # noqa: E731
+
+    def _traced(fn, detail):
+        def wrapped(*args):
+            obs_sentinel.record_trace(obs_kind, detail=f"{name}:{detail}")
+            if on_trace is not None:
+                on_trace()
+            return fn(*args)
+
+        return jax.jit(wrapped)
+
+    def begin_impl(x, y):
+        g_shape = jax.eval_shape(sample_fn, x, y, jnp.asarray(0, jnp.int32))
+        zeros = jax.tree_util.tree_map(
+            lambda s: jnp.zeros(s.shape, s.dtype), g_shape)
+        b = x.shape[0]
+        state = (zeros, jnp.zeros((b,), jnp.float32),
+                 jnp.asarray(0, jnp.int32),
+                 zeros, jnp.asarray(0, jnp.int32),
+                 jnp.zeros((b, 4), jnp.float32))
+        return state
+
+    def step_impl(state, x, y):
+        acc, m2, count, prev_acc, prev_count, _ = state
+
+        def body(_, carry):
+            acc, m2, count = carry
+            g = sample_fn(x, y, count)
+            # weight-mask past n_total: the tail stride of a non-dividing
+            # n keeps the same compiled shape, extra samples are inert
+            w = jnp.where(count < n_total, 1.0, 0.0).astype(jnp.float32)
+            acc_new = jax.tree_util.tree_map(
+                lambda a, b: a + (w * b).astype(a.dtype), acc, g)
+            m2 = jnp.where(w > 0.0, m2_update(m2, acc, acc_new, count), m2)
+            return acc_new, m2, count + jnp.asarray(w, jnp.int32)
+
+        acc, m2, count = jax.lax.fori_loop(
+            0, stride, body, (acc, m2, count))
+        conf = conf_stats(acc, m2, count, prev_acc, prev_count)
+        # the checkpoint snapshot the NEXT stride's delta measures against
+        return (acc, m2, count, acc, count, conf)
+
+    def finalize_impl(state):
+        acc, _m2, count, _pa, _pc, conf = state
+        scale = 1.0 / jnp.maximum(count.astype(jnp.float32), 1.0)
+        mean = jax.tree_util.tree_map(
+            lambda a: (a.astype(jnp.float32) * scale).astype(a.dtype), acc)
+        return finalize_fn(mean), conf
+
+    return AnytimeEntry(
+        _traced(begin_impl, "begin"),
+        _traced(step_impl, "step"),
+        _traced(finalize_impl, "finalize"),
+        n_total=n_total, stride=stride, plateau_tol=plateau_tol, name=name)

@@ -1,0 +1,37 @@
+"""Result types for anytime attribution serving."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any
+
+__all__ = ["AnytimeResult"]
+
+
+@dataclass(frozen=True)
+class AnytimeResult:
+    """One served request's best-so-far attribution plus its certainty.
+
+    Futures of an anytime server (`serve.AttributionServer` over an entry
+    built by `anytime.entry.make_anytime_entry`) resolve to this instead
+    of a bare attribution row: a deadline-closed window delivers the
+    running mean at whatever sample count it reached (``complete=False``)
+    rather than raising `DeadlineExceededError`, and a converged input
+    exits early (``converged=True``) with fewer samples than ``n_total``.
+
+    ``confidence`` is the `anytime.state` scalar in (0, 1]; ``rel_sem``
+    and ``delta`` are the two raw signals it folds (relative standard
+    error of the mean; relative motion since the previous checkpoint)."""
+
+    attribution: Any
+    confidence: float
+    n_used: int
+    n_total: int
+    complete: bool
+    converged: bool
+    rel_sem: float = 0.0
+    delta: float = 0.0
+
+    def meets(self, min_confidence: float) -> bool:
+        """Did this result clear a confidence floor (goodput predicate)?"""
+        return self.confidence >= float(min_confidence)

@@ -23,7 +23,8 @@ import jax.numpy as jnp
 from jax import lax
 
 __all__ = ["noise_sigma", "smoothgrad", "integrated_path", "trapezoid",
-           "resolve_sample_chunk", "validate_sample_batch_size"]
+           "resolve_sample_chunk", "resolve_checkpoint_stride",
+           "validate_sample_batch_size"]
 
 
 def validate_sample_batch_size(value) -> None:
@@ -76,6 +77,35 @@ def resolve_sample_chunk(sample_batch_size, batch: int, n_samples: int,
         return None
     chunk = max(1, _AUTO_TARGET_ROWS // max(1, int(batch)))
     return _clamp_chunk(chunk, n_samples)
+
+
+def resolve_checkpoint_stride(stride, n_samples: int, *,
+                              workload: str | None = None, shape=None,
+                              batch: int | None = None,
+                              dtype: str = "f32",
+                              default: int = 5) -> int:
+    """Trace-time resolution of the anytime checkpoint stride k
+    (``stride="auto"``, `wam_tpu.anytime`).
+
+    Explicit ints pass through (clamped to [1, n_samples]). For "auto", a
+    tuned ``anytime_stride`` from the schedule cache wins when the caller
+    identifies its workload (the `tune` sweep axis added with the anytime
+    round); otherwise ``default`` — small enough that a deadline-pressed
+    request still lands several checkpoints inside a typical window,
+    large enough that the conf-vector control sync stays a rounding error
+    next to the sample dispatches."""
+    if stride != "auto":
+        stride = int(stride)
+        if stride < 1:
+            raise ValueError(f"checkpoint stride must be >= 1, got {stride}")
+        return min(stride, max(1, int(n_samples)))
+    if workload is not None:
+        from wam_tpu.tune import lookup_schedule
+
+        ent = lookup_schedule(workload, shape, batch, dtype)
+        if ent is not None and ent.get("anytime_stride"):
+            return min(int(ent["anytime_stride"]), max(1, int(n_samples)))
+    return min(int(default), max(1, int(n_samples)))
 
 
 def noise_sigma(x: jax.Array, stdev_spread: float) -> jax.Array:

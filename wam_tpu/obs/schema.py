@@ -62,10 +62,18 @@ METRIC_NAMES = frozenset({
     "wam_tpu_memory_staged_bytes",
     # SLO tracker (obs/slo.py)
     "wam_tpu_slo_burn_rate",
+    "wam_tpu_slo_confidence",
     "wam_tpu_slo_error_rate",
     "wam_tpu_slo_health_rate",
     "wam_tpu_slo_p99_seconds",
     "wam_tpu_slo_window_requests",
+    # anytime attribution (anytime/, serve/metrics.py)
+    "wam_tpu_anytime_batches_total",
+    "wam_tpu_anytime_confidence",
+    "wam_tpu_anytime_deadline_partial_total",
+    "wam_tpu_anytime_early_exit_total",
+    "wam_tpu_anytime_samples_fraction",
+    "wam_tpu_anytime_strides_total",
     # retry / hedging (serve/retry.py)
     "wam_tpu_retry_attempts_total",
     "wam_tpu_retry_exhausted_total",
@@ -96,6 +104,7 @@ METRIC_NAMES = frozenset({
 LEDGER_ROW_TYPES = frozenset({
     "fleet_summary",
     "obs_snapshot",
+    "partial_result",
     "pod_autoscale",
     "pod_summary",
     "pod_worker",

@@ -74,6 +74,11 @@ class SLObjectives:
     error_rate: float = 0.0
     health_rate: float = 0.0
     window_s: float = 60.0
+    # anytime serving (wam_tpu.anytime): confidence-at-delivery floor —
+    # burn counts requests delivered BELOW this confidence against a 1%
+    # budget (the p99 convention: an anytime server may hand out up to 1%
+    # of its maps under the floor before the objective burns)
+    min_confidence: float = 0.0
 
 
 def parse_slo(spec) -> dict | None:
@@ -109,7 +114,8 @@ def parse_slo(spec) -> dict | None:
                 continue
             k, _, v = kv.partition("=")
             k = k.strip()
-            if k not in ("p99_ms", "error_rate", "health_rate", "window_s"):
+            if k not in ("p99_ms", "error_rate", "health_rate", "window_s",
+                         "min_confidence"):
                 raise ValueError(f"unknown SLO objective {k!r} in {spec!r}")
             kwargs[k] = float(v)
         if "@" in bucket and not bucket.rsplit("@", 1)[1]:
@@ -137,6 +143,10 @@ _g_p99 = _registry.gauge(
     labels=("replica", "bucket"))
 _g_n = _registry.gauge(
     "wam_tpu_slo_window_requests", "requests inside the rolling window",
+    labels=("replica", "bucket"))
+_g_conf = _registry.gauge(
+    "wam_tpu_slo_confidence",
+    "mean anytime confidence-at-delivery over the window",
     labels=("replica", "bucket"))
 
 
@@ -172,9 +182,12 @@ class SLOTracker:
 
     def note(self, bucket_key: str, *, latency_s: float = 0.0,
              ok: bool = True, healthy: bool = True,
+             confidence: float = 1.0,
              now: float | None = None, qos: str | None = None) -> None:
         """One resolved request. ``qos`` lands the sample in the
-        ``bucket@class`` window (module docstring). Errors and expiries go
+        ``bucket@class`` window (module docstring). ``confidence`` is the
+        anytime confidence-at-delivery (1.0 for full-n results, so plain
+        servers never burn a confidence budget). Errors and expiries go
         through `note_error` (they have no meaningful latency sample)."""
         key = f"{bucket_key}@{qos}" if qos else bucket_key
         if self.objectives_for(key) is None:
@@ -183,7 +196,8 @@ class SLOTracker:
         publish = False
         with self._lock:
             self._windows.setdefault(key, deque()).append(
-                (now, float(latency_s), bool(ok), bool(healthy)))
+                (now, float(latency_s), bool(ok), bool(healthy),
+                 float(confidence)))
             if now - self._last_publish >= _PUBLISH_MIN_INTERVAL_S:
                 self._last_publish = now
                 publish = True
@@ -201,7 +215,7 @@ class SLOTracker:
         with self._lock:
             w = self._windows.setdefault(key, deque())
             for _ in range(int(n)):
-                w.append((now, 0.0, False, False))
+                w.append((now, 0.0, False, False, 0.0))
 
     # -- window reads -------------------------------------------------------
 
@@ -228,12 +242,14 @@ class SLOTracker:
         n = len(window)
         if n == 0:
             return {"n": 0, "error_rate": 0.0, "health_rate": 1.0,
-                    "p99_s": 0.0, "burn_rate": 0.0}
-        errors = sum(1 for _, _, ok, _ in window if not ok)
-        unhealthy = sum(1 for _, _, _, h in window if not h)
+                    "p99_s": 0.0, "mean_confidence": 1.0, "burn_rate": 0.0}
+        errors = sum(1 for _, _, ok, _, _ in window if not ok)
+        unhealthy = sum(1 for _, _, _, h, _ in window if not h)
         error_rate = errors / n
         health_rate = 1.0 - unhealthy / n
-        lats = sorted(lat for _, lat, ok, _ in window if ok)
+        lats = sorted(lat for _, lat, ok, _, _ in window if ok)
+        confs = [c for _, _, ok, _, c in window if ok]
+        mean_conf = sum(confs) / len(confs) if confs else 1.0
         if lats:
             i = min(len(lats) - 1, int(round(0.99 * (len(lats) - 1))))
             p99_s = lats[i]
@@ -248,8 +264,14 @@ class SLOTracker:
         if obj.p99_ms > 0.0 and lats:
             over = sum(1 for lat in lats if lat > obj.p99_ms / 1e3)
             burn = max(burn, (over / len(lats)) / 0.01)
+        if obj.min_confidence > 0.0 and confs:
+            # the p99 convention: 1% of delivered maps may land under the
+            # confidence floor before the objective burns (docstring)
+            under = sum(1 for c in confs if c < obj.min_confidence)
+            burn = max(burn, (under / len(confs)) / 0.01)
         return {"n": n, "error_rate": error_rate, "health_rate": health_rate,
-                "p99_s": p99_s, "burn_rate": burn}
+                "p99_s": p99_s, "mean_confidence": mean_conf,
+                "burn_rate": burn}
 
     def burn_rate(self, bucket_key: str, now: float | None = None) -> float:
         return self.bucket_stats(bucket_key, now=now)["burn_rate"]
@@ -289,6 +311,8 @@ class SLOTracker:
                 _g_health.set(st["health_rate"], replica=self._rl, bucket=bkey)
                 _g_p99.set(st["p99_s"], replica=self._rl, bucket=bkey)
                 _g_n.set(st["n"], replica=self._rl, bucket=bkey)
+                _g_conf.set(st["mean_confidence"], replica=self._rl,
+                            bucket=bkey)
         return {
             "metric": "slo_status",
             "replica_id": self.replica_id,

@@ -244,6 +244,16 @@ class SeqShardedWam:
                                             static_argnames=("spatial",))
         self._fused_ig_chunk_acc = _sentinel_jit(self._fused_ig_chunk_acc_impl,
                                                  static_argnames=("spatial", "g"))
+        # anytime checkpointing (wam_tpu.anytime): Welford M2 from
+        # consecutive SUM accumulators + the per-row confidence vector.
+        # Both are SIDE computations — they read the accumulator, never
+        # feed back into it, so the accumulator chain of the checkpointed
+        # loops stays the exact same jitted dispatches as the plain loops
+        # (the bit-equal-checkpoint invariant, pinned in tests).
+        from wam_tpu.anytime.state import conf_stats, m2_update
+
+        self._anytime_m2 = _sentinel_jit(m2_update, detail="_anytime_m2")
+        self._anytime_conf = _sentinel_jit(conf_stats, detail="_anytime_conf")
 
     # -- pieces ------------------------------------------------------------
 
@@ -596,6 +606,174 @@ class SeqShardedWam:
                            else self._call(self._accum, acc, part, 1.0))
                 i += n_real
         return self._finalize(self._call(self._scale, acc, 1.0 / n_samples))
+
+    # -- anytime checkpointed estimators -----------------------------------
+    # Per-sample loops (the fused path's sample_chunk=1 cadence) with a
+    # confidence checkpoint every `stride` samples. The accumulator chain
+    # is the SAME jitted calls in the same order as the plain estimators,
+    # so the checkpoint at stride=n is bit-identical to the
+    # non-checkpointed result; the M2/conf side dispatches never touch it.
+
+    def smoothgrad_checkpointed(self, x, y, key, *, n_samples: int,
+                                stdev_spread: float,
+                                stride: int | str = "auto",
+                                min_confidence: float = 0.0,
+                                plateau_tol: float = 0.0,
+                                on_checkpoint=None):
+        """`smoothgrad` with progressive-refinement checkpoints: every
+        ``stride`` samples (and at the end) the running mean's confidence
+        vector (`wam_tpu.anytime.state`) is read back — a tiny
+        control-plane sync, the map itself never crosses early — and
+        ``on_checkpoint(count, conf)`` fires. With ``plateau_tol > 0`` the
+        loop EXITS EARLY once every row's checkpoint delta is under the
+        tolerance and every row's confidence clears ``min_confidence``;
+        the returned map is then the mean over the samples actually used.
+
+        ``stride="auto"`` consults the tuned ``anytime_stride`` schedule
+        axis (`core.estimators.resolve_checkpoint_stride`). Returns
+        ``(map, info)`` — info carries ``n_used / n_total / complete /
+        converged / conf`` (the last host conf vector, (B, 4))."""
+        from wam_tpu.core.estimators import resolve_checkpoint_stride
+
+        self._check_batched(x)
+        fused = self._resolve_fused(x)
+        stride = resolve_checkpoint_stride(
+            stride, n_samples, workload=f"wamseq{self.ndim}d",
+            shape=tuple(x.shape[1:]), batch=x.shape[0])
+        spatial = tuple(x.shape[-self.ndim:])
+        spread = jnp.asarray(stdev_spread, x.dtype)
+        if fused:
+            self.dec._check(x)
+        m2 = jnp.zeros((x.shape[0],), jnp.float32)
+        acc = None
+        prev_acc, prev_count = None, 0
+        conf_host = None
+        converged = False
+        count = 0
+        for i in range(n_samples):
+            ii = jnp.asarray(i, jnp.int32)
+            if fused:
+                if acc is None:
+                    acc_new = self._call(self._fused_step, x, key, ii,
+                                         spread, y, spatial=spatial)
+                else:
+                    acc_new = self._call(self._fused_step_acc, acc, x, key,
+                                         ii, spread, y, spatial=spatial)
+            else:
+                noisy = self._call(self._noisy, x, key, ii, spread)
+                coeffs = self._call(self.dec, self._dec_input(noisy))
+                g = self._call(self._grads, coeffs, y, spatial=spatial)
+                acc_new = (g if acc is None
+                           else self._call(self._accum, acc, g, 1.0))
+            if acc is not None:
+                m2 = self._call(self._anytime_m2, m2, acc, acc_new,
+                                jnp.asarray(i, jnp.float32))
+            acc = acc_new
+            count = i + 1
+            acc, m2, prev_acc, prev_count, conf_host, converged = (
+                self._checkpoint(acc, m2, count, n_samples, stride,
+                                 prev_acc, prev_count, conf_host,
+                                 min_confidence, plateau_tol,
+                                 on_checkpoint))
+            if converged:
+                break
+        attr = self._finalize(self._call(self._scale, acc, 1.0 / count))
+        info = {"n_used": count, "n_total": n_samples,
+                "complete": count >= n_samples, "converged": converged,
+                "conf": conf_host}
+        return attr, info
+
+    def integrated_checkpointed(self, x, y, *, n_steps: int,
+                                dx: float = 1.0,
+                                stride: int | str = "auto",
+                                min_confidence: float = 0.0,
+                                plateau_tol: float = 0.0,
+                                on_checkpoint=None):
+        """`integrated` with checkpoints every ``stride`` α-steps (see
+        `smoothgrad_checkpointed` — same policy, same conf vector; the
+        plateau signal is the running trapezoid integral's motion). An
+        early exit truncates the α-path: the best-so-far integral over
+        [0, α_k]. Returns ``(coeffs, integral, info)``."""
+        from wam_tpu.core.estimators import resolve_checkpoint_stride
+
+        self._check_batched(x)
+        fused = self._resolve_fused(x)
+        stride = resolve_checkpoint_stride(
+            stride, n_steps, workload=f"wamseq{self.ndim}d",
+            shape=tuple(x.shape[1:]), batch=x.shape[0])
+        spatial = tuple(x.shape[-self.ndim:])
+        coeffs = self._call(self.dec, self._dec_input(x))
+        alphas = jnp.linspace(0.0, 1.0, n_steps, dtype=jnp.float32)
+
+        def trap_w(i):
+            if n_steps == 1:
+                return 1.0
+            return 0.5 if i in (0, n_steps - 1) else 1.0
+
+        m2 = jnp.zeros((x.shape[0],), jnp.float32)
+        acc = None
+        prev_acc, prev_count = None, 0
+        conf_host = None
+        converged = False
+        count = 0
+        for i in range(n_steps):
+            w = trap_w(i) * dx
+            if fused:
+                if acc is None:
+                    acc_new = self._call(self._fused_ig_first, coeffs,
+                                         alphas[i], w, y, spatial=spatial)
+                else:
+                    acc_new = self._call(self._fused_ig_step, acc, coeffs,
+                                         alphas[i], w, y, spatial=spatial)
+            else:
+                g = self._call(self._grads_ig, coeffs, alphas[i], y,
+                               spatial=spatial)
+                acc_new = (self._call(self._first_nan, g, w)
+                           if acc is None
+                           else self._call(self._accum_nan, acc, g, w))
+            if acc is not None:
+                m2 = self._call(self._anytime_m2, m2, acc, acc_new,
+                                jnp.asarray(i, jnp.float32))
+            acc = acc_new
+            count = i + 1
+            acc, m2, prev_acc, prev_count, conf_host, converged = (
+                self._checkpoint(acc, m2, count, n_steps, stride,
+                                 prev_acc, prev_count, conf_host,
+                                 min_confidence, plateau_tol,
+                                 on_checkpoint))
+            if converged:
+                break
+        info = {"n_used": count, "n_total": n_steps,
+                "complete": count >= n_steps, "converged": converged,
+                "conf": conf_host}
+        return self._gather(coeffs), self._finalize(acc), info
+
+    def _checkpoint(self, acc, m2, count, n_total, stride, prev_acc,
+                    prev_count, conf_host, min_confidence, plateau_tol,
+                    on_checkpoint):
+        """Shared checkpoint read + early-exit policy for the checkpointed
+        loops: at each stride boundary (and at n_total) compute the conf
+        vector on device, sync it back, snapshot the accumulator for the
+        next delta, and decide convergence."""
+        from wam_tpu.anytime.state import SLOT_CONFIDENCE, SLOT_DELTA
+
+        converged = False
+        if count % stride == 0 or count >= n_total:
+            ref = prev_acc if prev_acc is not None else acc
+            conf_dev = self._call(
+                self._anytime_conf, acc, m2,
+                jnp.asarray(count, jnp.float32), ref,
+                jnp.asarray(prev_count, jnp.float32))
+            conf_host = jax.device_get(conf_dev)
+            prev_acc, prev_count = acc, count
+            if on_checkpoint is not None:
+                on_checkpoint(count, conf_host)
+            if (count < n_total and plateau_tol > 0.0
+                    and float(conf_host[:, SLOT_DELTA].max()) <= plateau_tol
+                    and float(conf_host[:, SLOT_CONFIDENCE].min())
+                    >= min_confidence):
+                converged = True
+        return acc, m2, prev_acc, prev_count, conf_host, converged
 
     def integrated(self, x, y, *, n_steps: int, dx: float = 1.0,
                    sample_chunk: int | None | str = 1):

@@ -28,6 +28,7 @@ from wam_tpu.serve.runtime import (
     QOS_CLASSES,
     AttributionServer,
     DeadlineExceededError,
+    InvalidDeadlineError,
     MemoryAdmissionError,
     QueueFullError,
     ServeError,
@@ -47,6 +48,7 @@ __all__ = [
     "QueueFullError",
     "MemoryAdmissionError",
     "DeadlineExceededError",
+    "InvalidDeadlineError",
     "ServerClosedError",
     "WorkerCrashedError",
     "RetryBudgetExceededError",

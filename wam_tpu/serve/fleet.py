@@ -96,6 +96,7 @@ from wam_tpu.serve.runtime import (
     QOS_CLASSES,
     AttributionServer,
     DeadlineExceededError,
+    InvalidDeadlineError,
     QueueFullError,
     ServeError,
     ServerClosedError,
@@ -148,6 +149,9 @@ class _FleetRequest:
     deadline_at: float | None  # perf_counter timestamp, None = no deadline
     future: Future
     qos: str = "interactive"
+    # anytime serving: the per-request confidence floor, threaded to
+    # whichever replica wins the route (wam_tpu.anytime)
+    min_confidence: float = 0.0
     # fleet-tier result-cache key (None = cache off): computed once at
     # submit, survives re-routes, populated from whichever replica wins
     ckey: str | None = None
@@ -567,19 +571,26 @@ class FleetServer:
     # -- client side --------------------------------------------------------
 
     def submit(self, x, y=None, deadline_ms: float | None = None,
-               qos: str = "interactive") -> Future:
+               qos: str = "interactive",
+               min_confidence: float = 0.0) -> Future:
         """Admit one item and route it to the least-loaded live replica.
         Returns a fleet-level future — it survives a replica death by
         re-routing to survivors. ``qos`` is the request's admission class
         (threaded to the replica's lanes and into routing via the
-        interactive-depth weight). Raises `QueueFullError` only when every
-        live replica rejected."""
+        interactive-depth weight). ``min_confidence`` is the anytime
+        convergence floor, threaded to the winning replica (only
+        meaningful for fleets over anytime entries —
+        `wam_tpu.anytime`). Raises `QueueFullError` only when every
+        live replica rejected; a zero/negative ``deadline_ms`` fails at
+        admission with `InvalidDeadlineError` before any routing."""
         if self.labeled and y is None:
             raise ValueError("labeled fleet: submit(x, y) needs a class label")
         if not self.labeled and y is not None:
             raise ValueError("unlabeled fleet: submit() must not carry a label")
         if qos not in QOS_CLASSES:
             raise ValueError(f"qos must be one of {QOS_CLASSES}, got {qos!r}")
+        if deadline_ms is not None and deadline_ms <= 0:
+            raise InvalidDeadlineError(deadline_ms)
         x = np.asarray(x, self.dtype)
         bucket = self.table.select(x.shape)  # NoBucketError before any queueing
         ckey = None
@@ -599,7 +610,8 @@ class FleetServer:
         else:
             deadline_at = now + deadline_ms / 1e3
         req = _FleetRequest(x, y, bucket, deadline_at, Future(),
-                            qos=qos, ckey=ckey)
+                            qos=qos, min_confidence=float(min_confidence),
+                            ckey=ckey)
         if obs_tracing._STATE.enabled:
             # detached per-request root: ends on whichever thread resolves
             # the fleet future (worker callback), closing the trace
@@ -619,9 +631,10 @@ class FleetServer:
         return req.future
 
     def attribute(self, x, y=None, deadline_ms: float | None = None,
-                  qos: str = "interactive"):
+                  qos: str = "interactive", min_confidence: float = 0.0):
         """Blocking convenience wrapper: submit + wait."""
-        return self.submit(x, y, deadline_ms=deadline_ms, qos=qos).result()
+        return self.submit(x, y, deadline_ms=deadline_ms, qos=qos,
+                           min_confidence=min_confidence).result()
 
     def submit_with_retry(self, x, y=None, *, policy=None, stats=None,
                           rng=None, deadline_ms: float | None = None) -> Future:
@@ -767,7 +780,8 @@ class FleetServer:
         for r in cands:
             try:
                 inner = r.server.submit(req.x, req.y, deadline_ms=remaining_ms,
-                                        qos=req.qos)
+                                        qos=req.qos,
+                                        min_confidence=req.min_confidence)
             except QueueFullError as e:
                 retry_after = (
                     e.retry_after_s
@@ -794,7 +808,11 @@ class FleetServer:
         if exc is None:
             result = inner.result()
             if (self._cache is not None and req.ckey is not None
-                    and not replica.server.degraded):
+                    and not replica.server.degraded
+                    and not getattr(replica.server, "_anytime", False)):
+                # anytime replicas excluded: their results depend on the
+                # batch's deadline/convergence trajectory, which would
+                # break the cache's bit-identical-hit contract
                 # populate at the fleet tier (replicas carry no cache);
                 # degraded CPU-rebuilt entries are skipped — their rounding
                 # differs from the accelerator rows the cache promises

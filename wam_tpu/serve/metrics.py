@@ -112,6 +112,33 @@ _g_fleet_compiles = _obs_registry.gauge(
     "wam_tpu_fleet_compile_count",
     "compile_count per replica as of the last fleet_summary()",
     labels=("replica",))
+# anytime attribution (wam_tpu.anytime): progressive-refinement serving
+_c_any_batches = _obs_registry.counter(
+    "wam_tpu_anytime_batches_total",
+    "batches driven through the anytime stride loop",
+    labels=("replica", "bucket"))
+_c_any_early = _obs_registry.counter(
+    "wam_tpu_anytime_early_exit_total",
+    "anytime batches that exited on convergence before n_total",
+    labels=("replica",))
+_c_any_partial = _obs_registry.counter(
+    "wam_tpu_anytime_deadline_partial_total",
+    "anytime batches delivered best-so-far at a closing deadline",
+    labels=("replica",))
+_c_any_strides = _obs_registry.counter(
+    "wam_tpu_anytime_strides_total",
+    "stride dispatches executed by the anytime driver",
+    labels=("replica",))
+_h_any_fraction = _obs_registry.histogram(
+    "wam_tpu_anytime_samples_fraction",
+    "n_used / n_total at delivery (1.0 = ran to completion)",
+    labels=("replica",),
+    buckets=(0.125, 0.25, 0.375, 0.5, 0.625, 0.75, 0.875, 1.0))
+_h_any_conf = _obs_registry.histogram(
+    "wam_tpu_anytime_confidence",
+    "per-request confidence scalar at delivery",
+    labels=("replica",),
+    buckets=(0.5, 0.6, 0.7, 0.8, 0.9, 0.95, 0.99, 1.0))
 
 # Per-bucket EMA service-time seed until the first batch of that bucket
 # lands: the retry-after / routing estimate for a never-served bucket.
@@ -159,6 +186,14 @@ class ServeMetrics:
         # runtime attaches its SLOTracker so emit() can flush a slo_status
         # row next to this replica's summary (None = no SLO policy)
         self.slo = None
+        # anytime serving (wam_tpu.anytime): stride-loop counters + samples
+        self.anytime_batches = 0
+        self.anytime_strides = 0
+        self.anytime_early_exits = 0
+        self.anytime_deadline_partials = 0
+        self._anytime_fractions: list[float] = []  # n_used/n_total per batch
+        self._anytime_confidences: list[float] = []  # per delivered request
+        self._partial_rows: list[dict] = []  # partial_result ledger rows
         self._t0 = time.perf_counter()
 
     # -- mutators (called from dispatcher / worker threads) -----------------
@@ -278,6 +313,61 @@ class ServeMetrics:
         for lat in latencies_s:
             _h_latency.observe(lat, replica=self._rl)
 
+    def note_anytime(
+        self,
+        *,
+        bucket_shape: tuple[int, ...],
+        n_used: int,
+        n_total: int,
+        strides: int,
+        converged: bool,
+        deadline_hit: bool,
+        confidences: list[float],
+    ) -> None:
+        """One batch through the anytime stride loop (`anytime.driver`):
+        counters, the samples-fraction / confidence histograms, and — when
+        the batch was delivered SHORT of ``n_total`` — one ``partial_result``
+        v2 ledger row recording what was served instead of a full map
+        (an early convergence exit or a deadline best-so-far delivery)."""
+        key = bucket_key(bucket_shape)
+        fraction = n_used / n_total if n_total > 0 else 1.0
+        with self._lock:
+            self.anytime_batches += 1
+            self.anytime_strides += strides
+            self.anytime_early_exits += bool(converged)
+            self.anytime_deadline_partials += bool(deadline_hit)
+            self._anytime_fractions.append(fraction)
+            self._anytime_confidences.extend(confidences)
+            if n_used < n_total:
+                row = {
+                    "metric": "partial_result",
+                    "schema_version": SCHEMA_VERSION,
+                    "bucket": list(bucket_shape),
+                    "n_requests": len(confidences),
+                    "n_used": int(n_used),
+                    "n_total": int(n_total),
+                    "samples_fraction": fraction,
+                    "converged": bool(converged),
+                    "deadline_hit": bool(deadline_hit),
+                    "confidence_min": float(min(confidences)) if confidences
+                    else float("nan"),
+                    "confidence_mean": float(np.mean(confidences))
+                    if confidences else float("nan"),
+                    "timestamp": time.time(),
+                }
+                if self.replica_id is not None:
+                    row["replica_id"] = self.replica_id
+                self._partial_rows.append(row)
+        _c_any_batches.inc(replica=self._rl, bucket=key)
+        _c_any_strides.inc(strides, replica=self._rl)
+        if converged:
+            _c_any_early.inc(replica=self._rl)
+        if deadline_hit:
+            _c_any_partial.inc(replica=self._rl)
+        _h_any_fraction.observe(fraction, replica=self._rl)
+        for c in confidences:
+            _h_any_conf.observe(float(c), replica=self._rl)
+
     # -- reporting ----------------------------------------------------------
 
     def latency_sample(self) -> list[float]:
@@ -331,6 +421,18 @@ class ServeMetrics:
                 "busy_s": self.busy_s,
                 "utilization": self.busy_s / window_s if window_s > 0 else 0.0,
                 "stages": self.stages.summary(),
+                "anytime": {
+                    "batches": self.anytime_batches,
+                    "strides": self.anytime_strides,
+                    "early_exits": self.anytime_early_exits,
+                    "deadline_partials": self.anytime_deadline_partials,
+                    "samples_fraction_mean": float(
+                        np.mean(self._anytime_fractions))
+                    if self._anytime_fractions else float("nan"),
+                    "confidence_mean": float(
+                        np.mean(self._anytime_confidences))
+                    if self._anytime_confidences else float("nan"),
+                },
             }
 
     def summary(self) -> dict:
@@ -347,7 +449,7 @@ class ServeMetrics:
         with the registry's flattened values follows the summary — the
         periodic registry-in-the-ledger record."""
         with self._lock:
-            rows = list(self.batch_rows)
+            rows = list(self.batch_rows) + list(self._partial_rows)
         for row in rows:
             writer.write(row)
         summary = self.snapshot()

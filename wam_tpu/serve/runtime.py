@@ -92,6 +92,7 @@ __all__ = [
     "QueueFullError",
     "MemoryAdmissionError",
     "DeadlineExceededError",
+    "InvalidDeadlineError",
     "ServerClosedError",
     "WorkerCrashedError",
     "QOS_CLASSES",
@@ -136,6 +137,22 @@ class DeadlineExceededError(ServeError):
     """The request's deadline lapsed while it was still queued."""
 
 
+class InvalidDeadlineError(ServeError, ValueError):
+    """``submit(deadline_ms=)`` with a zero or negative window: rejected at
+    admission, carrying the offending value. (Before this check, a
+    non-positive window silently computed an already-past absolute
+    deadline, queued the request, and expired it at pop time — a client
+    bug surfaced as a confusing `DeadlineExceededError` after a full queue
+    round-trip.) Also a `ValueError`, since the deadline is a bad
+    *argument*, not a runtime condition."""
+
+    def __init__(self, deadline_ms):
+        super().__init__(
+            f"deadline_ms must be > 0 (or None for no deadline), "
+            f"got {deadline_ms!r}")
+        self.deadline_ms = deadline_ms
+
+
 class ServerClosedError(ServeError):
     """`submit` after `close()` (or during drain)."""
 
@@ -162,6 +179,9 @@ class _Request:
     ctx: tuple | None = None
     qos: str = "interactive"  # admission lane (QOS_CLASSES)
     ckey: str | None = None  # result-cache key (None = cache off)
+    # anytime serving: per-request confidence floor for the convergence
+    # early exit (0.0 = any converged delivery clears it)
+    min_confidence: float = 0.0
 
 
 class _Lanes:
@@ -244,6 +264,12 @@ class _Inflight:
     # numeric-health vector (device future) riding the same harvest as
     # ``out`` — None when the health plane is off
     hvec: object = None
+    # anytime serving: the (B, ANYTIME_VEC_SIZE) confidence vector (device)
+    # riding the same harvest, and the driver's stride-loop info dict
+    # (n_used / n_total / complete / converged / strides / deadline_hit) —
+    # both None on a plain full-n batch
+    cvec: object = None
+    anytime: dict | None = None
 
 
 _NOT_READY = object()  # non-blocking _take_batch: nothing poppable yet
@@ -377,6 +403,17 @@ class AttributionServer:
         if coalesce_ms < 0:
             raise ValueError("coalesce_ms must be >= 0")
         self._entry = entry
+        # anytime serving (wam_tpu.anytime): an entry built by
+        # make_anytime_entry flips the server into progressive-refinement
+        # mode — deadlines deliver best-so-far AnytimeResults instead of
+        # raising, converged batches exit early. WAM_TPU_NO_ANYTIME=1 is
+        # the kill switch: the entry's full-n __call__ serves as a plain
+        # entry and every anytime semantic (including min_confidence)
+        # is disabled.
+        import os
+
+        self._anytime = (bool(getattr(entry, "wam_anytime", False))
+                         and os.environ.get("WAM_TPU_NO_ANYTIME") != "1")
         self.table = buckets if isinstance(buckets, BucketTable) else BucketTable(buckets)
         self.max_batch = max_batch
         self.max_wait_s = max_wait_ms / 1e3
@@ -595,23 +632,48 @@ class AttributionServer:
     # -- client side --------------------------------------------------------
 
     def submit(self, x, y=None, deadline_ms: float | None = None,
-               qos: str = "interactive") -> Future:
+               qos: str = "interactive",
+               min_confidence: float = 0.0) -> Future:
         """Enqueue one item (NO leading batch axis — a client batch is a
         sequence of submits, coalesced back together by the worker).
         ``qos`` picks the admission lane (module docstring "QoS lanes").
         Returns a `concurrent.futures.Future` resolving to the item's
-        attribution (leading axis stripped), or raising `ServeError`."""
+        attribution (leading axis stripped), or raising `ServeError`.
+
+        On an ANYTIME server (entry built by
+        `wam_tpu.anytime.make_anytime_entry`) the future resolves to an
+        `AnytimeResult`: a closing ``deadline_ms`` window delivers the
+        best-so-far map + confidence instead of raising
+        `DeadlineExceededError`, and ``min_confidence`` is the floor every
+        batch row must clear for the convergence early exit. A zero or
+        negative ``deadline_ms`` is a client bug and fails at admission
+        with `InvalidDeadlineError` (any server kind)."""
         if self.labeled and y is None:
             raise ValueError("labeled server: submit(x, y) needs a class label")
         if not self.labeled and y is not None:
             raise ValueError("unlabeled server: submit() must not carry a label")
         if qos not in QOS_CLASSES:
             raise ValueError(f"qos must be one of {QOS_CLASSES}, got {qos!r}")
+        if deadline_ms is not None and deadline_ms <= 0:
+            raise InvalidDeadlineError(deadline_ms)
+        if min_confidence:
+            if not self._anytime:
+                raise ValueError(
+                    "min_confidence needs an anytime server (an entry built "
+                    "by wam_tpu.anytime.make_anytime_entry)")
+            if not 0.0 <= min_confidence <= 1.0:
+                raise ValueError(
+                    f"min_confidence must be in [0, 1], got {min_confidence}")
         x = np.asarray(x, self.dtype)
         bucket = self.table.select(x.shape)  # NoBucketError before any queueing
         self.metrics.note_submit()
         ckey = None
-        if self._cache is not None:
+        if self._cache is not None and self._anytime:
+            # anytime results are NOT cached: what a request gets back
+            # depends on the batch's deadline/convergence trajectory, so a
+            # cached partial would violate the bit-identical-hit contract
+            pass
+        elif self._cache is not None:
             # consult BEFORE admission: a hit resolves immediately and
             # never touches the queue, memory admission, or a batch slot
             ckey = self._cache.key(x, y)
@@ -633,7 +695,8 @@ class AttributionServer:
             deadline = (now + self.default_deadline_s) if self.default_deadline_s else None
         else:
             deadline = now + deadline_ms / 1e3
-        req = _Request(x, y, bucket, now, deadline, qos=qos, ckey=ckey)
+        req = _Request(x, y, bucket, now, deadline, qos=qos, ckey=ckey,
+                       min_confidence=float(min_confidence))
         if obs_tracing._STATE.enabled:
             ctx = obs_tracing.current_context()
             if ctx is None:
@@ -668,9 +731,10 @@ class AttributionServer:
         return req.future
 
     def attribute(self, x, y=None, deadline_ms: float | None = None,
-                  qos: str = "interactive"):
+                  qos: str = "interactive", min_confidence: float = 0.0):
         """Blocking convenience wrapper: submit + wait."""
-        return self.submit(x, y, deadline_ms=deadline_ms, qos=qos).result()
+        return self.submit(x, y, deadline_ms=deadline_ms, qos=qos,
+                           min_confidence=min_confidence).result()
 
     # -- load signal --------------------------------------------------------
 
@@ -817,8 +881,11 @@ class AttributionServer:
                 # deadline hygiene: expiries leave the lanes BEFORE slot
                 # accounting, so they cannot displace live requests from
                 # the take. Returned immediately (no pop) so their futures
-                # fail outside the lock with no added hold time.
-                expired = q.drop_expired(now)
+                # fail outside the lock with no added hold time. An ANYTIME
+                # server never drops: a lapsed deadline still gets
+                # dispatched and delivers its best-so-far map (the driver
+                # guarantees at least one stride).
+                expired = [] if self._anytime else q.drop_expired(now)
                 if expired:
                     self._pending -= len(expired)
                     # crash-guard reach: until the worker fails them they
@@ -915,8 +982,10 @@ class AttributionServer:
             for r in reqs:
                 # race-window recheck (pop -> here); _take_batch already
                 # filtered, so this only catches deadlines that lapsed in
-                # the microseconds since
-                (expired if r.deadline is not None and now > r.deadline else live).append(r)
+                # the microseconds since. Anytime servers serve lapsed
+                # deadlines too (best-so-far delivery, never a drop).
+                (expired if not self._anytime and r.deadline is not None
+                 and now > r.deadline else live).append(r)
             self._fail_expired(bucket, expired)
             if not live:
                 self._finish_active(bucket)
@@ -979,13 +1048,32 @@ class AttributionServer:
             staged = put_committed((xs, ys), self._device)
         t0 = time.perf_counter()
         hvec = None
+        cvec = None
+        anytime_info = None
         try:
             with obs_sentinel.label(
                 replica=self.replica_id,
                 bucket=bucket_key(bucket.shape),
                 phase="serve",
             ), self.metrics.stages.stage("dispatch"):
-                out = self._call_entry(*staged)
+                if self._anytime:
+                    # progressive refinement: drive the begin/step/finalize
+                    # stride loop (`anytime.driver` — the shared policy).
+                    # Batch policy over the LIVE rows only (pad rows
+                    # replicate row 0 and must not hold the batch open):
+                    # tightest deadline, highest confidence floor.
+                    from wam_tpu.anytime.driver import drive_anytime
+
+                    deadlines = [r.deadline for r in live
+                                 if r.deadline is not None]
+                    out, cvec, anytime_info = drive_anytime(
+                        self._entry, *staged,
+                        deadline=min(deadlines) if deadlines else None,
+                        min_confidence=max(
+                            (r.min_confidence for r in live), default=0.0),
+                        n_rows=n_real)
+                else:
+                    out = self._call_entry(*staged)
                 if self._health is not None:
                     if getattr(self._entry, "wam_health", False):
                         # fused entry: the vector is a leaf of the same
@@ -1011,7 +1099,8 @@ class AttributionServer:
                         if k:
                             self._slo.note_error(bkey, k, qos=qos)
                 return None
-        return _Inflight(bucket, live, depth, xs, ys, t0, out, hvec)
+        return _Inflight(bucket, live, depth, xs, ys, t0, out, hvec,
+                         cvec=cvec, anytime=anytime_info)
 
     def _complete(self, batch: _Inflight):
         """Harvest an in-flight batch (block on the device result — where
@@ -1021,10 +1110,26 @@ class AttributionServer:
         live, n_real = batch.live, len(batch.live)
         bkey = bucket_key(batch.bucket.shape)
         healthy = True
+        conf_host = None
         try:
             try:
                 with self.metrics.stages.stage("harvest"):
-                    if batch.hvec is not None:
+                    if batch.anytime is not None:
+                        # anytime batch: the confidence vector (and health
+                        # vector, when on) rides the batch's ONE counted
+                        # result fetch — `evalsuite.fan.device_fetch`, so
+                        # fetch-accounting probes see exactly one fetch per
+                        # served batch with checkpointing on
+                        from wam_tpu.evalsuite.fan import device_fetch
+
+                        if batch.hvec is not None:
+                            out, conf_host, hvec_host = device_fetch(
+                                (batch.out, batch.cvec, batch.hvec))
+                        else:
+                            out, conf_host = device_fetch(
+                                (batch.out, batch.cvec))
+                            hvec_host = None
+                    elif batch.hvec is not None:
                         # the health vector rides the batch's one fetch
                         out, hvec_host = jax.device_get((batch.out, batch.hvec))
                     else:
@@ -1034,6 +1139,10 @@ class AttributionServer:
                 try:
                     out = self._recover(batch.xs, batch.ys)
                     hvec_host = None
+                    # the fallback entry is a plain full-n one: replayed
+                    # rows distribute as ordinary attributions
+                    batch.anytime = None
+                    conf_host = None
                 except Exception as e:
                     for r in live:
                         r.future.set_exception(e)
@@ -1049,10 +1158,30 @@ class AttributionServer:
                 # next submit observes the updated health_ok() verdict
                 healthy = self._health.note(hvec_host, bucket=bkey)
             service_s = time.perf_counter() - batch.t0
+            confidences: list[float] = []
             with self.metrics.stages.stage("distribute"):
                 done = time.perf_counter()
                 for i, r in enumerate(live):
                     row = jax.tree_util.tree_map(lambda a: np.asarray(a)[i], out)
+                    if batch.anytime is not None:
+                        # anytime delivery: the row plus its certainty —
+                        # never cached (submit kept ckey None)
+                        from wam_tpu.anytime.result import AnytimeResult
+                        from wam_tpu.anytime.state import (
+                            SLOT_CONFIDENCE, SLOT_DELTA, SLOT_REL_SEM)
+
+                        conf = float(conf_host[i, SLOT_CONFIDENCE])
+                        confidences.append(conf)
+                        r.future.set_result(AnytimeResult(
+                            attribution=row,
+                            confidence=conf,
+                            n_used=batch.anytime["n_used"],
+                            n_total=batch.anytime["n_total"],
+                            complete=batch.anytime["complete"],
+                            converged=batch.anytime["converged"],
+                            rel_sem=float(conf_host[i, SLOT_REL_SEM]),
+                            delta=float(conf_host[i, SLOT_DELTA])))
+                        continue
                     if (self._cache is not None and r.ckey is not None
                             and not self.degraded):
                         # populate at harvest (host-side rows). Degraded
@@ -1087,9 +1216,20 @@ class AttributionServer:
                 latencies_s=latencies_s,
                 qos=[r.qos for r in live],
             )
+            if batch.anytime is not None:
+                self.metrics.note_anytime(
+                    bucket_shape=batch.bucket.shape,
+                    n_used=batch.anytime["n_used"],
+                    n_total=batch.anytime["n_total"],
+                    strides=batch.anytime["strides"],
+                    converged=batch.anytime["converged"],
+                    deadline_hit=batch.anytime["deadline_hit"],
+                    confidences=confidences)
             if self._slo is not None:
-                for r, lat in zip(live, latencies_s):
-                    self._slo.note(bkey, latency_s=lat, ok=True,
-                                   healthy=healthy, qos=r.qos)
+                for i, (r, lat) in enumerate(zip(live, latencies_s)):
+                    self._slo.note(
+                        bkey, latency_s=lat, ok=True, healthy=healthy,
+                        qos=r.qos,
+                        confidence=confidences[i] if confidences else 1.0)
         finally:
             self._finish_active(batch.bucket)

@@ -39,6 +39,9 @@ class Candidate:
     fan_cap: int | None = None  # evaluation fan chunk cap (eval workloads)
     fan_chunk: int | None = None  # eval images-per-chunk override (fan engine)
     seq_fused: bool | None = None  # seq-sharded one-jit step vs split loop
+    # anytime checkpoint stride k (wam_tpu.anytime): samples per
+    # confidence checkpoint in the checkpointed estimators / entries
+    anytime_stride: int | None = None
 
     def label(self) -> str:
         parts = [f"chunk={self.sample_chunk if self.sample_chunk else 'full'}"]
@@ -56,13 +59,15 @@ class Candidate:
             parts.append(f"fchunk={self.fan_chunk}")
         if self.seq_fused is not None:
             parts.append("fused" if self.seq_fused else "split")
+        if self.anytime_stride is not None:
+            parts.append(f"k={self.anytime_stride}")
         return " ".join(parts)
 
     def entry(self) -> dict:
         """The knob fields of a schedule-cache entry."""
         out: dict = {"sample_chunk": self.sample_chunk}
         for field in ("stream_noise", "dwt_impl", "synth_impl", "layout",
-                      "fan_cap", "fan_chunk", "seq_fused"):
+                      "fan_cap", "fan_chunk", "seq_fused", "anytime_stride"):
             v = getattr(self, field)
             if v is not None:
                 out[field] = v

@@ -290,12 +290,18 @@ def _seq_mesh():
     return make_mesh({"data": n}, _jax.devices()[:n])
 
 
-def _seq_candidates(chunks=(1, 2, None)) -> list[Candidate]:
-    """The seq sweep space: sample-chunk ladder × fused-vs-split. Explicit
-    values only — `SeqShardedWam` resolves BOTH knobs from the entry this
+def _seq_candidates(chunks=(1, 2, None),
+                    strides=(2, 4)) -> list[Candidate]:
+    """The seq sweep space: sample-chunk ladder × fused-vs-split, plus the
+    anytime checkpoint-stride ladder (fused path only — the checkpointed
+    estimators run per-sample, so sample_chunk=1 is their cadence). Explicit
+    values only — `SeqShardedWam` resolves these knobs from the entry this
     sweep writes, so reading "auto" here would be circular."""
-    return [Candidate(sample_chunk=c, seq_fused=f)
-            for f in (True, False) for c in chunks]
+    cands = [Candidate(sample_chunk=c, seq_fused=f)
+             for f in (True, False) for c in chunks]
+    cands += [Candidate(sample_chunk=1, seq_fused=True, anytime_stride=k)
+              for k in strides]
+    return cands
 
 
 def _wamseq1d_workload(n_samples: int = 4, batch: int = 2,
@@ -320,10 +326,17 @@ def _wamseq1d_workload(n_samples: int = 4, batch: int = 2,
         sw = SeqShardedWam(mesh, model, ndim=1, wavelet="db2", level=2,
                            mode="symmetric", fused=bool(cand.seq_fused))
 
-        def run(x, key):
-            return sw.smoothgrad(x, y, key, n_samples=n_samples,
-                                 stdev_spread=0.25,
-                                 sample_chunk=cand.sample_chunk)
+        if cand.anytime_stride is not None:
+            def run(x, key):
+                out, _ = sw.smoothgrad_checkpointed(
+                    x, y, key, n_samples=n_samples, stdev_spread=0.25,
+                    stride=cand.anytime_stride)
+                return out
+        else:
+            def run(x, key):
+                return sw.smoothgrad(x, y, key, n_samples=n_samples,
+                                     stdev_spread=0.25,
+                                     sample_chunk=cand.sample_chunk)
 
         return run, (x, key)
 
@@ -355,10 +368,17 @@ def _wamseq2d_workload(n_samples: int = 4, batch: int = 2,
         sw = SeqShardedWam(mesh, model, ndim=2, wavelet="db2", level=2,
                            mode="reflect", fused=bool(cand.seq_fused))
 
-        def run(x, key):
-            return sw.smoothgrad(x, y, key, n_samples=n_samples,
-                                 stdev_spread=0.25,
-                                 sample_chunk=cand.sample_chunk)
+        if cand.anytime_stride is not None:
+            def run(x, key):
+                out, _ = sw.smoothgrad_checkpointed(
+                    x, y, key, n_samples=n_samples, stdev_spread=0.25,
+                    stride=cand.anytime_stride)
+                return out
+        else:
+            def run(x, key):
+                return sw.smoothgrad(x, y, key, n_samples=n_samples,
+                                     stdev_spread=0.25,
+                                     sample_chunk=cand.sample_chunk)
 
         return run, (x, key)
 

@@ -469,3 +469,57 @@ class WaveletAttribution2D(BaseWAM2D):
         return jit_entry(impl, donate=donate, on_trace=on_trace,
                          aot_key=_synth_tagged(aot_key),
                          with_health=with_health)
+
+    def anytime_serve_entry(self, stride: int | str = "auto", on_trace=None,
+                            plateau_tol: float | None = None):
+        """Checkpointed serving entry for ANYTIME serving
+        (`wam_tpu.anytime`, DESIGN.md "Anytime attribution"): the same
+        SmoothGrad mosaic as `serve_entry`, split into begin/step/finalize
+        jits so an `AttributionServer` over it can deliver best-so-far
+        mosaics at a deadline and exit early on convergence. The noise
+        stream is the STREAMING smooth path's (the instance seed folded per
+        sample index — `core.estimators.smoothgrad(materialize_noise=
+        False)`), so against a streaming plain entry the full-n anytime
+        result agrees up to sample-accumulation order (sequential sum vs
+        stacked mean). ``stride`` is the checkpoint cadence k
+        ("auto" consults the tuned ``anytime_stride`` schedule axis).
+        SmoothGrad only: IG's fixed-α trapezoid weights are not a running
+        mean over an exchangeable sample stream, and ``mesh=`` is rejected
+        like `serve_entry`."""
+        if self.mesh is not None:
+            raise ValueError(
+                "anytime_serve_entry() does not support mesh=; the serve "
+                "worker owns a single device — drive "
+                "SeqShardedWam.smoothgrad_checkpointed directly")
+        if self.method != "smooth":
+            raise ValueError(
+                "anytime_serve_entry() needs method='smooth': IG's trapezoid "
+                "path weights are not an exchangeable sample mean")
+        from wam_tpu.anytime.entry import DEFAULT_PLATEAU_TOL, make_anytime_entry
+        from wam_tpu.core.estimators import (
+            noise_sigma, resolve_checkpoint_stride)
+
+        key = jax.random.PRNGKey(self.random_seed)
+
+        def sample_fn(x, y, i):
+            self._apply_tuned_synth(x.shape)
+            xi = self._to_internal(x)
+            sigma = noise_sigma(xi, self.stdev_spread)
+            k = jax.random.fold_in(key, i)
+            noise = jax.random.normal(k, xi.shape, xi.dtype)
+            noisy = xi + sigma.reshape((-1,) + (1,) * (xi.ndim - 1)) * noise
+            if self.dwt_bf16:
+                noisy = noisy.astype(jnp.bfloat16)
+            _, grads = self.engine.attribute(noisy, y)
+            return mosaic2d(grads, self.normalize_coeffs, self._caxis)
+
+        return make_anytime_entry(
+            sample_fn,
+            n_total=self.n_samples,
+            stride=resolve_checkpoint_stride(
+                stride, self.n_samples, workload="wam2d",
+                dtype="bf16" if self.dwt_bf16 else "f32"),
+            plateau_tol=(plateau_tol if plateau_tol is not None
+                         else DEFAULT_PLATEAU_TOL),
+            on_trace=on_trace,
+            name="wam2d_anytime")
