@@ -63,6 +63,12 @@ SYNTH = "--synth" in sys.argv
 # One JSON row per impl; headline device-plane when the profiler yields one,
 # wall otherwise (CPU rows are honest wall-only).
 AUDIO = "--audio" in sys.argv
+# --precision: round-17 low-precision A/B — f32 vs bf16 eval fan (insertion/
+# deletion AUC delta + Spearman rank correlation of the per-image scores)
+# and f32 vs bf16 mel chain (throughput, max |Δ dB|, WAM-1D attribution
+# cosine). One JSON row per comparison on stdout plus the machine-readable
+# bundle at results/precision_r17.json. CPU rows are honest wall-plane.
+PRECISION = "--precision" in sys.argv
 
 
 def _h2d_report(run, key, batch: int, image: int, platform: str) -> dict:
@@ -471,6 +477,191 @@ def audio_mode():
         )
 
 
+def precision_mode():
+    """--precision: the round-17 low-precision A/B (fidelity-gated bf16).
+
+    Four comparisons, each emitted as one stdout JSON row and collected
+    into ``results/precision_r17.json``:
+
+    - mel throughput: jitted `melspectrogram(impl="matmul")` f32 vs bf16
+      (bf16 DFT/filterbank inputs, f32 accumulation) at audio geometry,
+      with max |Δ dB| between the outputs;
+    - mel attribution fidelity: WAM-1D single-pass mel gradients through
+      the full differentiable front, f32 vs bf16 chain — cosine and
+      Spearman of the flattened attributions (the knob's gate);
+    - fan insertion / fan deletion: `Eval2DWAM(precision="bf16")` vs f32
+      on a fixed toy model + mosaics — per-image AUC deltas, Spearman
+      rank correlation of the score vectors, and fan throughput.
+
+    Honest planes: on CPU every throughput is wall-clock
+    (``value_plane="wall"``) and the fan's bf16 is the boundary-cast shim
+    over f32 params (``params_dtype`` says so) — the MXU speedup claim
+    stays TPU-pending (BASELINE.md round 17)."""
+    from wam_tpu.config import enable_compilation_cache, ensure_usable_backend
+
+    ensure_usable_backend(timeout_s=180.0)
+    enable_compilation_cache()
+
+    import jax
+    import jax.numpy as jnp
+
+    from wam_tpu.evalsuite.eval2d import Eval2DWAM
+    from wam_tpu.evalsuite.metrics import spearman
+    from wam_tpu.ops import melspec as ms
+    from wam_tpu.profiling import (bench_samples, device_time_samples,
+                                   median_iqr)
+    from wam_tpu.wam1d import BaseWAM1D
+
+    platform = jax.default_backend()
+    rows = []
+
+    def emit(row):
+        rows.append(row)
+        print(json.dumps(row), flush=True)
+
+    import numpy as np
+
+    def _cos(a, b):
+        a = np.asarray(jnp.ravel(a), dtype=np.float64)
+        b = np.asarray(jnp.ravel(b), dtype=np.float64)
+        denom = np.linalg.norm(a) * np.linalg.norm(b)
+        return float(np.dot(a, b) / max(denom, 1e-30))
+
+    def _bench(fn, *args):
+        out = jax.block_until_ready(fn(*args))
+        wall = bench_samples(fn, *args, k=5, warmup=0)
+        dev = device_time_samples(fn, *args, k=3, warmup=0)
+        wall_med = median_iqr(wall)[0]
+        dev_med = median_iqr(dev)[0] if dev else None
+        return out, wall_med, dev_med
+
+    # -- mel chain throughput + dB fidelity ---------------------------------
+    b, n = (2, 16384) if QUICK else (8, 220500)
+    wave = jax.random.normal(jax.random.PRNGKey(0), (b, n), jnp.float32)
+    mel_out = {}
+    for bf16 in (False, True):
+        step = jax.jit(lambda v, _bf=bf16: ms.melspectrogram(
+            v, impl="matmul", bf16=_bf))
+        out, wall_med, dev_med = _bench(step, wave)
+        mel_out[bf16] = out
+        headline = dev_med if dev_med is not None else wall_med
+        emit({
+            "metric": f"mel_chain_b{b}_len{n}_{'bf16' if bf16 else 'f32'}",
+            "value": round(b / headline, 3),
+            "value_plane": "device" if dev_med is not None else "wall",
+            "unit": "waveforms/s",
+            "wall_value": round(b / wall_med, 3),
+            "device_value": (round(b / dev_med, 3)
+                             if dev_med is not None else None),
+            "max_abs_db_vs_f32": (
+                float(jnp.max(jnp.abs(out - mel_out[False])))
+                if bf16 else 0.0),
+            "dtype": "bf16+f32acc" if bf16 else "f32",
+            "platform": platform,
+        })
+
+    # -- mel attribution fidelity (WAM-1D single pass) ----------------------
+    # reduced geometry always: the gradient pass is eager (one grad per
+    # call) and the gate is a fidelity number, not a throughput one
+    ab, an, n_mels = 2, 16384, 64
+    awave = jax.random.normal(jax.random.PRNGKey(1), (ab, an), jnp.float32)
+    ay = jnp.arange(ab, dtype=jnp.int32) % 4
+    head = jax.random.normal(jax.random.PRNGKey(2), (n_mels, 4), jnp.float32)
+    # nonlinear head: a linear one's ∂loss/∂mel is weight-only and the
+    # bf16/f32 gradients would be identical by construction
+    model_fn = (  # noqa: E731
+        lambda mel: jnp.tanh(mel / 30.0).mean(axis=2)[:, 0, :] @ head)
+    wam = BaseWAM1D(model_fn, wavelet="haar", J=2, n_mels=n_mels)
+    ms.set_stft_impl("matmul")  # exercise the full bf16 DFT+filterbank chain
+    prev_mel = ms.get_mel_bf16()
+    try:
+        attr = {}
+        for bf16 in (False, True):
+            ms.set_mel_bf16(bf16)
+            g_mel, _ = wam(awave, ay)
+            attr[bf16] = g_mel
+    finally:
+        ms.set_mel_bf16(prev_mel)
+        ms.set_stft_impl("auto")
+    emit({
+        "metric": f"mel_wam1d_attr_fidelity_b{ab}_len{an}",
+        "attribution_cosine": round(_cos(attr[True], attr[False]), 6),
+        "rank_correlation": round(float(spearman(
+            jnp.ravel(attr[True]), jnp.ravel(attr[False]))), 6),
+        "dtype": "bf16+f32acc vs f32",
+        "platform": platform,
+    })
+
+    # -- eval fan A/B (insertion / deletion AUC) ----------------------------
+    import flax.linen as nn
+
+    class _TinyImg(nn.Module):
+        @nn.compact
+        def __call__(self, x):  # (B, 3, H, W)
+            x = jnp.transpose(x, (0, 2, 3, 1))
+            x = nn.relu(nn.Conv(8, (3, 3), strides=(2, 2))(x)).mean(axis=(1, 2))
+            return nn.Dense(5)(x)
+
+    n_images, image, n_iter = (2, 32, 16) if QUICK else (8, 32, 64)
+    tiny = _TinyImg()
+    params32 = tiny.init(jax.random.PRNGKey(0),
+                         jnp.zeros((1, 3, image, image)))
+    params16 = jax.tree_util.tree_map(
+        lambda a: a.astype(jnp.bfloat16)
+        if jnp.issubdtype(a.dtype, jnp.floating) else a, params32)
+
+    def bind(dtype):
+        # bf16 binds the params at bf16 (the bind_inference policy); the
+        # evaluator's precision shim casts the fan inputs at the jit
+        # boundary and the logits back to f32 before every reduction
+        p = params32 if dtype == "f32" else params16
+        return lambda x: tiny.apply(p, x)
+
+    rngx = jax.random.normal(jax.random.PRNGKey(3),
+                             (n_images, 3, image, image), jnp.float32)
+    y = [i % 5 for i in range(n_images)]  # 5-class head
+    wams = jax.random.uniform(jax.random.PRNGKey(4),
+                              (n_images, image, image))
+    scores = {}
+    for dtype in ("f32", "bf16"):
+        ev = Eval2DWAM(bind(dtype), explainer=lambda xx, yy: wams,
+                       wavelet="haar", J=2, batch_size=128,
+                       precision=None if dtype == "f32" else dtype)
+        for mode in ("insertion", "deletion"):
+            s, _ = ev.evaluate_auc(rngx, y, mode, n_iter=n_iter)  # compile
+            t0 = time.perf_counter()
+            k = 3
+            for _ in range(k):
+                s, _ = ev.evaluate_auc(rngx, y, mode, n_iter=n_iter)
+            wall_med = (time.perf_counter() - t0) / k
+            scores[(dtype, mode)] = (jnp.asarray(s), wall_med)
+    for mode in ("insertion", "deletion"):
+        s32, w32 = scores[("f32", mode)]
+        s16, w16 = scores[("bf16", mode)]
+        emit({
+            "metric": f"fan_auc_{mode}_b{n_images}_n{n_iter}_bf16_vs_f32",
+            "value": round(n_images / w16, 3),
+            "f32_value": round(n_images / w32, 3),
+            "value_plane": "wall",
+            "unit": "images/s",
+            "auc_delta_max": float(jnp.max(jnp.abs(s16 - s32))),
+            "auc_delta_mean": float(jnp.mean(jnp.abs(s16 - s32))),
+            "rank_correlation": round(float(spearman(s16, s32)), 6),
+            "attribution_cosine": round(_cos(s16, s32), 6),
+            "dtype": "bf16 fan (boundary cast, f32 reductions)",
+            "params_dtype": "bf16",
+            "platform": platform,
+        })
+
+    os.makedirs("results", exist_ok=True)
+    bundle = {"round": 17, "platform": platform,
+              "quick": QUICK, "rows": rows}
+    with open(os.path.join("results", "precision_r17.json"), "w") as f:
+        json.dump(bundle, f, indent=2)
+    print(f"# wrote results/precision_r17.json ({len(rows)} rows)",
+          file=sys.stderr)
+
+
 def spread_mode():
     """--spread [N]: run the bench in N FRESH processes (default 3) and
     report how tightly the headline agrees — the acceptance check that the
@@ -521,6 +712,8 @@ def spread_mode():
 if __name__ == "__main__":
     if "--spread" in sys.argv:
         spread_mode()
+    elif PRECISION:
+        precision_mode()
     elif AUDIO:
         audio_mode()
     else:

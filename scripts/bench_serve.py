@@ -266,9 +266,14 @@ def run_bench(cfg, args, n_fleet: int):
             # on the bench config alone — the toy model inits from a fixed
             # seed, so its closed-over params are process-stable (the
             # aot.py keying contract); cached_entry adds shape + backend.
+            from wam_tpu.config import precision_tag
             from wam_tpu.serve import OVERSIZE_ENTRY_ID, fleet_aot_key
 
-            base_key = f"bench_serve|toy2d|J2|n{n_samples}|mb{max_batch}"
+            # precision-tagged base key: a bf16-policy run must not reuse
+            # (or poison) the f32 export — tag is "f32" → no suffix
+            base_key = fleet_aot_key(
+                f"bench_serve|toy2d|J2|n{n_samples}|mb{max_batch}", None,
+                precision_tag())
 
             def entry_factory(rid, m, _wam=wam, _base=base_key):
                 key = (fleet_aot_key(_base, n_fleet)

@@ -183,6 +183,28 @@ def test_one_fetch_per_metric_call_eval2d(img_model_fn, count_device_get):
     assert fs.count == 1
 
 
+def test_one_fetch_per_metric_call_eval2d_bf16_fan(img_model_fn):
+    """Round 17: the bf16 fan keeps the single-fetch contract — the casting
+    shim lives inside the traced runner (`fan.cast_model_fn`), so precision
+    never adds a host round-trip."""
+    from wam_tpu.evalsuite.eval2d import Eval2DWAM
+
+    ev = Eval2DWAM(img_model_fn,
+                   explainer=lambda x, y: jnp.ones(x.shape[:1] + x.shape[-2:]),
+                   wavelet="haar", J=2, batch_size=16, precision="bf16")
+    assert ev._fan_plan(9).fan_dtype == "bf16"
+    x = jnp.asarray(np.random.default_rng(0).standard_normal((2, 3, 32, 32)),
+                    dtype=jnp.float32)
+    y = [1, 3]
+    ev.precompute(x, np.asarray(y))
+    with fan.fetch_scope() as fs:
+        ev.insertion(x, y, n_iter=8)
+    assert fs.count == 1
+    with fan.fetch_scope() as fs:
+        ev.mu_fidelity(x, y, grid_size=8, sample_size=6, subset_size=12)
+    assert fs.count == 1
+
+
 def test_one_fetch_per_metric_call_baselines():
     from wam_tpu.evalsuite.eval_baselines import EvalImageBaselines
 

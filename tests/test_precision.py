@@ -1,0 +1,449 @@
+"""Round-17 precision policy: resolution precedence, key separation, and
+the bf16 fidelity gates.
+
+Every bf16-gated metric is tolerance-tested against its f32 oracle at CPU
+test geometry (thresholds carry ~10-20x margin over the measured deltas,
+recorded inline):
+
+- insertion/deletion AUC through the bf16 fan (measured max delta ~9e-4),
+- μ-fidelity through the bf16 fan (measured max delta ~0.031 at
+  sample_size 24 — μ is a coarse Spearman, single rank flips are quantized),
+- the eval1d mel-bf16 AUC path (measured ~9e-5),
+- WAM-1D mel-chain attribution cosine (measured 1.0 to 6 decimals).
+
+Plus the policy plumbing: `resolve_precision` precedence (explicit > env >
+tuned-schedule > f32), `plan_fan`'s fan_dtype axis, runner/AOT/result-cache
+key separation, `fleet_aot_key` precision tagging, the autotuner Candidate
+axes, and the mel1d workload preset.
+"""
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from wam_tpu.config import (
+    FAN_DTYPES,
+    PrecisionPolicy,
+    compute_cast,
+    fp8_supported,
+    precision_tag,
+    resolve_precision,
+)
+from wam_tpu.evalsuite.fan import FanPlan, cast_model_fn, plan_fan
+from wam_tpu.tune import invalidate_process_cache, record_schedule
+
+
+@pytest.fixture
+def sched_cache(tmp_path, monkeypatch):
+    """Isolated user-layer schedule cache (the test_tune fixture)."""
+    path = tmp_path / "schedules.json"
+    monkeypatch.setenv("WAM_TPU_SCHEDULE_CACHE", str(path))
+    monkeypatch.delenv("WAM_TPU_NO_SCHEDULE_CACHE", raising=False)
+    invalidate_process_cache()
+    yield path
+    invalidate_process_cache()
+
+
+@pytest.fixture
+def clean_env(monkeypatch):
+    monkeypatch.delenv("WAM_TPU_FAN_DTYPE", raising=False)
+    monkeypatch.delenv("WAM_TPU_MEL_BF16", raising=False)
+
+
+class TinyImg(nn.Module):
+    classes: int = 5
+
+    @nn.compact
+    def __call__(self, x):  # (B, 3, H, W)
+        x = jnp.transpose(x, (0, 2, 3, 1))
+        x = nn.relu(nn.Conv(8, (3, 3), strides=(2, 2))(x)).mean(axis=(1, 2))
+        return nn.Dense(self.classes)(x)
+
+
+@pytest.fixture(scope="module")
+def tiny_img():
+    model = TinyImg()
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 3, 32, 32)))
+    bf16 = jax.tree_util.tree_map(
+        lambda a: a.astype(jnp.bfloat16)
+        if jnp.issubdtype(a.dtype, jnp.floating) else a, params)
+    return (lambda x: model.apply(params, x),
+            lambda x: model.apply(bf16, x))
+
+
+# -- policy object -----------------------------------------------------------
+
+
+def test_precision_policy_validates_fan_dtype():
+    for d in FAN_DTYPES:
+        assert PrecisionPolicy(fan_dtype=d).fan_dtype == d
+    with pytest.raises(ValueError):
+        PrecisionPolicy(fan_dtype="fp16")
+
+
+def test_precision_policy_compute_dtype_and_tag():
+    assert PrecisionPolicy().compute_dtype() is None
+    assert PrecisionPolicy(fan_dtype="bf16").compute_dtype() == jnp.bfloat16
+    # fp8 resolves to the fp8 storage type where the backend compiles it,
+    # bf16 otherwise — never None (the policy IS low precision)
+    fp8 = PrecisionPolicy(fan_dtype="fp8").compute_dtype()
+    assert fp8 in (jnp.float8_e4m3fn, jnp.bfloat16)
+    assert isinstance(fp8_supported(), bool)
+    assert PrecisionPolicy().tag() == "f32"
+    assert PrecisionPolicy(fan_dtype="bf16").tag() == "bf16"
+    assert PrecisionPolicy(fan_dtype="bf16", mel_bf16=True).tag() == "bf16+mel"
+    assert PrecisionPolicy(mel_bf16=True).tag() == "f32+mel"
+
+
+def test_compute_cast_is_boundary_shim():
+    x = jnp.ones((3,), jnp.float32)
+    assert compute_cast(x, None) is x
+    assert compute_cast(x, jnp.bfloat16).dtype == jnp.bfloat16
+
+
+# -- resolution precedence ---------------------------------------------------
+
+
+def test_resolve_precision_defaults_f32(sched_cache, clean_env):
+    pol = resolve_precision("eval2d", (65,), 65)
+    assert pol == PrecisionPolicy()
+    assert precision_tag() == "f32"
+
+
+def test_resolve_precision_env_knobs(sched_cache, clean_env, monkeypatch):
+    monkeypatch.setenv("WAM_TPU_FAN_DTYPE", "bf16")
+    monkeypatch.setenv("WAM_TPU_MEL_BF16", "1")
+    pol = resolve_precision("eval2d", (65,), 65)
+    assert pol.fan_dtype == "bf16" and pol.mel_bf16
+    assert precision_tag() == "bf16+mel"
+    monkeypatch.setenv("WAM_TPU_MEL_BF16", "0")  # falsy spellings
+    assert not resolve_precision().mel_bf16
+
+
+def test_resolve_precision_rejects_bad_env(clean_env, monkeypatch):
+    monkeypatch.setenv("WAM_TPU_FAN_DTYPE", "fp16")
+    with pytest.raises(ValueError):
+        resolve_precision()
+
+
+def test_resolve_precision_tuned_entry(sched_cache, clean_env):
+    record_schedule("eval2d", (65,), 65, {"fan_dtype": "bf16",
+                                          "mel_bf16": True})
+    pol = resolve_precision("eval2d", (65,), 65)
+    assert pol.fan_dtype == "bf16" and pol.mel_bf16
+    # a different workload/geometry does not inherit the entry
+    assert resolve_precision("eval1d", (65,), 65) == PrecisionPolicy()
+
+
+def test_resolve_precision_explicit_beats_env_and_tuned(
+        sched_cache, clean_env, monkeypatch):
+    record_schedule("eval2d", (65,), 65, {"fan_dtype": "bf16"})
+    monkeypatch.setenv("WAM_TPU_FAN_DTYPE", "bf16")
+    pol = resolve_precision("eval2d", (65,), 65, fan_dtype="f32")
+    assert pol.fan_dtype == "f32"
+
+
+# -- plan_fan fan_dtype axis -------------------------------------------------
+
+
+def test_plan_fan_dtype_default_keeps_old_equality(sched_cache, clean_env):
+    # pre-round-17 FanPlan literals still compare equal (fan_dtype="f32")
+    assert plan_fan(256, 65) == FanPlan(256, 3, None)
+    assert plan_fan(256, 65).fan_dtype == "f32"
+
+
+def test_plan_fan_dtype_explicit_env_and_tuned(sched_cache, clean_env,
+                                               monkeypatch):
+    assert plan_fan(256, 65, fan_dtype="bf16") == FanPlan(256, 3, None, "bf16")
+    monkeypatch.setenv("WAM_TPU_FAN_DTYPE", "bf16")
+    assert plan_fan(256, 65).fan_dtype == "bf16"  # env applies at any cap
+    monkeypatch.delenv("WAM_TPU_FAN_DTYPE")
+    # tuned fan_dtype only under "auto" (fan_cap semantics)
+    record_schedule("eval2d", (65,), 65, {"fan_cap": 128, "fan_dtype": "bf16"})
+    assert plan_fan("auto", 65) == FanPlan(128, 1, None, "bf16")
+    assert plan_fan(256, 65).fan_dtype == "f32"
+
+
+def test_cast_model_fn_passthrough_and_cast(tiny_img):
+    f32_fn, _ = tiny_img
+    assert cast_model_fn(f32_fn, "f32") is f32_fn
+    seen = {}
+
+    def probe(x):
+        seen["dtype"] = x.dtype
+        return jnp.zeros((x.shape[0], 2), jnp.bfloat16)
+
+    out = cast_model_fn(probe, "bf16")(jnp.ones((2, 4), jnp.float32))
+    assert seen["dtype"] == jnp.bfloat16
+    assert out.dtype == jnp.float32  # logits back to f32 for reductions
+
+
+# -- bf16 fidelity gates vs the f32 oracle -----------------------------------
+
+
+def _eval2d(model_fn, wams, precision=None, batch_size=16):
+    from wam_tpu.evalsuite.eval2d import Eval2DWAM
+
+    return Eval2DWAM(model_fn, explainer=lambda xx, yy: wams,
+                     wavelet="haar", J=2, batch_size=batch_size,
+                     precision=precision)
+
+
+def test_fan_auc_bf16_tolerance(tiny_img, clean_env):
+    """Insertion/deletion AUC through the bf16 fan vs the f32 oracle.
+    Measured max delta at this geometry: ~9e-4 (gate 0.02); the score
+    RANKING must survive exactly (Spearman 1.0 at these gaps)."""
+    from wam_tpu.evalsuite.metrics import spearman
+
+    f32_fn, bf16_fn = tiny_img
+    rng = np.random.default_rng(3)
+    x = jnp.asarray(rng.standard_normal((3, 3, 32, 32)), dtype=jnp.float32)
+    y = [0, 1, 2]
+    wams = jnp.asarray(rng.standard_normal((3, 32, 32)), dtype=jnp.float32)
+    for mode in ("insertion", "deletion"):
+        ref, _ = _eval2d(f32_fn, wams).evaluate_auc(x, y, mode, n_iter=8)
+        low, _ = _eval2d(bf16_fn, wams, precision="bf16").evaluate_auc(
+            x, y, mode, n_iter=8)
+        ref, low = np.asarray(ref), np.asarray(low)
+        assert np.max(np.abs(low - ref)) < 0.02, mode
+        assert float(spearman(jnp.asarray(low), jnp.asarray(ref))) == 1.0
+
+
+def test_mu_fidelity_bf16_tolerance(tiny_img, clean_env):
+    """μ-fidelity through the bf16 fan. μ is a Spearman over subset draws —
+    quantized, so single rank flips move it in steps; measured max delta
+    0.031 at sample_size 24 (gate 0.1)."""
+    f32_fn, bf16_fn = tiny_img
+    rng = np.random.default_rng(3)
+    x = jnp.asarray(rng.standard_normal((3, 3, 32, 32)), dtype=jnp.float32)
+    y = [0, 1, 2]
+    wams = jnp.asarray(rng.standard_normal((3, 32, 32)), dtype=jnp.float32)
+    kw = dict(grid_size=8, sample_size=24, subset_size=48)
+    ref = np.asarray(_eval2d(f32_fn, wams, batch_size=32).mu_fidelity(
+        x, y, **kw))
+    low = np.asarray(_eval2d(bf16_fn, wams, precision="bf16",
+                             batch_size=32).mu_fidelity(x, y, **kw))
+    assert np.max(np.abs(low - ref)) < 0.1
+
+
+def test_eval1d_mel_bf16_auc_tolerance(clean_env):
+    """The eval1d AUC path under the bf16 mel chain vs the f32 oracle
+    (measured max delta ~9e-5; gate 0.02)."""
+    from wam_tpu.evalsuite.eval1d import Eval1DWAM
+    from wam_tpu.wam1d import normalize_waveforms
+
+    class TinyAudio(nn.Module):
+        @nn.compact
+        def __call__(self, x):  # (B, 1, T, M)
+            return nn.Dense(4)(x.reshape((x.shape[0], -1)))
+
+    rng = np.random.default_rng(2)
+    x = jnp.asarray(rng.standard_normal((2, 2048)), dtype=jnp.float32)
+    y = [0, 1]
+    scores = {}
+    for bf in (False, True):
+        ev = Eval1DWAM(lambda m: None, explainer=None, n_fft=256, n_mels=16,
+                       precision=PrecisionPolicy(mel_bf16=bf))
+        mel = ev._melspec(normalize_waveforms(x))
+        model = TinyAudio()
+        variables = model.init(jax.random.PRNGKey(0), mel)
+        ev.model_fn = lambda m: model.apply(variables, m)
+        ev.explainer = lambda xx, yy: (jnp.ones(mel[:, 0].shape), [])
+        scores[bf] = np.asarray(ev.insertion(x, y, target="melspec",
+                                             n_iter=8))
+    assert np.max(np.abs(scores[True] - scores[False])) < 0.02
+
+
+def test_mel_bf16_attribution_cosine_gate(clean_env):
+    """The ISSUE's gate for the mel knob: WAM-1D attribution cosine between
+    the bf16 mel chain and f32 ≥ 0.99 (measured 1.0 to 6 decimals; the
+    per-bin dB delta is NOT the gate — near-silent bins swing log10)."""
+    from wam_tpu.ops import melspec as ms
+    from wam_tpu.wam1d import BaseWAM1D
+
+    wave = jax.random.normal(jax.random.PRNGKey(1), (2, 4096), jnp.float32)
+    y = jnp.asarray([0, 1], jnp.int32)
+    head = jax.random.normal(jax.random.PRNGKey(2), (16, 4), jnp.float32)
+    # NONLINEAR head: with a linear model ∂loss/∂mel is a constant of the
+    # weights and the A/B would compare identical gradients by construction
+    wam = BaseWAM1D(lambda mel: jnp.tanh(mel / 30.0).mean(axis=2)[:, 0, :]
+                    @ head,
+                    wavelet="haar", J=2, n_mels=16, n_fft=256)
+    ms.set_stft_impl("matmul")  # the full bf16 DFT+filterbank chain
+    prev = ms.get_mel_bf16()
+    try:
+        attr = {}
+        for bf in (False, True):
+            ms.set_mel_bf16(bf)
+            attr[bf], _ = wam(wave, y)
+    finally:
+        ms.set_mel_bf16(prev)
+        ms.set_stft_impl("auto")
+    a = np.asarray(attr[True], np.float64).ravel()
+    b = np.asarray(attr[False], np.float64).ravel()
+    cos = float(a @ b / (np.linalg.norm(a) * np.linalg.norm(b)))
+    assert cos >= 0.99
+    # and the knob is not a no-op: the chains genuinely differ
+    assert np.any(a != b)
+
+
+def test_melspectrogram_per_call_override_beats_global(clean_env):
+    from wam_tpu.ops import melspec as ms
+
+    x = jax.random.normal(jax.random.PRNGKey(0), (1, 2048), jnp.float32)
+    kw = dict(n_fft=256, n_mels=16, impl="matmul")
+    base = ms.melspectrogram(x, **kw)
+    prev = ms.get_mel_bf16()
+    try:
+        ms.set_mel_bf16(True)
+        # per-call bf16=False overrides the global back to the f32 chain
+        np.testing.assert_array_equal(
+            np.asarray(ms.melspectrogram(x, bf16=False, **kw)),
+            np.asarray(base))
+        assert np.any(np.asarray(ms.melspectrogram(x, **kw))
+                      != np.asarray(base))
+    finally:
+        ms.set_mel_bf16(prev)
+
+
+# -- key separation ----------------------------------------------------------
+
+
+def test_run_cached_auc_key_separates_dtypes(tiny_img, clean_env):
+    """Two plans differing only in fan_dtype must build two runners (the
+    dtype is baked into the traced program)."""
+    from wam_tpu.evalsuite.metrics import generate_masks, run_cached_auc
+
+    f32_fn, _ = tiny_img
+    rng = np.random.default_rng(5)
+    x = jnp.asarray(rng.standard_normal((2, 3, 32, 32)), dtype=jnp.float32)
+    expl = jnp.asarray(rng.standard_normal((2, 32, 32)), dtype=jnp.float32)
+    y = np.array([0, 1])
+    n_iter = 4
+
+    def inputs_fn(x_s, e_s):
+        ins, _ = generate_masks(n_iter, e_s)
+        return x_s[None] * ins[:, None]
+
+    runners = {}
+    geom = (16, 1, None)
+    run_cached_auc(runners, "m", inputs_fn, f32_fn, FanPlan(*geom),
+                   n_iter, x, expl, y)
+    run_cached_auc(runners, "m", inputs_fn, f32_fn, FanPlan(*geom, "bf16"),
+                   n_iter, x, expl, y)
+    assert len(runners) == 2
+
+
+def test_result_cache_key_precision_flip(clean_env, monkeypatch):
+    from wam_tpu.serve.result_cache import result_cache_key
+
+    x = np.ones((3, 4, 4), np.float32)
+    base = result_cache_key(x, 1, "entry")
+    assert base.endswith("|f32")
+    monkeypatch.setenv("WAM_TPU_FAN_DTYPE", "bf16")
+    assert result_cache_key(x, 1, "entry") != base
+    monkeypatch.delenv("WAM_TPU_FAN_DTYPE")
+    monkeypatch.setenv("WAM_TPU_MEL_BF16", "1")
+    assert result_cache_key(x, 1, "entry") != base
+    monkeypatch.delenv("WAM_TPU_MEL_BF16")
+    assert result_cache_key(x, 1, "entry") == base  # live, per call
+
+
+def test_fleet_aot_key_precision_tagging():
+    from wam_tpu.serve import fleet_aot_key
+
+    # pre-round-17 forms unchanged (warm caches)
+    assert fleet_aot_key("m", 4) == "m|fleet4"
+    assert fleet_aot_key("m", None) == "m"
+    assert fleet_aot_key(None, 8, "bf16") is None
+    # default-precision spellings are suffix-free
+    assert fleet_aot_key("m", 4, "f32") == "m|fleet4"
+    assert fleet_aot_key("m", None, "") == "m"
+    # non-default policies tag after the fleet tag
+    assert fleet_aot_key("m", 4, "bf16") == "m|fleet4|bf16"
+    assert fleet_aot_key("m", 1, "bf16+mel") == "m|bf16+mel"
+
+
+def test_eval2d_precision_threads_into_fan_plan(tiny_img, clean_env):
+    f32_fn, _ = tiny_img
+    wams = jnp.ones((1, 32, 32))
+    assert _eval2d(f32_fn, wams)._fan_plan(6).fan_dtype == "f32"
+    assert _eval2d(f32_fn, wams,
+                   precision="bf16")._fan_plan(6).fan_dtype == "bf16"
+    pol = PrecisionPolicy(fan_dtype="bf16", mel_bf16=True)
+    assert _eval2d(f32_fn, wams, precision=pol)._fan_plan(6).fan_dtype == "bf16"
+
+
+# -- autotuner axes ----------------------------------------------------------
+
+
+def test_candidate_precision_axes_label_and_entry():
+    from wam_tpu.tune.autotuner import Candidate
+
+    cand = Candidate(fan_cap=256, fan_dtype="bf16", mel_bf16=True)
+    assert "dtype=bf16" in cand.label() and "mel=bf16" in cand.label()
+    entry = cand.entry()
+    assert entry["fan_dtype"] == "bf16" and entry["mel_bf16"] is True
+    # None fields stay out of the persisted entry
+    assert "fan_dtype" not in Candidate(fan_cap=256).entry()
+    assert "mel_bf16" not in Candidate(fan_cap=256).entry()
+    assert "mel=f32" in Candidate(mel_bf16=False).label()
+
+
+def test_explicit_plan_carries_candidate_dtype():
+    from wam_tpu.tune.autotuner import Candidate
+    from wam_tpu.tune.workloads import _explicit_plan
+
+    assert _explicit_plan(Candidate(fan_cap=64), 9).fan_dtype == "f32"
+    plan = _explicit_plan(Candidate(fan_cap=64, fan_dtype="bf16"), 9)
+    assert plan.fan_dtype == "bf16"
+
+
+def test_mel1d_workload_builds_and_runs(clean_env):
+    from wam_tpu.tune.workloads import get_workload
+
+    wl = get_workload("mel1d", batch=2, n=2048)
+    assert wl.workload == "mel1d"
+    labels = [c.label() for c in wl.candidates]
+    assert any("mel=bf16" in s for s in labels)
+    assert any("mel=f32" in s for s in labels)
+    outs = []
+    for cand in wl.candidates:
+        fn, args = wl.build(cand)
+        outs.append(np.asarray(jax.block_until_ready(fn(*args))))
+    assert outs[0].shape == outs[1].shape
+    assert np.any(outs[0] != outs[1])  # the knob reaches the chain
+
+
+# -- model casting shims -----------------------------------------------------
+
+
+def test_bind_vit_inference_policy_string(clean_env):
+    from wam_tpu.models.vit import bind_vit_inference, vit_tiny_test
+
+    model = vit_tiny_test(num_classes=3)
+    x = jnp.ones((1, 16, 16, 3), jnp.float32)
+    variables = model.init(jax.random.PRNGKey(0), x)
+    ref = bind_vit_inference(model, variables)(x)
+    low = bind_vit_inference(model, variables, compute_dtype="bf16")(x)
+    assert low.dtype == jnp.float32  # logits back in f32
+    assert np.allclose(np.asarray(low), np.asarray(ref), atol=0.1)
+
+
+def test_bind_audio_inference_policy_string(clean_env):
+    from wam_tpu.models.audio import bind_audio_inference
+
+    class TinyNet(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            return nn.Dense(2)(x.reshape((x.shape[0], -1)))
+
+    model = TinyNet()
+    x = jnp.ones((1, 1, 8, 4), jnp.float32)
+    variables = model.init(jax.random.PRNGKey(0), x)
+    ref = bind_audio_inference(model, variables)(x)
+    low = bind_audio_inference(model, variables, compute_dtype="bf16")(x)
+    assert low.dtype == jnp.float32
+    assert np.allclose(np.asarray(low), np.asarray(ref), atol=0.1)

@@ -22,11 +22,158 @@ __all__ = [
     "EvalConfig",
     "ServeConfig",
     "ObsConfig",
+    "PrecisionPolicy",
+    "resolve_precision",
+    "precision_tag",
+    "compute_cast",
+    "fp8_supported",
     "select_backend",
     "enable_compilation_cache",
     "add_config_args",
     "config_from_args",
 ]
+
+FAN_DTYPES = ("f32", "bf16", "fp8")
+
+
+def fp8_supported() -> bool:
+    """True when the active backend can actually run an fp8 matmul with f32
+    accumulation (not merely when jnp exposes the dtype — older backends
+    expose ``float8_e4m3fn`` as a storage type and fail at lowering). The
+    probe compiles a tiny dot once and caches the verdict for the process.
+    """
+    global _fp8_result
+    if _fp8_result is not None:
+        return _fp8_result
+    try:
+        import jax.numpy as jnp
+
+        if not hasattr(jnp, "float8_e4m3fn"):
+            _fp8_result = False
+            return False
+        a = jnp.ones((8, 8), jnp.float8_e4m3fn)
+        out = jnp.matmul(a, a, preferred_element_type=jnp.float32)
+        out.block_until_ready()
+        _fp8_result = bool(out.dtype == jnp.float32)
+    except Exception:
+        _fp8_result = False
+    return _fp8_result
+
+
+_fp8_result: bool | None = None
+
+
+@dataclass(frozen=True)
+class PrecisionPolicy:
+    """Low-precision policy for the eval fans and the mel chain.
+
+    ``fan_dtype`` is the compute dtype of the eval-fan model forwards
+    ("f32" | "bf16" | "fp8"); ``mel_bf16`` flips the mel front-end's two
+    DFT/filterbank contractions to bf16 inputs. Either way every
+    contraction stays f32-accumulated (``preferred_element_type``) and
+    every reduction downstream of the cast (softmax, AUC trapezoid,
+    Spearman) runs in f32 — the cast is a boundary shim, never a policy
+    on the math that ranks things. "fp8" degrades to bf16 when the
+    backend fails the `fp8_supported` probe, so a policy tuned on an
+    fp8-capable chip still runs (slower, more accurate) elsewhere.
+
+    Resolution (`resolve_precision`) is explicit-arg > env knob
+    (``WAM_TPU_FAN_DTYPE`` / ``WAM_TPU_MEL_BF16``) > tuned schedule entry
+    (fields written by the `tune.autotuner` `fan_dtype`/`mel_bf16`
+    Candidate axes) > f32 defaults.
+    """
+
+    fan_dtype: str = "f32"
+    mel_bf16: bool = False
+
+    def __post_init__(self):
+        if self.fan_dtype not in FAN_DTYPES:
+            raise ValueError(
+                f"fan_dtype must be one of {FAN_DTYPES}, got {self.fan_dtype!r}")
+
+    def compute_dtype(self):
+        """The jnp dtype the fan forward casts to, or None for pure f32.
+        The None return is what lets callers skip the shim entirely — an
+        f32 policy adds zero ops to the traced graph."""
+        if self.fan_dtype == "f32":
+            return None
+        import jax.numpy as jnp
+
+        if self.fan_dtype == "fp8" and fp8_supported():
+            return jnp.float8_e4m3fn
+        return jnp.bfloat16
+
+    def tag(self) -> str:
+        """Short stable tag for AOT / result-cache keys ("f32", "bf16",
+        "bf16+mel", ...). bf16 and f32 executables (and their cached
+        results) must never collide on a key."""
+        return self.fan_dtype + ("+mel" if self.mel_bf16 else "")
+
+
+def _validate_fan_dtype(value: str, source: str) -> str:
+    if value not in FAN_DTYPES:
+        raise ValueError(
+            f"{source} must be one of {FAN_DTYPES}, got {value!r}")
+    return value
+
+
+def resolve_precision(workload: str | None = None,
+                      shape: tuple | None = None,
+                      batch: int | None = None,
+                      *,
+                      fan_dtype: str | None = None,
+                      mel_bf16: bool | None = None) -> PrecisionPolicy:
+    """Resolve the precision policy for one workload.
+
+    Explicit args win; then the ``WAM_TPU_FAN_DTYPE`` / ``WAM_TPU_MEL_BF16``
+    env knobs (validated at read, like ``WAM_TPU_STFT_IMPL``); then — only
+    when a (workload, batch) key is given — the tuned schedule entry's
+    ``fan_dtype`` / ``mel_bf16`` fields; then f32. Pass ``workload=None``
+    to skip the tuned layer (the plan-fan convention for explicit caps:
+    an explicit geometry ignores tuned entries, env knobs still apply).
+    """
+    import os
+
+    ent = None
+    if workload is not None and batch is not None:
+        from wam_tpu.tune.cache import lookup_schedule
+
+        ent = lookup_schedule(workload, shape or (batch,), batch)
+    if fan_dtype is None:
+        env = os.environ.get("WAM_TPU_FAN_DTYPE", "")
+        if env:
+            fan_dtype = _validate_fan_dtype(env, "WAM_TPU_FAN_DTYPE")
+        elif ent and ent.get("fan_dtype"):
+            fan_dtype = _validate_fan_dtype(
+                str(ent["fan_dtype"]), "tuned fan_dtype")
+        else:
+            fan_dtype = "f32"
+    else:
+        fan_dtype = _validate_fan_dtype(fan_dtype, "fan_dtype")
+    if mel_bf16 is None:
+        env = os.environ.get("WAM_TPU_MEL_BF16", "")
+        if env:
+            mel_bf16 = env not in ("0", "false", "no")
+        elif ent is not None:
+            mel_bf16 = bool(ent.get("mel_bf16", False))
+        else:
+            mel_bf16 = False
+    return PrecisionPolicy(fan_dtype=fan_dtype, mel_bf16=bool(mel_bf16))
+
+
+def precision_tag() -> str:
+    """The live process-level precision tag (env knobs only) — folded into
+    serve result-cache keys so flipping a knob can never replay a stale
+    f32/bf16 result. Read per call, like WAM_TPU_NO_RESULT_CACHE."""
+    return resolve_precision().tag()
+
+
+def compute_cast(x, dtype):
+    """Cast an array to a policy compute dtype at a precision boundary;
+    ``dtype=None`` (the f32 policy) is the identity. Named so the
+    `precision-flow` lint rule can treat its result as low-precision
+    tainted even though the dtype is a runtime value."""
+    return x if dtype is None else x.astype(dtype)
 
 
 _probe_result: bool | None = None

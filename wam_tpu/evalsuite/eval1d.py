@@ -23,7 +23,7 @@ from wam_tpu.evalsuite.metrics import (
     run_cached_auc,
 )
 from wam_tpu.evalsuite.packing import array_to_coeffs1d, coeffs_to_array1d
-from wam_tpu.ops.melspec import melspectrogram
+from wam_tpu.ops.melspec import get_mel_bf16, melspectrogram
 from wam_tpu.wam1d import normalize_waveforms
 from wam_tpu.wavelets import wavedec, waverec
 
@@ -49,6 +49,7 @@ class Eval1DWAM:
         data_axis: str = "data",
         donate_inputs: bool | None = None,
         aot_key: str | None = None,
+        precision=None,
     ):
         """Constructor args are frozen config (the reference's
         constructor-kwargs surface, SURVEY.md §5.6) — build a new evaluator
@@ -58,7 +59,12 @@ class Eval1DWAM:
         tuned schedule cache (`wam_tpu.tune.resolve_fan_cap`, workload
         "eval1d"), falling back to 128 — the same auto plumbing eval2d and
         the baseline evaluators grew in round 6. ``donate_inputs`` /
-        ``aot_key``: see `Eval2DWAM` (same policy and caveats)."""
+        ``aot_key``: see `Eval2DWAM` (same policy and caveats).
+        ``precision``: a `config.PrecisionPolicy`, a ``fan_dtype`` string
+        ("bf16"/"fp8"), or None — None resolves fan_dtype per metric fan
+        (env knob / tuned entry via `plan_fan`) and mel_bf16 once here
+        (env knob / melspec global). The mel flag is frozen at
+        construction like every other constructor arg."""
         self.model_fn = model_fn
         self.explainer = explainer
         self.wavelet = wavelet
@@ -72,6 +78,14 @@ class Eval1DWAM:
         self.data_axis = data_axis
         self.donate_inputs = donate_inputs
         self.aot_key = aot_key
+        from wam_tpu.config import PrecisionPolicy
+
+        if isinstance(precision, str):
+            precision = PrecisionPolicy(fan_dtype=precision)
+        self._fan_dtype = precision.fan_dtype if precision is not None else None
+        # None defers to the melspec-global default (set_mel_bf16 /
+        # WAM_TPU_MEL_BF16) at trace time
+        self._mel_bf16 = precision.mel_bf16 if precision is not None else None
         self._auc_runners: dict = {}
         self.grad_wams = None
         self._expl_key = None
@@ -101,14 +115,16 @@ class Eval1DWAM:
         """Explicit int ``batch_size`` pins the memory cap; "auto" consults
         the tuned schedule cache keyed by this metric's fan (workload
         "eval1d": fan_cap + fan_chunk override)."""
-        return plan_fan(self.batch_size, fan, workload="eval1d")
+        return plan_fan(self.batch_size, fan, workload="eval1d",
+                        fan_dtype=self._fan_dtype)
 
     def _fan_cap(self, fan: int) -> int:
         return self._fan_plan(fan).cap
 
     def _melspec(self, wave: jax.Array) -> jax.Array:
         mel = melspectrogram(
-            wave, sample_rate=self.sample_rate, n_fft=self.n_fft, n_mels=self.n_mels
+            wave, sample_rate=self.sample_rate, n_fft=self.n_fft,
+            n_mels=self.n_mels, bf16=self._mel_bf16,
         )
         return mel[:, None, :, :]  # (B, 1, T, M)
 
@@ -168,9 +184,14 @@ class Eval1DWAM:
         # the argmax (input-fidelity) variant returns raw logit rows. With a
         # mesh, the sample axis is sharded inside the same runner — no
         # per-sample host loop in any configuration (r4 verdict #4).
+        # the mel flag is part of the traced program, so it must be part of
+        # the runner-cache key (and through it the AOT key): a bf16-mel
+        # runner must never serve an f32-mel call
+        mel_bf16 = (self._mel_bf16 if self._mel_bf16 is not None
+                    else get_mel_bf16())
         return run_cached_auc(
             self._auc_runners,
-            (mode, target),
+            (mode, target, mel_bf16),
             inputs_fn,
             self.model_fn,
             self._fan_plan(n_iter + 1),

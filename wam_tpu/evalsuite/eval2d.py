@@ -24,6 +24,7 @@ import numpy as np
 
 from wam_tpu.evalsuite.fan import (
     FanPlan,
+    cast_model_fn,
     fan_runner,
     make_chunked_forward,
     plan_fan,
@@ -90,6 +91,7 @@ class Eval2DWAM:
         data_axis: str = "data",
         donate_inputs: bool | None = None,
         aot_key: str | None = None,
+        precision=None,
     ):
         """Constructor args are frozen config (the reference's
         constructor-kwargs surface, SURVEY.md §5.6) — build a new evaluator
@@ -109,7 +111,15 @@ class Eval2DWAM:
         .donation_safe` copies. ``aot_key`` opts the single-device metric
         runners into the AOT executable cache (`wam_tpu.pipeline.aot`) —
         it must uniquely identify model + params; both are ignored on the
-        mesh path."""
+        mesh path.
+
+        ``precision``: a `config.PrecisionPolicy`, a ``fan_dtype`` string
+        ("bf16"/"fp8"), or None — None resolves the fan compute dtype per
+        metric fan (``WAM_TPU_FAN_DTYPE`` env knob / tuned ``fan_dtype``
+        schedule axis via `plan_fan`). The shim casts fan inputs at the
+        jit boundary and logits back to f32 before every reduction; bind
+        the model's params at the matching dtype
+        (`models.bind_inference(compute_dtype=...)`) for the MXU win."""
         self.model_fn = model_fn
         self.explainer = explainer
         self.wavelet = wavelet
@@ -123,6 +133,11 @@ class Eval2DWAM:
         self.data_axis = data_axis
         self.donate_inputs = donate_inputs
         self.aot_key = aot_key
+        from wam_tpu.config import PrecisionPolicy
+
+        if isinstance(precision, str):
+            precision = PrecisionPolicy(fan_dtype=precision)
+        self._fan_dtype = precision.fan_dtype if precision is not None else None
         self._auc_runners: dict = {}
         self._mu_runners: dict = {}
         self._mu_draw_cache: dict = {}
@@ -160,7 +175,7 @@ class Eval2DWAM:
         memory cap (law-derived chunks); "auto" consults the tuned schedule
         cache (round-6 ``fan_cap`` + this round's ``fan_chunk`` override)
         keyed by this metric's fan."""
-        return plan_fan(self.batch_size, fan)
+        return plan_fan(self.batch_size, fan, fan_dtype=self._fan_dtype)
 
     def _fan_cap(self, fan: int) -> int:
         return self._fan_plan(fan).cap
@@ -268,7 +283,12 @@ class Eval2DWAM:
         if plan is None:
             plan = self._fan_plan(sample_size)
         images_per_chunk = plan.images_per_chunk
-        forward = make_chunked_forward(self.model_fn, plan.fan_chunk)
+        # logits come back f32 from the shim, so the Spearman/softmax
+        # reductions below stay f32 whatever the fan compute dtype
+        forward = cast_model_fn(
+            make_chunked_forward(self.model_fn, plan.fan_chunk),
+            plan.fan_dtype)
+        base_fn = cast_model_fn(self.model_fn, plan.fan_dtype)
 
         def forward_probs(inputs, label):
             return jnp.take(softmax_probs(forward(inputs)), label, axis=1)
@@ -282,7 +302,7 @@ class Eval2DWAM:
 
         def run(xb, wamsb, yb, randb, onehotb):
             base_probs = jnp.take_along_axis(
-                softmax_probs(self.model_fn(xb)), yb[:, None], axis=1
+                softmax_probs(base_fn(xb)), yb[:, None], axis=1
             )[:, 0]
 
             def one(args):
@@ -311,8 +331,10 @@ class Eval2DWAM:
 
         aot_key = None
         if self.aot_key is not None:
+            # dtype-tagged so a bf16 μ executable can never collide with
+            # the f32 one under the same model key
             aot_key = (f"{self.aot_key}|mu|g{grid_size}|s{sample_size}"
-                       f"|c{images_per_chunk}")
+                       f"|c{images_per_chunk}|{plan.fan_dtype}")
         return fan_runner(run, mesh=self.mesh, data_axis=self.data_axis,
                           donate=self.donate_inputs, donate_argnums=(0,),
                           aot_key=aot_key)
@@ -342,7 +364,8 @@ class Eval2DWAM:
 
         plan = self._fan_plan(sample_size)
         key = (grid_size, sample_size, tuple(x.shape[1:]),
-               tuple(wams.shape[1:]), plan.images_per_chunk, plan.fan_chunk)
+               tuple(wams.shape[1:]), plan.images_per_chunk, plan.fan_chunk,
+               plan.fan_dtype)
         runner = self._mu_runners.get(key)
         if runner is None:
             runner = self._make_mu_runner(grid_size, sample_size, plan)

@@ -64,7 +64,7 @@ class _BaseEvalBaselines:
                  n_samples: int, stdev_spread: float, cam_layer: str, nchw: bool,
                  methods: tuple[str, ...], mesh=None, data_axis: str = "data",
                  compute_dtype=None, donate_inputs: bool | None = None,
-                 aot_key: str | None = None):
+                 aot_key: str | None = None, precision=None):
         if method == "srd":
             raise NotImplementedError(
                 "'srd' is excluded by design: the reference imports it from a "
@@ -82,11 +82,32 @@ class _BaseEvalBaselines:
                 "attention body never materializes them"
             )
         self.model = model
-        # compute_dtype (e.g. jnp.bfloat16): cast float params/stats ONCE so
-        # every path — the perturbation-fan model_fn AND the CAM/LRP routes
-        # that re-apply self.variables — runs at the same precision; inputs
-        # are cast at the model boundary, logits come back float32 (the
-        # bind_inference convention, models/resnet.py).
+        # compute_dtype (e.g. jnp.bfloat16, or the policy strings
+        # "bf16"/"fp8"): cast float params/stats ONCE so every path — the
+        # perturbation-fan model_fn AND the CAM/LRP routes that re-apply
+        # self.variables — runs at the same precision; inputs are cast at
+        # the model boundary, logits come back float32 (the bind_inference
+        # convention, models/resnet.py). ``precision`` (a
+        # `config.PrecisionPolicy` or fan_dtype string) is the policy form
+        # of the same knob: it supplies compute_dtype when none is given
+        # and tags the fan plans so runner/AOT keys separate dtypes.
+        from wam_tpu.config import PrecisionPolicy
+
+        if isinstance(precision, str):
+            precision = PrecisionPolicy(fan_dtype=precision)
+        if isinstance(compute_dtype, str):
+            compute_dtype = PrecisionPolicy(
+                fan_dtype=compute_dtype).compute_dtype()
+        if compute_dtype is None and precision is not None:
+            compute_dtype = precision.compute_dtype()
+        if precision is not None:
+            self._fan_dtype = precision.fan_dtype
+        elif compute_dtype is not None:
+            self._fan_dtype = {"bfloat16": "bf16", "float8_e4m3fn": "fp8",
+                               "float8_e5m2": "fp8"}.get(
+                                   jnp.dtype(compute_dtype).name)
+        else:
+            self._fan_dtype = None
         self.compute_dtype = compute_dtype
         if compute_dtype is not None:
             variables = jax.tree_util.tree_map(
@@ -193,8 +214,11 @@ class _BaseEvalBaselines:
     def _fan_plan(self, fan: int) -> FanPlan:
         """Perturbation-fan geometry: ``batch_size="auto"`` consults the
         tuned ``fan_cap`` + ``fan_chunk`` schedule (wam_tpu.tune), explicit
-        int caps derive chunks by the cap//fan law."""
-        return plan_fan(self.batch_size, fan)
+        int caps derive chunks by the cap//fan law. The plan's fan_dtype
+        (compute_dtype / precision, already baked into model_fn) rides
+        along so every runner/AOT key derived from a plan separates
+        precisions."""
+        return plan_fan(self.batch_size, fan, fan_dtype=self._fan_dtype)
 
     def _fan_cap(self, fan: int) -> int:
         return self._fan_plan(fan).cap
@@ -262,12 +286,14 @@ class EvalImageBaselines(_BaseEvalBaselines):
         compute_dtype=None,
         donate_inputs: bool | None = None,
         aot_key: str | None = None,
+        precision=None,
     ):
         super().__init__(model, variables, method, batch_size, random_seed,
                          n_samples, stdev_spread, cam_layer, nchw=nchw,
                          methods=IMAGE_METHODS, mesh=mesh, data_axis=data_axis,
                          compute_dtype=compute_dtype,
-                         donate_inputs=donate_inputs, aot_key=aot_key)
+                         donate_inputs=donate_inputs, aot_key=aot_key,
+                         precision=precision)
         self.denormalize_fn = denormalize_fn
         self.preprocess_fn = preprocess_fn
 
@@ -315,7 +341,7 @@ class EvalImageBaselines(_BaseEvalBaselines):
         aot_key = None
         if self.aot_key is not None:
             aot_key = (f"{self.aot_key}|mu|g{grid_size}|s{sample_size}"
-                       f"|c{images_per_chunk}")
+                       f"|c{images_per_chunk}|{plan.fan_dtype}")
         return fan_runner(run, mesh=self.mesh, data_axis=self.data_axis,
                           donate=self.donate_inputs, donate_argnums=(0,),
                           aot_key=aot_key)
@@ -338,7 +364,8 @@ class EvalImageBaselines(_BaseEvalBaselines):
 
         plan = self._fan_plan(sample_size)
         key = (grid_size, sample_size, tuple(x.shape[1:]),
-               tuple(expl.shape[1:]), plan.images_per_chunk, plan.fan_chunk)
+               tuple(expl.shape[1:]), plan.images_per_chunk, plan.fan_chunk,
+               plan.fan_dtype)
         runner = self._mu_runners.get(key)
         if runner is None:
             runner = self._make_mu_runner(grid_size, sample_size,
@@ -370,12 +397,14 @@ class EvalAudioBaselines(_BaseEvalBaselines):
         compute_dtype=None,
         donate_inputs: bool | None = None,
         aot_key: str | None = None,
+        precision=None,
     ):
         super().__init__(model, variables, method, batch_size, random_seed,
                          n_samples, stdev_spread, cam_layer, nchw=False,
                          methods=AUDIO_METHODS, mesh=mesh, data_axis=data_axis,
                          compute_dtype=compute_dtype,
-                         donate_inputs=donate_inputs, aot_key=aot_key)
+                         donate_inputs=donate_inputs, aot_key=aot_key,
+                         precision=precision)
 
     def _perturb(self, x_s, masks):
         # x_s: (1, T, M); masks: (n_iter+1, T, M) -> (n_iter+1, 1, T, M)

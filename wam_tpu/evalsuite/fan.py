@@ -42,6 +42,7 @@ __all__ = [
     "FanPlan",
     "plan_fan",
     "fan_chunk_geometry",
+    "cast_model_fn",
     "make_chunked_forward",
     "make_sharded_runner",
     "fan_runner",
@@ -135,11 +136,16 @@ class FanPlan:
     ``cap``: the memory cap in model rows (tuned ``fan_cap`` or the
     caller's explicit batch_size). ``images_per_chunk``: images per
     ``lax.map`` chunk of the fan step. ``fan_chunk``: inner per-sample
-    chunk when one sample's fan alone exceeds the cap (else None)."""
+    chunk when one sample's fan alone exceeds the cap (else None).
+    ``fan_dtype``: the fan forward's compute dtype ("f32"/"bf16"/"fp8" —
+    `config.PrecisionPolicy`); part of the plan because it is part of the
+    traced program — every runner cache / AOT key derived from a plan must
+    separate dtypes or a schedule flip replays the wrong executable."""
 
     cap: int
     images_per_chunk: int
     fan_chunk: int | None
+    fan_dtype: str = "f32"
 
 
 def fan_chunk_geometry(batch_size: int, fan: int) -> tuple[int, int | None]:
@@ -153,7 +159,8 @@ def fan_chunk_geometry(batch_size: int, fan: int) -> tuple[int, int | None]:
 
 
 def plan_fan(batch_size, fan: int, *, workload: str = "eval2d",
-             shape=None, default: int = 128) -> FanPlan:
+             shape=None, default: int = 128,
+             fan_dtype: str | None = None) -> FanPlan:
     """Tuned fan geometry for one metric call.
 
     Explicit int ``batch_size`` pins the cap (the caller's memory budget —
@@ -163,7 +170,13 @@ def plan_fan(batch_size, fan: int, *, workload: str = "eval2d",
     tuned ``fan_chunk`` entry that overrides images_per_chunk directly
     (the autotuner's `Candidate.fan_chunk` sweep axis: at a fixed cap the
     law picks one images-per-chunk, but the best lax.map chunk on real
-    hardware need not equal cap//fan)."""
+    hardware need not equal cap//fan).
+
+    ``fan_dtype`` pins the fan compute dtype; None resolves it the policy
+    way (`config.resolve_precision`): ``WAM_TPU_FAN_DTYPE`` env knob, then
+    — under ``"auto"`` geometry only, like the cap — the tuned entry's
+    ``fan_dtype`` axis, then f32."""
+    from wam_tpu.config import resolve_precision
     from wam_tpu.tune import resolve_fan_cap
 
     cap = resolve_fan_cap(batch_size, fan, workload=workload, shape=shape,
@@ -177,7 +190,32 @@ def plan_fan(batch_size, fan: int, *, workload: str = "eval2d",
             images_per_chunk = max(1, int(ent["fan_chunk"]))
             if images_per_chunk > 1:
                 fan_chunk = None  # several whole images per chunk: no inner split
-    return FanPlan(cap, images_per_chunk, fan_chunk)
+    policy = resolve_precision(
+        workload if batch_size == "auto" else None,
+        shape or (fan,), fan, fan_dtype=fan_dtype)
+    return FanPlan(cap, images_per_chunk, fan_chunk, policy.fan_dtype)
+
+
+def cast_model_fn(model_fn, fan_dtype: str):
+    """Precision boundary shim for the fan forward: inputs cast to the
+    policy compute dtype ONCE at the jit boundary, logits cast back to f32
+    so every reduction downstream (softmax, AUC trapezoid, Spearman)
+    accumulates in f32. "f32" returns ``model_fn`` unchanged — zero traced
+    ops. Pair with params bound at the same dtype
+    (`models.bind_inference(compute_dtype=...)` /
+    `EvalBaselines(compute_dtype=...)`) for the MXU win; against f32
+    params the cast is promoted away by XLA — safe, just not faster."""
+    from wam_tpu.config import PrecisionPolicy, compute_cast
+
+    dtype = PrecisionPolicy(fan_dtype=fan_dtype).compute_dtype()
+    if dtype is None:
+        return model_fn
+
+    def cast_fn(x):
+        low = compute_cast(x, dtype)
+        return model_fn(low).astype(jnp.float32)
+
+    return cast_fn
 
 
 def make_chunked_forward(model_fn, fan_chunk: int | None):

@@ -14,6 +14,7 @@ import jax.numpy as jnp
 
 from wam_tpu.evalsuite.fan import (  # noqa: F401  (re-exported: pre-fan import sites)
     FanPlan,
+    cast_model_fn,
     fan_chunk_geometry,
     fan_runner,
     make_chunked_forward,
@@ -185,6 +186,7 @@ def batched_auc_runner(
     data_axis: str = "data",
     donate: bool | None = None,
     aot_key: str | None = None,
+    fan_dtype: str = "f32",
 ):
     """One-jit-dispatch insertion/deletion evaluation across an image batch.
 
@@ -225,9 +227,16 @@ def batched_auc_runner(
     opts the single-device runner into the AOT executable cache; both are
     ignored on the mesh path (shard_map programs neither donate cleanly
     nor export on the pinned jax).
+
+    ``fan_dtype`` ("f32"/"bf16"/"fp8") wraps the chunked forward in the
+    precision boundary shim (`fan.cast_model_fn`): the whole perturbation
+    fan casts to the compute dtype once per chunk and the stacked logits
+    cast back to f32 BEFORE softmax/AUC, so the rank-forming reductions
+    never run low-precision.
     """
 
-    forward = make_chunked_forward(model_fn, fan_chunk)
+    forward = cast_model_fn(make_chunked_forward(model_fn, fan_chunk),
+                            fan_dtype)
 
     def body(xb, explb, yb):
         def one(args):
@@ -285,7 +294,7 @@ def run_cached_auc(
     else:
         plan = FanPlan(batch_size, *fan_chunk_geometry(batch_size, n_iter + 1))
     key = (n_iter, return_logits, tuple(x.shape[1:]), key_extra,
-           plan.images_per_chunk, plan.fan_chunk)
+           plan.images_per_chunk, plan.fan_chunk, plan.fan_dtype)
     runner = cache.get(key)
     if runner is None:
         if aot_key is not None:
@@ -299,6 +308,7 @@ def run_cached_auc(
         runner = batched_auc_runner(
             inputs_fn, model_fn, plan.images_per_chunk, return_logits,
             plan.fan_chunk, mesh, data_axis, donate, aot_key,
+            plan.fan_dtype,
         )
         cache[key] = runner
     # ONE device fetch for the whole call: round 4 batched the per-element

@@ -66,6 +66,14 @@ KNOB_DOCS = {
     "WAM_TPU_STFT_IMPL":
         "STFT backend override for the audio path "
         "(`auto`/`fft`/`matmul`)",
+    "WAM_TPU_FAN_DTYPE":
+        "eval-fan compute dtype override (`f32`/`bf16`/`fp8`): fan inputs "
+        "cast at the jit boundary, reductions stay f32; fp8 degrades to "
+        "bf16 off-backend",
+    "WAM_TPU_MEL_BF16":
+        "`1` runs the mel front-end's DFT/filterbank matmuls with bf16 "
+        "inputs and f32 accumulation (fidelity-gated; "
+        "`0`/`false`/`no` = f32)",
     "WAM_TPU_FUSED_RELU_IMPL":
         "fused-ReLU backend override (`auto`/`xla`/`pallas`)",
     "WAM_TPU_POD_AUTHKEY":

@@ -7,7 +7,9 @@ accumulates in bf16 on the MXU: ~8 bits of mantissa across a K-deep
 reduction, which is exactly the silent-quality-cliff the wavelet
 kernels guard against (see wavelets/nhwc.py). The rule taints names
 assigned from a bf16 cast (``x = x.astype(jnp.bfloat16)``,
-``dtype=jnp.bfloat16``), clears the taint on any other rebind, and
+``dtype=jnp.bfloat16``) or from the policy casting shim
+(``x = compute_cast(x, dtype)`` with a non-f32 dtype — round 17's
+boundary casts), clears the taint on any other rebind, and
 flags contraction calls fed a tainted name — or an inline bf16 cast —
 when the call has no ``preferred_element_type`` keyword. ``a @ b`` on
 a tainted name is flagged too (operator form can't request f32
@@ -54,7 +56,12 @@ def _is_f32_dtype(node: ast.AST) -> bool:
 
 def _cast_dtype(expr: ast.AST) -> str | None:
     """'bf16' / 'f32' / None for the *outermost* cast in an expression:
-    ``<x>.astype(<dtype>)`` or a call carrying ``dtype=<dtype>``."""
+    ``<x>.astype(<dtype>)``, a call carrying ``dtype=<dtype>``, or the
+    policy casting shim ``compute_cast(x, <policy dtype>)``
+    (`wam_tpu.config.compute_cast` — its dtype is usually a runtime
+    policy value that may resolve to bf16/fp8, so the shim is treated as
+    a low-precision taint source unless its dtype argument is statically
+    f32/None)."""
     if not isinstance(expr, ast.Call):
         return None
     dtype_nodes = []
@@ -62,6 +69,13 @@ def _cast_dtype(expr: ast.AST) -> str | None:
             and expr.args):
         dtype_nodes.append(expr.args[0])
     dtype_nodes.extend(kw.value for kw in expr.keywords if kw.arg == "dtype")
+    if tail_name(expr.func) == "compute_cast":
+        d = expr.args[1] if len(expr.args) > 1 else None
+        d = next((kw.value for kw in expr.keywords if kw.arg == "dtype"), d)
+        if d is None or _is_f32_dtype(d) or (
+                isinstance(d, ast.Constant) and d.value is None):
+            return "f32"
+        return "bf16"
     for d in dtype_nodes:
         if _is_bf16_dtype(d):
             return "bf16"

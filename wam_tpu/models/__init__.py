@@ -8,8 +8,10 @@ from wam_tpu.models.resnet import (
 )
 from wam_tpu.models.ingest import strip_module_prefix, torch_resnet_to_flax
 from wam_tpu.models.resnet3d import ResNet3D, resnet3d_10, resnet3d_18
+from wam_tpu.models.vit import bind_vit_inference
 
 __all__ = [
+    "bind_vit_inference",
     "ResNet",
     "resnet18",
     "resnet34",

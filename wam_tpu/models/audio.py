@@ -89,6 +89,12 @@ def bind_audio_inference(model: nn.Module, variables,
         from wam_tpu.models.resnet import _fold_bn_variables
 
         variables = _fold_bn_variables(variables)
+    if isinstance(compute_dtype, str):
+        # policy string form ("bf16"/"fp8") — same resolution as
+        # resnet.bind_inference / vit.bind_vit_inference
+        from wam_tpu.config import PrecisionPolicy
+
+        compute_dtype = PrecisionPolicy(fan_dtype=compute_dtype).compute_dtype()
     if compute_dtype is not None:
         variables = jax.tree_util.tree_map(
             lambda a: a.astype(compute_dtype)

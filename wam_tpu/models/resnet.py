@@ -252,7 +252,11 @@ def bind_inference(
     at the model boundary, and logits are cast back to float32. The wavelet
     transform outside the model stays float32. Attribution maps agree with
     the float32 path to high cosine similarity because SmoothGrad's noise
-    floor (σ = 0.25·range) dominates bf16 rounding.
+    floor (σ = 0.25·range) dominates bf16 rounding. The policy strings
+    "bf16"/"fp8" are accepted too and resolve through
+    `config.PrecisionPolicy` — "fp8" degrades to bf16 when the backend
+    fails the `config.fp8_supported` probe, so a tuned schedule carrying
+    fp8 still binds everywhere.
 
     fold_bn=True folds BatchNorm multiplies into conv kernels (see
     `_fold_bn_variables`) — same function, cheaper VJP.
@@ -275,6 +279,10 @@ def bind_inference(
         model = model.clone(act=fused_relu)
     if fold_bn:
         variables = _fold_bn_variables(variables)
+    if isinstance(compute_dtype, str):
+        from wam_tpu.config import PrecisionPolicy
+
+        compute_dtype = PrecisionPolicy(fan_dtype=compute_dtype).compute_dtype()
     if compute_dtype is not None:
         variables = jax.tree_util.tree_map(
             lambda a: a.astype(compute_dtype) if jnp.issubdtype(a.dtype, jnp.floating) else a,

@@ -24,7 +24,8 @@ import jax.numpy as jnp
 
 from wam_tpu.models.patchconv import PatchConv
 
-__all__ = ["ViT", "capturing_attention", "vit_b16", "vit_tiny_test"]
+__all__ = ["ViT", "bind_vit_inference", "capturing_attention", "vit_b16",
+           "vit_tiny_test"]
 
 
 def capturing_attention(query, key, value, dtype=None, precision=None,
@@ -128,3 +129,39 @@ class ViT(nn.Module):
 
 vit_b16 = partial(ViT, patch=16, dim=768, depth=12, heads=12, mlp_hidden=3072)
 vit_tiny_test = partial(ViT, patch=8, dim=64, depth=2, heads=4, mlp_hidden=128)
+
+
+def bind_vit_inference(model: ViT, variables, nchw: bool = False,
+                       compute_dtype=None):
+    """Bind ViT params into a pure ``x -> logits`` function — the
+    transformer twin of `models.resnet.bind_inference`'s casting shim.
+
+    compute_dtype (jnp dtype or the policy strings "bf16"/"fp8", resolved
+    through `config.PrecisionPolicy` — fp8 degrades to bf16 off-backend):
+    float params cast ONCE here, input cast at the model boundary, logits
+    back to f32, so attention softmax statistics and downstream metric
+    reductions see f32 logits. The init-time 'perturbations' collection
+    (the ViT's gradient taps) is dropped like the evaluators do — it is
+    an artifact of init, not a parameter."""
+    import jax
+
+    base = {k: v for k, v in variables.items() if k != "perturbations"}
+    if isinstance(compute_dtype, str):
+        from wam_tpu.config import PrecisionPolicy
+
+        compute_dtype = PrecisionPolicy(fan_dtype=compute_dtype).compute_dtype()
+    if compute_dtype is not None:
+        base = jax.tree_util.tree_map(
+            lambda a: a.astype(compute_dtype)
+            if jnp.issubdtype(a.dtype, jnp.floating) else a,
+            base,
+        )
+
+    def fn(x):
+        if nchw:
+            x = jnp.transpose(x, (0, 2, 3, 1))
+        if compute_dtype is not None:
+            return model.apply(base, x.astype(compute_dtype)).astype(jnp.float32)
+        return model.apply(base, x)
+
+    return fn

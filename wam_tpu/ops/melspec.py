@@ -28,7 +28,8 @@ import numpy as np
 from jax import lax
 
 __all__ = ["mel_filterbank", "stft_power", "melspectrogram", "amplitude_to_db",
-           "mel_to_stft_magnitude", "set_stft_impl", "get_stft_impl"]
+           "mel_to_stft_magnitude", "set_stft_impl", "get_stft_impl",
+           "set_mel_bf16", "get_mel_bf16"]
 
 # STFT backend: "fft" = jnp.fft.rfft (XLA's Cooley-Tukey matmul
 # decomposition on TPU); "matmul" = ONE windowed real-DFT matmul pair per
@@ -62,6 +63,33 @@ except ValueError as _e:
     raise ValueError(
         f"WAM_TPU_STFT_IMPL={_env_impl!r} is invalid: {_e}"
     ) from None
+
+
+# bf16 mel chain (PrecisionPolicy.mel_bf16): the windowed-DFT and
+# filterbank matmuls take bf16 inputs with f32 accumulation
+# (preferred_element_type) — half the MXU input bytes, same f32 power /
+# dB math. The DFT part honors the flag only under the matmul STFT impl
+# (the fft path has no bf16 rfft worth taking — XLA upcasts); the
+# filterbank matmul honors it under either impl.
+# Gated by the attribution-cosine tolerance tests in tests/test_precision.py
+# (the round-3 f32-accumulate DWT precedent: bf16 inputs, f32 out).
+_mel_bf16 = False
+
+
+def set_mel_bf16(on: bool) -> None:
+    """Default the mel chain's matmuls to bf16 inputs for *not-yet-traced*
+    calls (per-call ``bf16=`` overrides this)."""
+    global _mel_bf16
+    _mel_bf16 = bool(on)
+
+
+def get_mel_bf16() -> bool:
+    return _mel_bf16
+
+
+_env_mel = os.environ.get("WAM_TPU_MEL_BF16", "")
+if _env_mel:
+    set_mel_bf16(_env_mel not in ("0", "false", "no"))
 
 
 def _use_matmul_stft(n_fft: int) -> bool:
@@ -108,7 +136,7 @@ def mel_filterbank(n_freqs: int, n_mels: int, sample_rate: int, f_min: float = 0
     return fb.astype(np.float32)
 
 
-def stft_power(x: jax.Array, n_fft: int = 1024, hop: int | None = None, center: bool = True, impl: str | None = None) -> jax.Array:
+def stft_power(x: jax.Array, n_fft: int = 1024, hop: int | None = None, center: bool = True, impl: str | None = None, bf16: bool | None = None) -> jax.Array:
     """Power spectrogram |STFT|² with a Hann window.
 
     x: (..., L) → (..., n_frames, n_fft//2 + 1). Differentiable.
@@ -116,6 +144,9 @@ def stft_power(x: jax.Array, n_fft: int = 1024, hop: int | None = None, center: 
     ("matmul" | "fft"); the sequence-sharded estimators force "matmul" — the
     DFT-as-matmul form is GSPMD-partitionable, while the fft path is not
     (and trips an XLA CPU fft-thunk layout check on sharded operands).
+    ``bf16`` overrides the global `set_mel_bf16` default for this call:
+    bf16 frame/DFT-matrix inputs with f32-accumulated matmuls (matmul impl
+    only; the power output stays f32).
     """
     hop = n_fft // 2 if hop is None else hop
     if center:
@@ -144,11 +175,22 @@ def stft_power(x: jax.Array, n_fft: int = 1024, hop: int | None = None, center: 
         use_matmul = _use_matmul_stft(n_fft)
     else:
         use_matmul = impl == "matmul"
+    use_bf16 = _mel_bf16 if bf16 is None else bool(bf16)
     if use_matmul:
+        C, S = _dft_matrices(n_fft)
+        if use_bf16:
+            # single-pass bf16 inputs, f32-accumulated: half the MXU input
+            # bytes of the HIGH (bf16_3x) baseline below; |Δ mel-dB| gated
+            # by tests/test_precision.py against the f32 oracle
+            fr = frames.astype(jnp.bfloat16)
+            re = jnp.matmul(fr, jnp.asarray(C, dtype=jnp.bfloat16),
+                            preferred_element_type=jnp.float32)
+            im = jnp.matmul(fr, jnp.asarray(S, dtype=jnp.bfloat16),
+                            preferred_element_type=jnp.float32)
+            return re * re + im * im
         # windowed real-DFT as two MXU matmuls; Precision.HIGH (bf16_3x
         # passes) holds the mel-dB error at the f32 summation floor while
         # measuring ~10% faster than HIGHEST end to end (BASELINE.md r4)
-        C, S = _dft_matrices(n_fft)
         re = jnp.matmul(frames, jnp.asarray(C), precision=lax.Precision.HIGH)
         im = jnp.matmul(frames, jnp.asarray(S), precision=lax.Precision.HIGH)
         return re * re + im * im
@@ -170,16 +212,25 @@ def melspectrogram(
     hop: int | None = None,
     to_db: bool = True,
     impl: str | None = None,
+    bf16: bool | None = None,
 ) -> jax.Array:
     """Batch melspectrogram: (..., L) → (..., n_frames, n_mels).
 
     Matches the reference's per-waveform layout after its transpose
     (`lib/wam_1D.py:216`: time-major, mel channels last). ``impl`` is the
-    per-call STFT backend override (see `stft_power`).
+    per-call STFT backend override (see `stft_power`); ``bf16`` the
+    per-call mel-chain precision override (see `set_mel_bf16`) — bf16
+    inputs on the DFT and filterbank matmuls, f32 accumulation, f32 dB.
     """
-    p = stft_power(x, n_fft=n_fft, hop=hop, impl=impl)
-    fb = jnp.asarray(mel_filterbank(n_fft // 2 + 1, n_mels, sample_rate), dtype=x.dtype)
-    mel = p @ fb  # (..., n_frames, n_mels)
+    use_bf16 = _mel_bf16 if bf16 is None else bool(bf16)
+    p = stft_power(x, n_fft=n_fft, hop=hop, impl=impl, bf16=use_bf16)
+    fb = mel_filterbank(n_fft // 2 + 1, n_mels, sample_rate)
+    if use_bf16:
+        pb = p.astype(jnp.bfloat16)
+        mel = jnp.matmul(pb, jnp.asarray(fb, dtype=jnp.bfloat16),
+                         preferred_element_type=jnp.float32)
+    else:
+        mel = p @ jnp.asarray(fb, dtype=x.dtype)  # (..., n_frames, n_mels)
     return amplitude_to_db(mel) if to_db else mel
 
 

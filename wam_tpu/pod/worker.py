@@ -130,8 +130,15 @@ def build_worker_server(args, fleet_metrics):
             lambda x: toy(x.mean(axis=1)), J=2,
             n_samples=args.n_samples, sample_batch_size=None)
         if args.aot_key_base or args.registry:
-            base = (args.aot_key_base
-                    or f"pod_worker|toy2d|J2|n{args.n_samples}|mb{args.max_batch}")
+            from wam_tpu.config import precision_tag
+            from wam_tpu.serve import fleet_aot_key
+
+            # precision-tag the base so a bf16-policy worker never reuses
+            # the f32 export bundle ("f32" tag → suffix-free, warm caches)
+            base = fleet_aot_key(
+                args.aot_key_base
+                or f"pod_worker|toy2d|J2|n{args.n_samples}|mb{args.max_batch}",
+                None, precision_tag())
 
             def entry_factory(rid, m, _wam=wam, _base=base):
                 from wam_tpu.serve import OVERSIZE_ENTRY_ID, fleet_aot_key

@@ -34,15 +34,25 @@ from wam_tpu.pipeline.donation import resolve_donate
 __all__ = ["jit_entry", "fleet_aot_key"]
 
 
-def fleet_aot_key(aot_key: str | None, n_replicas: int | None) -> str | None:
-    """Replica-count tag for fleet AOT keys. The fleet's oversize entry is
-    dispatched data-parallel over an N-chip mesh, and an exported executable
-    bakes that mesh size in — so an export built for a 4-chip fleet must be
-    a cache MISS on an 8-chip one. Single-chip keys (``n_replicas`` in
-    {None, 1}) pass through unchanged, keeping existing AOT caches warm."""
-    if aot_key is None or n_replicas in (None, 1):
-        return aot_key
-    return f"{aot_key}|fleet{int(n_replicas)}"
+def fleet_aot_key(aot_key: str | None, n_replicas: int | None,
+                  precision: str | None = None) -> str | None:
+    """Replica-count (and precision) tag for fleet AOT keys. The fleet's
+    oversize entry is dispatched data-parallel over an N-chip mesh, and an
+    exported executable bakes that mesh size in — so an export built for a
+    4-chip fleet must be a cache MISS on an 8-chip one. Likewise the
+    precision policy is baked into the traced program (bf16 param casts,
+    boundary input casts), so a non-default ``precision`` tag
+    (`config.PrecisionPolicy.tag()`, e.g. "bf16" or "bf16+mel") is appended
+    — a bf16 export must never cache-hit the f32 one. Single-chip keys
+    (``n_replicas`` in {None, 1}) and the default policy ("f32"/None/"")
+    pass through unchanged, keeping existing AOT caches warm."""
+    if aot_key is None:
+        return None
+    if n_replicas not in (None, 1):
+        aot_key = f"{aot_key}|fleet{int(n_replicas)}"
+    if precision not in (None, "", "f32"):
+        aot_key = f"{aot_key}|{precision}"
+    return aot_key
 
 
 def jit_entry(

@@ -10,7 +10,7 @@ be answered from a byte-bounded cache with EXACT results, not approximate
 ones.
 
 Key = ``sha256(input bytes | shape | dtype) | label | cache_id |
-schedule_fingerprint``:
+schedule_fingerprint | precision_tag``:
 
 - the input digest covers the raw bytes plus shape/dtype, so a reshaped
   or recast array never collides;
@@ -21,7 +21,10 @@ schedule_fingerprint``:
 - the schedule fingerprint (`tune.cache.schedule_fingerprint`) changes
   whenever a tuned schedule lands or the schedule kill switch flips, so
   stale-schedule hits are structurally impossible — the key stops
-  matching (tests pin this).
+  matching (tests pin this);
+- the precision tag (`config.precision_tag`) covers env-routed precision
+  flips (``WAM_TPU_FAN_DTYPE`` / ``WAM_TPU_MEL_BF16``) the same way —
+  a bf16 run can never replay a cached f32 result or vice versa.
 
 Placement: `AttributionServer.submit` / `FleetServer.submit` consult the
 cache BEFORE admission — a hit resolves the future immediately and never
@@ -89,13 +92,20 @@ def cache_disabled() -> bool:
 
 def result_cache_key(x: np.ndarray, y, cache_id: str) -> str:
     """Content address for one request: input digest + label + entry id +
-    the live tuned-schedule fingerprint (module docstring)."""
+    the live tuned-schedule fingerprint (module docstring) + the live
+    precision tag. Tuned-entry precision flips already move the schedule
+    fingerprint; the tag covers the ENV route (``WAM_TPU_FAN_DTYPE`` /
+    ``WAM_TPU_MEL_BF16``), read per call like the fingerprint, so flipping
+    a precision knob can never replay a result computed under the other
+    policy."""
+    from wam_tpu.config import precision_tag
     from wam_tpu.tune.cache import schedule_fingerprint
 
     h = hashlib.sha256()
     h.update(x.tobytes())
     h.update(repr((x.shape, str(x.dtype))).encode())
-    return f"{h.hexdigest()}|{y}|{cache_id}|{schedule_fingerprint()}"
+    return (f"{h.hexdigest()}|{y}|{cache_id}|{schedule_fingerprint()}"
+            f"|{precision_tag()}")
 
 
 def _tree_bytes(value) -> int:

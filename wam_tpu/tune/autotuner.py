@@ -42,6 +42,11 @@ class Candidate:
     # anytime checkpoint stride k (wam_tpu.anytime): samples per
     # confidence checkpoint in the checkpointed estimators / entries
     anytime_stride: int | None = None
+    # precision axes (config.PrecisionPolicy): eval-fan compute dtype
+    # ("f32"/"bf16"/"fp8") and the bf16 mel chain flag — resolved by
+    # plan_fan / resolve_precision from the persisted entry
+    fan_dtype: str | None = None
+    mel_bf16: bool | None = None
 
     def label(self) -> str:
         parts = [f"chunk={self.sample_chunk if self.sample_chunk else 'full'}"]
@@ -61,13 +66,18 @@ class Candidate:
             parts.append("fused" if self.seq_fused else "split")
         if self.anytime_stride is not None:
             parts.append(f"k={self.anytime_stride}")
+        if self.fan_dtype is not None:
+            parts.append(f"dtype={self.fan_dtype}")
+        if self.mel_bf16 is not None:
+            parts.append(f"mel={'bf16' if self.mel_bf16 else 'f32'}")
         return " ".join(parts)
 
     def entry(self) -> dict:
         """The knob fields of a schedule-cache entry."""
         out: dict = {"sample_chunk": self.sample_chunk}
         for field in ("stream_noise", "dwt_impl", "synth_impl", "layout",
-                      "fan_cap", "fan_chunk", "seq_fused", "anytime_stride"):
+                      "fan_cap", "fan_chunk", "seq_fused", "anytime_stride",
+                      "fan_dtype", "mel_bf16"):
             v = getattr(self, field)
             if v is not None:
                 out[field] = v

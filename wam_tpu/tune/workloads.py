@@ -18,9 +18,19 @@ Presets:
   sample 128) sweeping the evaluation fan cap AND the images-per-chunk
   override (`Candidate.fan_chunk`); winner feeds
   `evalsuite.fan.plan_fan("auto")` (VERDICT.md round-5 directive 3 — the
-  slowest eval row).
-- ``fan2d`` — the insertion-AUC fan at production geometry, same two axes,
-  persisted under the (n_iter+1)-row eval2d key every AUC metric resolves.
+  slowest eval row). Also probes the bf16 fan (`Candidate.fan_dtype`,
+  round 17): model params bound bf16, fan inputs cast at the boundary,
+  reductions f32 — the tuned entry's fan_dtype is what
+  ``plan_fan("auto")`` resolves per workload.
+- ``fan2d`` — the insertion-AUC fan at production geometry, same axes
+  (cap, fan_chunk, fan_dtype), persisted under the (n_iter+1)-row eval2d
+  key every AUC metric resolves.
+- ``mel1d`` — the audio mel front-end at flagship audio geometry (b8,
+  220500 samples, matmul STFT), A/B-ing the bf16 mel chain
+  (`Candidate.mel_bf16`: bf16 DFT/filterbank inputs, f32 accumulation)
+  against the Precision.HIGH f32 baseline; the winner's ``mel_bf16``
+  field documents the measured call for operators of the
+  ``WAM_TPU_MEL_BF16`` knob.
 - ``wamvit2d`` — patch-aligned ViT WAM (tiny capture-capable ViT, patch 8
   on 64² inputs → the planner's J=3) at CPU-fast geometry, sweeping chunks,
   stream_noise, an NCHW layout probe (the ViT is natively channel-last)
@@ -196,16 +206,23 @@ def _mu2d_workload(n_images: int = 4, image: int = 224, grid_size: int = 28,
     model = resnet50(num_classes=1000)
     variables = model.init(jax.random.PRNGKey(0),
                            jnp.zeros((1, image, image, 3)))
-    model_fn = bind_inference(model, variables, nchw=True, fold_bn=True)
     x = jax.random.normal(jax.random.PRNGKey(1),
                           (n_images, 3, image, image), jnp.float32)
     y = jnp.arange(n_images, dtype=jnp.int32) % 1000
     # fixed random mosaics: the sweep measures the masking/forward fan, the
     # explainer is out of scope (and out of the timed region)
     wams = jax.random.uniform(jax.random.PRNGKey(2), (n_images, image, image))
+    # one bound model per fan dtype (flagship's nchw-dict pattern): the bf16
+    # candidate must run a bf16-param model, not just cast a f32 one's inputs
+    bound: dict[str, Callable] = {}
 
     def build(cand: Candidate):
-        ev = Eval2DWAM(model_fn, explainer=lambda xx, yy: wams,
+        dt = cand.fan_dtype or "f32"
+        if dt not in bound:
+            bound[dt] = bind_inference(
+                model, variables, nchw=True, fold_bn=True,
+                compute_dtype=None if dt == "f32" else dt)
+        ev = Eval2DWAM(bound[dt], explainer=lambda xx, yy: wams,
                        batch_size=int(cand.fan_cap))
         rand_all, onehot_all = ev._mu_random_draws(
             n_images, grid_size, sample_size, subset_size)
@@ -218,6 +235,9 @@ def _mu2d_workload(n_images: int = 4, image: int = 224, grid_size: int = 28,
     # says 256//128 = 2, the sweep asks whether 1 or 4 actually wins
     cands += [Candidate(fan_cap=256, fan_chunk=1),
               Candidate(fan_cap=256, fan_chunk=4)]
+    # precision axis (round 17): the bf16 fan at the hand-law cap — fidelity
+    # is gated separately (tests/test_precision.py), the sweep only ranks
+    cands.append(Candidate(fan_cap=256, fan_dtype="bf16"))
     return Workload(name="mu2d", workload="eval2d", shape=(sample_size,),
                     batch=sample_size, items=n_images, candidates=cands,
                     build=build)
@@ -232,7 +252,7 @@ def _explicit_plan(cand: Candidate, fan: int):
     images_per_chunk, fan_chunk = fan_chunk_geometry(cap, fan)
     if cand.fan_chunk:
         images_per_chunk, fan_chunk = max(1, int(cand.fan_chunk)), None
-    return FanPlan(cap, images_per_chunk, fan_chunk)
+    return FanPlan(cap, images_per_chunk, fan_chunk, cand.fan_dtype or "f32")
 
 
 def _fan2d_workload(n_images: int = 8, image: int = 224,
@@ -250,28 +270,62 @@ def _fan2d_workload(n_images: int = 8, image: int = 224,
     model = resnet50(num_classes=1000)
     variables = model.init(jax.random.PRNGKey(0),
                            jnp.zeros((1, image, image, 3)))
-    model_fn = bind_inference(model, variables, nchw=True, fold_bn=True)
     x = jax.random.normal(jax.random.PRNGKey(1),
                           (n_images, 3, image, image), jnp.float32)
     y = jnp.arange(n_images, dtype=jnp.int32) % 1000
     wams = jax.random.uniform(jax.random.PRNGKey(2), (n_images, image, image))
+    bound: dict[str, Callable] = {}
 
     def build(cand: Candidate):
-        ev = Eval2DWAM(model_fn, explainer=lambda xx, yy: wams,
+        dt = cand.fan_dtype or "f32"
+        if dt not in bound:
+            bound[dt] = bind_inference(
+                model, variables, nchw=True, fold_bn=True,
+                compute_dtype=None if dt == "f32" else dt)
+        ev = Eval2DWAM(bound[dt], explainer=lambda xx, yy: wams,
                        batch_size=int(cand.fan_cap))
         plan = _explicit_plan(cand, n_iter + 1)
         runner = batched_auc_runner(
             lambda img, wam: ev._perturb_for_auc(img, wam, "insertion",
                                                  n_iter),
-            model_fn, plan.images_per_chunk, fan_chunk=plan.fan_chunk)
+            bound[dt], plan.images_per_chunk, fan_chunk=plan.fan_chunk,
+            fan_dtype=plan.fan_dtype)
         return runner, (x, wams, jnp.asarray(y))
 
     cands = [Candidate(fan_cap=c) for c in (128, 256, 512)]
     cands += [Candidate(fan_cap=256, fan_chunk=1),
               Candidate(fan_cap=512, fan_chunk=4)]
+    # precision axis (round 17): bf16 fan at the round-5 winner cap
+    cands.append(Candidate(fan_cap=256, fan_dtype="bf16"))
     return Workload(name="fan2d", workload="eval2d", shape=(n_iter + 1,),
                     batch=n_iter + 1, items=n_images, candidates=cands,
                     build=build)
+
+
+def _mel1d_workload(batch: int = 8, n: int = 220500) -> Workload:
+    """Audio mel front-end A/B at flagship audio geometry (ESC-50 5 s @
+    44.1 kHz, matmul STFT — the TPU-native impl): f32 baseline vs the bf16
+    mel chain (`melspectrogram(bf16=True)`: bf16 DFT-basis/filterbank
+    matmul inputs, f32 accumulation). Persists under a ``mel1d`` key whose
+    ``mel_bf16`` field records the measured verdict; fidelity (max |Δ dB|,
+    attribution cosine) is the tests'/bench's job — the sweep only ranks
+    throughput."""
+    from wam_tpu.ops.melspec import melspectrogram
+
+    x = jax.random.normal(jax.random.PRNGKey(1), (batch, n), jnp.float32)
+
+    def build(cand: Candidate):
+        bf = bool(cand.mel_bf16)
+
+        @jax.jit
+        def run(v):
+            return melspectrogram(v, impl="matmul", bf16=bf)
+
+        return run, (x,)
+
+    cands = [Candidate(mel_bf16=False), Candidate(mel_bf16=True)]
+    return Workload(name="mel1d", workload="mel1d", shape=(n,), batch=batch,
+                    items=batch, candidates=cands, build=build)
 
 
 def _seq_mesh():
@@ -500,6 +554,7 @@ WORKLOADS: dict[str, Callable[..., Workload]] = {
     "flagship": _flagship_workload,
     "mu2d": _mu2d_workload,
     "fan2d": _fan2d_workload,
+    "mel1d": _mel1d_workload,
     "wamvit2d": _wamvit2d_workload,
     "wamvid3d": _wamvid3d_workload,
     "wamseq1d": _wamseq1d_workload,
