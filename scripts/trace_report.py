@@ -101,11 +101,43 @@ def compile_table(compile_rows: list[dict]) -> list[dict]:
     ]
 
 
-def load_events(path: str) -> list[dict]:
+def load_events(path: str) -> tuple[list[dict], dict[int, str]]:
+    """(complete spans, pid -> process name). The names come from the
+    Perfetto ``process_name`` metadata rows (``ph:"M"``) that
+    `PodRouter.trace_events` labels workers with — cross-host workers
+    carry an ``@hostN`` suffix, which is what `host_table` groups on."""
     with open(path) as f:
         payload = json.load(f)
     events = payload["traceEvents"] if isinstance(payload, dict) else payload
-    return [e for e in events if e.get("ph") == "X"]
+    proc_names = {
+        e.get("pid"): str(e.get("args", {}).get("name") or "")
+        for e in events
+        if e.get("ph") == "M" and e.get("name") == "process_name"
+    }
+    return [e for e in events if e.get("ph") == "X"], proc_names
+
+
+def host_table(events: list[dict], proc_names: dict[int, str]) -> list[dict]:
+    """Per-host rollup of a merged pod trace: spans grouped by the
+    ``@host`` suffix of their process label. Unlabeled pids (the router
+    driver itself, single-host workers) roll up under ``local`` — the
+    router's own host. Empty when the trace has no labeled processes,
+    so single-process traces print nothing new."""
+    rows: dict[str, dict] = {}
+    for e in events:
+        name = proc_names.get(e.get("pid"), "")
+        host = name.rsplit("@", 1)[1] if "@" in name else "local"
+        row = rows.setdefault(
+            host, {"host": host, "pids": set(), "spans": 0, "total_ms": 0.0})
+        row["pids"].add(e.get("pid"))
+        row["spans"] += 1
+        row["total_ms"] += e.get("dur", 0.0) / 1e3
+    out = []
+    for host in sorted(rows):
+        r = rows[host]
+        out.append({"host": host, "processes": len(r["pids"]),
+                    "spans": r["spans"], "total_ms": r["total_ms"]})
+    return out
 
 
 def _pct(sorted_vals: list[float], q: float) -> float:
@@ -208,7 +240,7 @@ def main() -> int:
                              "phase compile breakdown section")
     args = parser.parse_args()
 
-    events = load_events(args.trace)
+    events, proc_names = load_events(args.trace)
     if not events:
         print("no complete (ph:X) events in trace", file=sys.stderr)
         return 1
@@ -259,6 +291,17 @@ def main() -> int:
     if len(pids) > 1:
         print(f"\ncross-process trace: {len(pids)} processes "
               f"(spans joined per trace_id)")
+        hosts = host_table(events, proc_names)
+        if len(hosts) > 1:
+            # multi-HOST pod trace (TCP workers labeled @hostN by
+            # PodRouter.trace_events, clocks re-based per worker via the
+            # lowest-RTT-midpoint offset): per-host span rollup
+            hhdr = f"{'host':<12} {'processes':>9} {'spans':>8} {'total ms':>10}"
+            print(hhdr)
+            print("-" * len(hhdr))
+            for h in hosts:
+                print(f"{h['host']:<12} {h['processes']:>9} "
+                      f"{h['spans']:>8} {h['total_ms']:>10.2f}")
 
     orphans = orphaned_spans(events)
     if orphans:

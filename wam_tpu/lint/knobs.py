@@ -80,6 +80,13 @@ KNOB_DOCS = {
         "hex connection auth key the pod router hands to worker "
         "processes (set by the router; workers refuse to start without "
         "it)",
+    "WAM_TPU_POD_TRANSPORT":
+        "pod control-plane transport (`tcp` = framed zero-copy sockets, "
+        "the default; `pipe` = legacy multiprocessing pipes, loopback "
+        "only)",
+    "WAM_TPU_POD_HEARTBEAT_S":
+        "pod router heartbeat interval in seconds (default 0.25); also "
+        "the staleness bound on routing's drain estimates",
 }
 
 _ENV_METHODS = {"get", "setdefault", "pop"}

@@ -87,6 +87,14 @@ METRIC_NAMES = frozenset({
     "wam_tpu_pod_worker_drain_seconds",
     "wam_tpu_pod_worker_restarts_total",
     "wam_tpu_pod_workers_alive",
+    # pod wire transport (pod/netchannel.py, pod/metrics.py)
+    "wam_tpu_pod_net_handshakes_total",
+    "wam_tpu_pod_net_heartbeats_coalesced_total",
+    "wam_tpu_pod_net_host_rtt_seconds",
+    "wam_tpu_pod_net_messages_total",
+    "wam_tpu_pod_net_registry_stream_bytes_total",
+    "wam_tpu_pod_net_rx_bytes_total",
+    "wam_tpu_pod_net_tx_bytes_total",
     # compile-artifact registry (registry/)
     "wam_tpu_registry_artifacts_total",
     "wam_tpu_registry_hydrations_total",
@@ -106,6 +114,7 @@ LEDGER_ROW_TYPES = frozenset({
     "obs_snapshot",
     "partial_result",
     "pod_autoscale",
+    "pod_host",
     "pod_summary",
     "pod_worker",
     "registry_hydration",

@@ -58,6 +58,16 @@ _c_autoscale = _obs_registry.counter(
 _c_completed = _obs_registry.counter(
     "wam_tpu_pod_requests_completed_total",
     "requests resolved OK through the pod router")
+_c_coalesced = _obs_registry.counter(
+    "wam_tpu_pod_net_heartbeats_coalesced_total",
+    "health probes skipped because one was already outstanding")
+_c_registry_stream = _obs_registry.counter(
+    "wam_tpu_pod_net_registry_stream_bytes_total",
+    "registry bundle bytes streamed to probing workers")
+_g_host_rtt = _obs_registry.gauge(
+    "wam_tpu_pod_net_host_rtt_seconds",
+    "per-host control-channel RTT EMA (heartbeat round-trips)",
+    labels=("host",))
 
 _LATENCY_SAMPLE_MAX = 200_000  # bounded like ServeMetrics' sample
 
@@ -83,6 +93,18 @@ class PodMetrics:
             self.completed += 1
             if len(self.latencies_s) < _LATENCY_SAMPLE_MAX:
                 self.latencies_s.append(latency_s)
+
+    # -- wire transport -------------------------------------------------------
+
+    def note_heartbeat_coalesced(self) -> None:
+        _c_coalesced.inc()
+
+    def note_registry_stream(self, nbytes: int) -> None:
+        if nbytes:
+            _c_registry_stream.inc(nbytes)
+
+    def note_host_rtt(self, host: str, ema_s: float) -> None:
+        _g_host_rtt.set(ema_s, host=host)
 
     # -- worker lifecycle ----------------------------------------------------
 
@@ -217,10 +239,12 @@ class PodMetrics:
             "per_worker": per_worker,
         }
 
-    def emit(self, writer, config: dict | None = None, workers=()) -> dict:
+    def emit(self, writer, config: dict | None = None, workers=(),
+             hosts=()) -> dict:
         """Write the pod's ledger: worker lifecycle rows, restart trail,
-        autoscale trail, then the ``pod_summary`` (config attached).
-        Returns the summary row."""
+        autoscale trail, one ``pod_host`` row per host group (the
+        router's `host_summary`), then the ``pod_summary`` (config
+        attached). Returns the summary row."""
         with self._lock:
             worker_rows = list(self.worker_rows)
             restarts = list(self.restarts)
@@ -231,6 +255,9 @@ class PodMetrics:
             writer.write(row)
         for row in autoscale_rows:
             writer.write(row)
+        for host_row in hosts:
+            writer.write({"metric": "pod_host",
+                          "schema_version": SCHEMA_VERSION, **host_row})
         summary = self.pod_summary(list(workers))
         if config:
             summary["config"] = config

@@ -1,17 +1,29 @@
 """Router <-> worker control channel (pod tentpole, transport layer).
 
 One pod = one front-door router process + N independent fleet worker
-processes. The control channel is deliberately minimal:
-`multiprocessing.connection` (length-prefixed pickle frames over a
-loopback TCP socket, HMAC-authenticated via an ``authkey`` the router
-passes to each worker through the environment — never argv, which is
-world-readable in /proc). Each side serializes sends through a lock
-(`Connection.send` is not thread-safe) while one dedicated receiver
-thread per connection drains the other direction.
+processes. The message grammar here is TRANSPORT-INDEPENDENT — plain
+dicts keyed by ``op`` — and two wire encodings speak it:
 
-Message grammar (plain dicts keyed by ``op``; ndarrays ride pickle)::
+- ``tcp://host:port`` (default since round 18): the framed-TCP
+  transport (`pod.transport` + `pod.netchannel`) — length-prefixed
+  binary frames where ndarray payloads ride as raw zero-copy buffer
+  frames (header carries shape/dtype; NO pickle on the array path),
+  mutually HMAC-authenticated via the same `AUTHKEY_ENV` secret.
+- bare ``host:port``: the legacy `multiprocessing.connection` pipe
+  (length-prefixed pickle frames over loopback TCP), kept behind
+  ``WAM_TPU_POD_TRANSPORT=pipe`` as the fallback.
 
-    worker -> router   {"op": "hello", worker_id, pid, snapshot, buckets}
+Either way the authkey reaches workers through the environment — never
+argv, which is world-readable in /proc — and each side serializes
+sends through a lock while one dedicated receiver thread per
+connection drains the other direction.
+
+Message grammar::
+
+    worker -> router   {"op": "registry_probe", worker_id}
+    router -> worker   {"op": "registry_bundle", files}
+    worker -> router   {"op": "hello", worker_id, pid, host, snapshot,
+                        buckets}
     router -> worker   {"op": "submit", req_id, x, y, deadline_ms, ctx}
     worker -> router   {"op": "result", req_id, ok, value | error}
     router -> worker   {"op": "health", t_send}
@@ -19,6 +31,11 @@ Message grammar (plain dicts keyed by ``op``; ndarrays ride pickle)::
     router -> worker   {"op": "close"}
     worker -> router   {"op": "bye", snapshot, spans}
 
+``registry_probe`` is the one PRE-hello exchange: a freshly connected
+worker (spawned with ``--registry wire``) asks for the pod's
+compile-artifact bundle and hydrates from the streamed ``files``
+(relpath -> raw bytes frames) BEFORE warmup, so a cold host joins at
+``compile_count == 0`` without sharing a filesystem with the router.
 ``hello`` is sent AFTER the worker's fleet warmed — readiness and
 liveness are the same signal. ``health_reply`` echoes the router's
 ``t_send`` so the router can estimate the worker's perf_counter clock
@@ -74,6 +91,11 @@ class WorkerSnapshot:
     projected_drain_s: float = 0.0
     ema_service_s: dict = field(default_factory=dict)  # bucket key -> s
     qos_depth: dict = field(default_factory=dict)  # QoS class -> queued items
+    # free admission slots across live replicas; 0 = a submit would
+    # bounce QueueFull, and the router deprioritizes the hop (a reject
+    # costs a cross-host round-trip on the tcp transport). -1 = unknown
+    # (pre-round-18 worker).
+    queue_free: int = -1
     slo_penalty_s: float = 0.0
     quarantined: bool = False  # EVERY live replica quarantined
     live_replicas: int = 1
@@ -167,15 +189,22 @@ class Channel:
         return self._closed
 
 
-def connect_to_router(address: str) -> Channel:
-    """Worker-side dial: ``address`` is "host:port"; the authkey comes
-    from the environment (`AUTHKEY_ENV`, hex)."""
-    host, _, port = address.rpartition(":")
+def connect_to_router(address: str):
+    """Worker-side dial. The address carries its transport:
+    ``tcp://host:port`` speaks the framed zero-copy transport
+    (`pod.netchannel`), a bare ``host:port`` the legacy
+    multiprocessing pipe. The authkey comes from the environment
+    either way (`AUTHKEY_ENV`, hex)."""
     key_hex = os.environ.get(AUTHKEY_ENV, "")
     if not key_hex:
         raise RuntimeError(
             f"worker has no {AUTHKEY_ENV} in its environment — pod workers "
             "must be spawned by a PodRouter (or a test setting the key)")
+    if address.startswith("tcp://"):
+        from wam_tpu.pod.netchannel import connect_tcp
+
+        return connect_tcp(address, bytes.fromhex(key_hex))
+    host, _, port = address.rpartition(":")
     conn = Client((host or "127.0.0.1", int(port)),
                   authkey=bytes.fromhex(key_hex))
     return Channel(conn)

@@ -13,10 +13,27 @@ model at both scales:
   stale by up to one interval; in-flight accounting covers the gap) plus
   the worker's SLO burn penalty. Workers whose every replica is
   quarantined are last-resort candidates, never dropped.
+- **host-aware (round 18)**: every worker belongs to a host group
+  (``--host-label``, self-reported at hello). Cross-host candidates
+  carry their host's congestion-free MIN RTT (lowest heartbeat
+  round-trip seen, seeded by the transport handshake; the EMA is kept
+  alongside for observability) added to the drain+SLO score — a remote
+  worker wins exactly when it is cheaper by more than the wire, so an
+  idle remote host absorbs load a busy local one would queue (locality
+  is a penalty, not a tier: a hard local-first tier would starve
+  remote hosts whenever local queues merely had room; penalizing with
+  the loaded EMA would double-count queueing the drain term already
+  scores). Workers
+  whose heartbeat said ``queue_free == 0`` sort after workers with
+  room on ANY host: a rejected submit now costs a network round-trip,
+  not a pipe hop, so the router avoids hops it already knows will
+  bounce.
 - **typed backpressure, aggregated fleet-style**: a worker's
   `QueueFullError` re-routes the request to the next candidate; when
   every live worker rejected, the request fails with a `QueueFullError`
-  carrying the SMALLEST ``retry_after_s`` any worker offered.
+  carrying the smallest ``retry_after_s`` per HOST, min-reduced across
+  hosts — folding in any host's supervised-respawn ETA when a dead
+  host would be back sooner than the live ones drain.
 - **zero lost requests across worker death**: the router keeps the host
   copy of every in-flight request until its result arrives; a worker
   death (channel EOF, heartbeat timeout, or exit code — whichever signal
@@ -41,6 +58,7 @@ from __future__ import annotations
 import itertools
 import os
 import secrets
+import socket as _socket
 import subprocess
 import sys
 import threading
@@ -72,6 +90,29 @@ __all__ = ["NoLiveWorkerError", "PodRouter"]
 # respawn-ETA half of NoLiveWorkerError.retry_after_s)
 _SPAWN_EMA_SEED_S = 5.0
 
+# control-channel transport: "tcp" (framed zero-copy, pod.netchannel)
+# or "pipe" (legacy multiprocessing pickle pipe)
+TRANSPORT_ENV = "WAM_TPU_POD_TRANSPORT"
+_DEFAULT_TRANSPORT = "tcp"
+
+# health-poll period override (seconds); constructor args still win
+HEARTBEAT_ENV = "WAM_TPU_POD_HEARTBEAT_S"
+_DEFAULT_HEARTBEAT_S = 0.25
+
+# per-host RTT EMA smoothing (heartbeat round-trips; handshake-seeded)
+_RTT_EMA_ALPHA = 0.2
+
+# at most this many pre-hello exchanges (registry probes) before a
+# connection must say hello or be dropped
+_MAX_PREFACE_MSGS = 4
+
+
+def _resolve_transport(transport: str | None) -> str:
+    t = transport or os.environ.get(TRANSPORT_ENV, "") or _DEFAULT_TRANSPORT
+    if t not in ("tcp", "pipe"):
+        raise ValueError(f"unknown pod transport {t!r} (tcp|pipe)")
+    return t
+
 
 class NoLiveWorkerError(ServeError):
     """Every pod worker is dead (or refused this request after deaths).
@@ -101,21 +142,31 @@ class _PodRequest:
     t_submit: float
     qos: str = "interactive"
     tried: set = field(default_factory=set)
-    min_retry_after: float | None = None
+    # tightest QueueFullError retry_after per HOST that rejected; the
+    # terminal error min-reduces ACROSS hosts (satellite: a pod is now
+    # multiple failure domains, the estimate must span all of them)
+    retry_after_by_host: dict = field(default_factory=dict)
     ctx: tuple | None = None
 
 
 class _Worker:
     """Router-side state for one worker process incarnation."""
 
-    def __init__(self, wid: int, incarnation: int):
+    def __init__(self, wid: int, incarnation: int, expected_host: str = ""):
         self.wid = wid
         self.incarnation = incarnation
         self.proc: subprocess.Popen | None = None
         self.chan: Channel | None = None
         self.snapshot = None  # latest protocol.WorkerSnapshot
+        self.snapshot_t = time.monotonic()  # when `snapshot` landed
         self.last_reply = time.monotonic()
         self.alive = False
+        self.host = ""  # self-reported at hello
+        self.expected_host = expected_host  # spawn-time assignment
+        # monotonic time of the unanswered health probe, or None —
+        # the heartbeat loop coalesces instead of stacking probes
+        # (heartbeat thread sets, receiver thread clears; benign race)
+        self.health_pending_t: float | None = None
         self.draining = False  # autoscale shrink: no new routes
         self.closing = False  # router-initiated close: EOF is not a death
         self.ready = threading.Event()
@@ -153,12 +204,31 @@ class PodRouter:
     autoscale : a `pod.autoscaler.AutoscaleConfig` to grow/shrink the
         worker set from aggregate drain + SLO burn; None = fixed set.
     heartbeat_s / heartbeat_timeout_s : health-poll period and the
-        silence threshold that declares a worker dead.
+        silence threshold that declares a worker dead. ``heartbeat_s``
+        defaults from ``WAM_TPU_POD_HEARTBEAT_S`` (else 0.25); at most
+        ONE probe per worker is outstanding — while a worker is busy,
+        further ticks coalesce instead of stacking stale probes.
     ready_timeout_s : max wall time for a spawned worker to warm and
         say hello (covers jax import + registry hydration + warmup).
+    transport : "tcp" (framed zero-copy transport, `pod.netchannel`) or
+        "pipe" (legacy multiprocessing pickle pipe); None defaults from
+        ``WAM_TPU_POD_TRANSPORT`` (else tcp). The scheme rides the
+        ``--connect`` address, so workers need no extra flag.
+    hosts : host-group labels to spread spawned workers over
+        (round-robin by wid; the literal ``{host}`` in the argv is
+        substituted, so benches pass ``--host-label {host}``). None =
+        every worker expected on this router's own host.
+    host_label : this router's own host identity for host-local-first
+        routing (default: the real hostname).
+    registry : a compile-artifact bundle DIRECTORY to stream over the
+        wire to workers spawned with ``--registry wire`` — a freshly
+        connected host probes, receives the bundle as raw byte frames,
+        and hydrates to ``compile_count == 0`` before taking traffic.
+        Workers with a shared filesystem keep using ``--registry
+        PATH`` directly; this parameter is for hosts that do not.
     env : extra environment for worker processes.
     metrics_path : pod JSONL ledger (pod_worker / worker_restart /
-        pod_autoscale / pod_summary rows) written at close.
+        pod_autoscale / pod_host / pod_summary rows) written at close.
     """
 
     # checked by the lock-discipline lint rule: mutations outside __init__
@@ -170,6 +240,9 @@ class PodRouter:
         "_workers": "_lock",
         "_threads": "_lock",
         "_spawn_ema_s": "_lock",
+        "_host_rtt": "_lock",
+        "_host_rtt_min": "_lock",
+        "_wire_files": "_lock",
     }
 
     def __init__(
@@ -181,9 +254,13 @@ class PodRouter:
         labeled: bool = True,
         supervise=True,
         autoscale=None,
-        heartbeat_s: float = 0.25,
+        heartbeat_s: float | None = None,
         heartbeat_timeout_s: float = 5.0,
         ready_timeout_s: float = 180.0,
+        transport: str | None = None,
+        hosts: list | None = None,
+        host_label: str | None = None,
+        registry: str | None = None,
         env: dict | None = None,
         metrics: PodMetrics | None = None,
         metrics_path: str | None = None,
@@ -199,15 +276,31 @@ class PodRouter:
         self._worker_argv = [str(a) for a in worker_argv]
         self.n_initial = int(workers)
         self.labeled = labeled
+        if heartbeat_s is None:
+            try:
+                heartbeat_s = float(
+                    os.environ.get(HEARTBEAT_ENV, "") or _DEFAULT_HEARTBEAT_S)
+            except ValueError:
+                heartbeat_s = _DEFAULT_HEARTBEAT_S
         self.heartbeat_s = heartbeat_s
         self.heartbeat_timeout_s = heartbeat_timeout_s
         self.ready_timeout_s = ready_timeout_s
+        self.transport = _resolve_transport(transport)
+        self.hosts = [str(h) for h in hosts] if hosts else None
+        self.host_label = host_label or _socket.gethostname()
+        self.registry = registry
         self._env = dict(env or {})
         self.metrics = metrics if metrics is not None else PodMetrics()
         self.metrics_path = metrics_path
         self.seed = seed
 
         self._lock = threading.Lock()
+        # serializes score->choose->inflight-insert in _route_inner:
+        # two client threads scoring concurrently both see the same
+        # inflight counts and pick the same worker, so a 16-submit
+        # burst lands 5/3 instead of 4/4 and the straggler waits out a
+        # full extra batch cycle behind the overfull worker's queue
+        self._route_lock = threading.Lock()
         self._workers: dict[int, _Worker] = {}
         self._wid_counter = itertools.count(0)
         self._req_ids = itertools.count(1)
@@ -216,8 +309,16 @@ class PodRouter:
         self._stop = threading.Event()
         self._threads: list[threading.Thread] = []
         self._spawn_ema_s = _SPAWN_EMA_SEED_S
+        self._host_rtt: dict[str, float] = {}  # host label -> RTT EMA (s)
+        # host label -> lowest RTT seen (s): the congestion-free wire
+        # cost. The EMA above is observability (how the path is doing);
+        # ROUTING penalizes with the min — a loaded worker's heartbeat
+        # RTT measures queueing, which the drain score already counts,
+        # and double-counting it would starve busy-but-cheap hosts.
+        self._host_rtt_min: dict[str, float] = {}
+        self._wire_files: dict[str, bytes] | None = None  # lazy bundle
         self._authkey = secrets.token_bytes(16)
-        self._listener: Listener | None = None
+        self._listener = None  # Listener (pipe) or NetListener (tcp)
         self.address: str | None = None
 
         self._supervisor = None
@@ -238,9 +339,16 @@ class PodRouter:
     def start(self) -> "PodRouter":
         if self._started:
             return self
-        self._listener = Listener(("127.0.0.1", 0), authkey=self._authkey)
-        host, port = self._listener.address
-        self.address = f"{host}:{port}"
+        if self.transport == "tcp":
+            from wam_tpu.pod.netchannel import NetListener, format_address
+
+            self._listener = NetListener(authkey=self._authkey)
+            self.address = format_address(*self._listener.address)
+        else:
+            self._listener = Listener(("127.0.0.1", 0),
+                                      authkey=self._authkey)
+            host, port = self._listener.address
+            self.address = f"{host}:{port}"
         t = threading.Thread(target=self._accept_loop, daemon=True,
                              name="wam-pod-accept")
         t.start()
@@ -267,13 +375,22 @@ class PodRouter:
         env[AUTHKEY_ENV] = self._authkey.hex()
         return env
 
+    def _host_for_wid(self, wid: int) -> str:
+        """Spawn-time host assignment: round-robin over the configured
+        host groups (stable per wid, so a respawn stays on its host)."""
+        if self.hosts:
+            return self.hosts[wid % len(self.hosts)]
+        return self.host_label
+
     def _spawn_worker(self, wid: int, incarnation: int = 0) -> _Worker:
         """Launch one worker process and register its pending slot; the
         acceptor thread completes the handshake when its hello arrives."""
-        w = _Worker(wid, incarnation)
+        host = self._host_for_wid(wid)
+        w = _Worker(wid, incarnation, expected_host=host)
         with self._lock:
             self._workers[wid] = w
-        argv = [a.replace("{wid}", str(wid)) for a in self._worker_argv]
+        argv = [a.replace("{wid}", str(wid)).replace("{host}", host)
+                for a in self._worker_argv]
         argv += ["--connect", self.address, "--worker-id", str(wid)]
         w.t_spawn = time.perf_counter()
         w.proc = subprocess.Popen(argv, env=self._worker_env(),
@@ -308,33 +425,109 @@ class PodRouter:
         self._await_ready(w)
 
     def _accept_loop(self) -> None:
+        """Accept connections and hand each to its own preface thread —
+        a worker that streams the registry bundle and warms for seconds
+        before saying hello must not serialize every OTHER worker's
+        bring-up behind it."""
         while not self._stop.is_set():
             try:
                 conn = self._listener.accept()
             except (OSError, EOFError):
                 return  # listener closed
-            try:
-                hello = conn.recv()
-            except (OSError, EOFError):
-                conn.close()
-                continue
-            wid = hello.get("worker_id")
-            with self._lock:
-                w = self._workers.get(wid)
-            if hello.get("op") != "hello" or w is None or w.ready.is_set():
-                conn.close()
-                continue
-            w.chan = Channel(conn)
-            w.snapshot = hello.get("snapshot")
-            w.last_reply = time.monotonic()
-            w.alive = True
-            t = threading.Thread(target=self._receive_loop, args=(w,),
-                                 daemon=True,
-                                 name=f"wam-pod-recv-{wid}")
+            chan = conn if self.transport == "tcp" else Channel(conn)
+            t = threading.Thread(target=self._preface_loop, args=(chan,),
+                                 daemon=True, name="wam-pod-preface")
             t.start()
             with self._lock:
                 self._threads.append(t)
-            w.ready.set()
+
+    def _preface_loop(self, chan) -> None:
+        """One fresh connection: serve pre-hello registry probes, then
+        register the worker when its hello arrives."""
+        msg = None
+        try:
+            for _ in range(_MAX_PREFACE_MSGS):
+                msg = chan.recv()
+                if msg.get("op") != "registry_probe":
+                    break
+                files = self._load_wire_files()
+                with obs_tracing.span(
+                        "registry_stream", cat="pod",
+                        files=len(files),
+                        bytes=sum(len(v) for v in files.values())):
+                    chan.send({"op": "registry_bundle", "files": files})
+                self.metrics.note_registry_stream(
+                    sum(len(v) for v in files.values()))
+        except (OSError, EOFError):
+            chan.close()
+            return
+        if not isinstance(msg, dict) or msg.get("op") != "hello":
+            chan.close()
+            return
+        wid = msg.get("worker_id")
+        with self._lock:
+            w = self._workers.get(wid)
+        if w is None or w.ready.is_set():
+            chan.close()
+            return
+        w.chan = chan
+        w.snapshot = msg.get("snapshot")
+        w.snapshot_t = time.monotonic()
+        w.host = msg.get("host") or w.expected_host
+        hs_rtt = getattr(chan, "handshake_rtt_s", None)
+        if hs_rtt is not None:
+            # the HMAC proof round-trip is a free RTT sample: seed the
+            # host EMA and the clock offset so host-aware routing and
+            # the trace merge have signal before the first heartbeat
+            self._note_rtt(w, hs_rtt)
+            if w.snapshot is not None:
+                w.clock_offset_s = (time.perf_counter() - hs_rtt / 2.0
+                                    - w.snapshot.t_worker)
+        w.last_reply = time.monotonic()
+        w.alive = True
+        t = threading.Thread(target=self._receive_loop, args=(w,),
+                             daemon=True,
+                             name=f"wam-pod-recv-{wid}")
+        t.start()
+        with self._lock:
+            self._threads.append(t)
+        w.ready.set()
+
+    def _load_wire_files(self) -> dict:
+        """The registry bundle as {relpath: bytes}, read once and cached
+        — what ``registry_probe`` streams (raw byte frames on the tcp
+        transport; nothing re-reads the directory per worker)."""
+        with self._lock:
+            if self._wire_files is not None:
+                return self._wire_files
+        files: dict[str, bytes] = {}
+        if self.registry:
+            base = os.path.abspath(self.registry)
+            for dirpath, _, names in os.walk(base):
+                for name in sorted(names):
+                    path = os.path.join(dirpath, name)
+                    rel = os.path.relpath(path, base).replace(os.sep, "/")
+                    try:
+                        with open(path, "rb") as fh:
+                            files[rel] = fh.read()
+                    except OSError:
+                        continue  # torn/vanished file: per-artifact miss
+        with self._lock:
+            if self._wire_files is None:
+                self._wire_files = files
+            return self._wire_files
+
+    def _note_rtt(self, w: _Worker, rtt_s: float) -> None:
+        host = w.host or w.expected_host
+        with self._lock:
+            prev = self._host_rtt.get(host)
+            ema = (rtt_s if prev is None
+                   else (1.0 - _RTT_EMA_ALPHA) * prev + _RTT_EMA_ALPHA * rtt_s)
+            self._host_rtt[host] = ema
+            prev_min = self._host_rtt_min.get(host)
+            self._host_rtt_min[host] = (rtt_s if prev_min is None
+                                        else min(prev_min, rtt_s))
+        self.metrics.note_host_rtt(host, ema)
 
     def close(self, emit_metrics: bool = True) -> None:
         with self._lock:
@@ -371,7 +564,8 @@ class PodRouter:
             from wam_tpu.results import JsonlWriter
 
             self.metrics.emit(JsonlWriter(self.metrics_path),
-                              config=self.describe(), workers=workers)
+                              config=self.describe(), workers=workers,
+                              hosts=self.host_summary())
         with self._lock:
             self._started = False
 
@@ -392,6 +586,10 @@ class PodRouter:
             "supervised": self._supervisor is not None,
             "autoscaled": self._autoscaler is not None,
             "heartbeat_s": self.heartbeat_s,
+            "transport": self.transport,
+            "host_label": self.host_label,
+            "hosts": self.hosts,
+            "wire_registry": bool(self.registry),
             "worker_argv": self._worker_argv,
         }
 
@@ -418,6 +616,16 @@ class PodRouter:
                     except OSError:
                         pass
                     continue
+                if (w.health_pending_t is not None
+                        and now - w.health_pending_t
+                        < self.heartbeat_timeout_s):
+                    # probe still unanswered: coalesce — a worker busy
+                    # with a batch answers ONE probe when it surfaces,
+                    # not a backlog of stale ones (death detection rides
+                    # last_reply silence either way)
+                    self.metrics.note_heartbeat_coalesced()
+                    continue
+                w.health_pending_t = now
                 try:
                     w.chan.send({"op": "health", "t_send": time.perf_counter()})
                 except OSError:
@@ -446,8 +654,11 @@ class PodRouter:
                     w.best_rtt_s = rtt
                     w.clock_offset_s = (msg["t_send"] + rtt / 2.0
                                         - msg["t_worker"])
+                self._note_rtt(w, rtt)
                 w.snapshot = msg["snapshot"]
+                w.snapshot_t = time.monotonic()
                 w.last_reply = time.monotonic()
+                w.health_pending_t = None
             elif op == "bye":
                 w.final_snapshot = msg.get("snapshot")
                 w.spans = msg.get("spans") or []
@@ -504,10 +715,36 @@ class PodRouter:
             return False
         return True
 
+    def kill_host(self, host: str) -> list[int]:
+        """SIGKILL every live worker on one host label — the host-level
+        chaos fault (rack loss, host OOM, a pulled network cable as far
+        as this router can tell). Detection, in-flight re-route, and
+        supervised respawn all run the per-worker death paths; returns
+        the wids killed."""
+        with self._lock:
+            victims = [w for w in self._workers.values()
+                       if w.alive and self._worker_host(w) == host]
+        killed = []
+        for w in victims:
+            if w.proc is None:
+                continue
+            try:
+                w.proc.kill()
+            except OSError:
+                continue
+            killed.append(w.wid)
+        return killed
+
     def live_worker_ids(self) -> list[int]:
         with self._lock:
             return sorted(w.wid for w in self._workers.values()
                           if w.alive and not w.draining)
+
+    def live_hosts(self) -> list[str]:
+        with self._lock:
+            return sorted({self._worker_host(w)
+                           for w in self._workers.values()
+                           if w.alive and not w.draining})
 
     # -- autoscaler surface -------------------------------------------------
 
@@ -608,16 +845,40 @@ class PodRouter:
 
     # -- routing ------------------------------------------------------------
 
+    def _worker_host(self, w: _Worker) -> str:
+        return w.host or w.expected_host
+
+    def _respawn_hints_by_host(self) -> dict:
+        """host label -> seconds until that host plausibly serves again
+        (its soonest pending respawn's backoff ETA + the spawn-time
+        EMA). Only hosts with an in-flight respawn appear."""
+        if self._supervisor is None:
+            return {}
+        with self._lock:
+            by_host: dict[str, list[int]] = {}
+            for w in self._workers.values():
+                by_host.setdefault(self._worker_host(w), []).append(w.wid)
+            spawn_ema = self._spawn_ema_s
+        hints = {}
+        for host, wids in by_host.items():
+            eta = self._supervisor.pending_eta_s(wids=wids)
+            if eta is not None:
+                hints[host] = max(0.0, eta) + spawn_ema
+        return hints
+
     def _respawn_hint_s(self) -> float | None:
-        """How long until a worker is plausibly serving again: the
-        supervisor's pending-restart ETA plus the spawn-time EMA. None
-        when unsupervised (nobody is coming back)."""
+        """How long until SOME host is plausibly serving again: the
+        per-host respawn ETAs min-reduced across hosts. None when
+        unsupervised (nobody is coming back)."""
         if self._supervisor is None:
             return None
-        eta = self._supervisor.pending_eta_s()
-        if eta is None and not self._supervisor.any_restartable():
+        hints = self._respawn_hints_by_host()
+        if hints:
+            return min(hints.values())
+        if not self._supervisor.any_restartable():
             return None
-        return max(0.0, eta or 0.0) + self._spawn_ema_s
+        with self._lock:
+            return self._spawn_ema_s
 
     def _score(self, w: _Worker, bkey: str) -> float:
         s = w.snapshot
@@ -633,7 +894,17 @@ class PodRouter:
         # same discipline the in-process fleet applies per replica
         # (serve.fleet.INTERACTIVE_DEPTH_WEIGHT) lifted one tier up
         interactive_depth = (s.qos_depth or {}).get("interactive", 0)
-        return (s.projected_drain_s + inflight * ema + s.slo_penalty_s
+        # age the drain estimate: a worker that reported 80ms of queue
+        # 80ms ago has worked it off by now.  Without the decay a
+        # just-freed worker keeps its stale mid-batch drain and loses
+        # routes to a mid-batch peer whose heartbeat predates its batch
+        # (drain 0), parking requests behind a live batch for a full
+        # extra service cycle.  Work routed since the snapshot is the
+        # inflight term's job, so decaying only the reported drain
+        # cannot under-count.
+        drain = max(0.0, s.projected_drain_s
+                    - (time.monotonic() - w.snapshot_t))
+        return (drain + inflight * ema + s.slo_penalty_s
                 + INTERACTIVE_DEPTH_WEIGHT * interactive_depth * ema)
 
     def _route(self, req: _PodRequest, raise_errors: bool) -> None:
@@ -655,9 +926,16 @@ class PodRouter:
                      if w.alive and not w.draining and w.ready.is_set()
                      and w.wid not in req.tried]
         if not cands:
-            if req.min_retry_after is not None:
-                # every live worker rejected: aggregated backpressure
-                return _fail(QueueFullError(req.min_retry_after))
+            if req.retry_after_by_host:
+                # every live worker rejected: per-host tightest
+                # estimates, min-reduced ACROSS hosts — and a dead
+                # host's respawn ETA competes too, in case the pod is
+                # back before any live host drains
+                ra = min(req.retry_after_by_host.values())
+                hints = self._respawn_hints_by_host()
+                if hints:
+                    ra = min(ra, min(hints.values()))
+                return _fail(QueueFullError(ra))
             return _fail(NoLiveWorkerError(
                 "no live pod worker left for this request",
                 retry_after_s=self._respawn_hint_s()))
@@ -668,19 +946,50 @@ class PodRouter:
                     DeadlineExceededError("deadline lapsed during re-route"))
         else:
             remaining_ms = None
-        cands.sort(key=lambda w: (self._score(w, req.bkey), w.wid))
-        quarantined = {w.wid: (w.snapshot.quarantined if w.snapshot else False)
-                       for w in cands}
-        if any(quarantined.values()):
-            cands = ([w for w in cands if not quarantined[w.wid]]
-                     + [w for w in cands if quarantined[w.wid]])
-        for w in cands:
-            with w.inflight_lock:
-                if not w.alive:
-                    continue
-                w.inflight[req.req_id] = req
+        with self._lock:
+            host_rtt = dict(self._host_rtt_min)
+
+        def _key(w: _Worker):
+            host = self._worker_host(w)
+            local = host == self.host_label
+            s = w.snapshot
+            # a heartbeat-reported full queue means this hop will bounce
+            # with QueueFullError — now a network round-trip, so workers
+            # with room (on any host) come first
+            full = s is not None and s.queue_free == 0
+            # locality is a SCORE penalty, not a hard tier: a remote
+            # worker wins exactly when it is cheaper by more than the
+            # wire (that host's congestion-free MIN RTT — queueing is
+            # the drain term's job). A hard tier would starve remote
+            # hosts whenever local workers merely have queue room.
+            penalty = 0.0 if local else host_rtt.get(host, 0.0)
+            return (full, self._score(w, req.bkey) + penalty, w.wid)
+
+        while cands:
+            # score->choose->inflight-insert is atomic under _route_lock
+            # so concurrent submits see each other's inflight and a
+            # burst spreads evenly; the send itself happens outside so
+            # payload writes to different workers still overlap
+            with self._route_lock:
+                cands.sort(key=_key)
+                quarantined = {
+                    w.wid: (w.snapshot.quarantined if w.snapshot else False)
+                    for w in cands}
+                if any(quarantined.values()):
+                    cands = ([w for w in cands if not quarantined[w.wid]]
+                             + [w for w in cands if quarantined[w.wid]])
+                chosen = None
+                for w in cands:
+                    with w.inflight_lock:
+                        if not w.alive:
+                            continue
+                        w.inflight[req.req_id] = req
+                    chosen = w
+                    break
+            if chosen is None:
+                break
             try:
-                w.chan.send({
+                chosen.chan.send({
                     "op": "submit", "req_id": req.req_id, "x": req.x,
                     "y": req.y, "deadline_ms": remaining_ms, "ctx": req.ctx,
                     "qos": req.qos,
@@ -689,8 +998,9 @@ class PodRouter:
                 # died between the candidate snapshot and the send: undo
                 # and fall through to the next candidate (its death path
                 # runs via the receiver/heartbeat threads)
-                with w.inflight_lock:
-                    w.inflight.pop(req.req_id, None)
+                with chosen.inflight_lock:
+                    chosen.inflight.pop(req.req_id, None)
+                cands.remove(chosen)
                 continue
             return
         return _fail(NoLiveWorkerError(
@@ -709,11 +1019,14 @@ class PodRouter:
         exc = decode_error(msg.get("error") or {})
         if isinstance(exc, QueueFullError):
             # worker-level backpressure: try the rest of the pod, keeping
-            # the smallest retry_after offered (fleet aggregation rule)
+            # the smallest retry_after PER HOST (the terminal error
+            # min-reduces across hosts — fleet aggregation one tier up)
             req.tried.add(w.wid)
             ra = getattr(exc, "retry_after_s", None) or 0.0
-            req.min_retry_after = (ra if req.min_retry_after is None
-                                   else min(req.min_retry_after, ra))
+            host = self._worker_host(w)
+            cur = req.retry_after_by_host.get(host)
+            req.retry_after_by_host[host] = (ra if cur is None
+                                             else min(cur, ra))
             self._route(req, raise_errors=False)
             return
         if isinstance(exc, ServerClosedError):
@@ -732,6 +1045,36 @@ class PodRouter:
             workers = list(self._workers.values())
         return self.metrics.pod_summary(workers)
 
+    def host_summary(self) -> list[dict]:
+        """One row per host group: worker counts, completed work, the
+        RTT estimates (EMA for path health, min for the routing
+        penalty), and any pending respawn ETA — the ``pod_host``
+        ledger rows."""
+        with self._lock:
+            workers = list(self._workers.values())
+            host_rtt = dict(self._host_rtt)
+            host_rtt_min = dict(self._host_rtt_min)
+        hints = self._respawn_hints_by_host()
+        rows: dict[str, dict] = {}
+        for w in sorted(workers, key=lambda w: w.wid):
+            host = self._worker_host(w)
+            row = rows.setdefault(host, {
+                "host": host,
+                "local": host == self.host_label,
+                "workers": 0,
+                "alive": 0,
+                "completed": 0,
+                "rtt_ema_s": host_rtt.get(host),
+                "rtt_min_s": host_rtt_min.get(host),
+                "respawn_eta_s": hints.get(host),
+            })
+            row["workers"] += 1
+            row["alive"] += int(w.alive)
+            s = w.final_snapshot if w.final_snapshot is not None else w.snapshot
+            if s is not None:
+                row["completed"] += s.completed
+        return list(rows.values())
+
     def trace_events(self) -> list[dict]:
         """Worker spans shipped at close, re-based onto the router's
         perf_counter via each worker's heartbeat clock offset — ready for
@@ -744,7 +1087,13 @@ class PodRouter:
                 continue
             pid = (w.final_snapshot.pid if w.final_snapshot is not None
                    else (w.proc.pid if w.proc is not None else -w.wid))
+            host = self._worker_host(w)
+            name = f"pod-worker-{w.wid}"
+            if host != self.host_label:
+                # cross-host worker: carry the host in the Perfetto
+                # process label so one merged trace reads as a pod map
+                name += f"@{host}"
             events.extend(obs_tracing.spans_to_events(
                 w.spans, pid=pid, clock_offset_s=w.clock_offset_s,
-                process_name=f"pod-worker-{w.wid}"))
+                process_name=name))
         return events

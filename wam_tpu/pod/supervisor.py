@@ -138,16 +138,20 @@ class PodSupervisor:
 
     # -- retry-hint surface (NoLiveWorkerError.retry_after_s) ---------------
 
-    def pending_eta_s(self) -> float | None:
+    def pending_eta_s(self, wids=None) -> float | None:
         """Seconds until the NEAREST in-flight respawn finishes its
         backoff (0.0 when one is already warming), or None when nothing
-        is respawning right now."""
+        is respawning right now. ``wids`` restricts to a subset of
+        workers — the router computes PER-HOST respawn ETAs with it and
+        min-reduces across hosts for the retry hints."""
         with self._lock:
-            if not self._pending_eta:
+            etas = (self._pending_eta.values() if wids is None
+                    else [eta for wid, eta in self._pending_eta.items()
+                          if wid in set(wids)])
+            if not etas:
                 return None
             now = time.monotonic()
-            return max(0.0, min(eta - now for eta in
-                                self._pending_eta.values()))
+            return max(0.0, min(eta - now for eta in etas))
 
     def any_restartable(self) -> bool:
         """Whether at least one known worker could still come back (i.e.

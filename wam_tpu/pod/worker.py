@@ -72,7 +72,12 @@ def _parse(argv):
     p.add_argument("--aot-key-base", default="",
                    help="AOT-key the toy entry (registry/executable cache)")
     p.add_argument("--registry", default="",
-                   help="compile-artifact bundle to hydrate before warmup")
+                   help="compile-artifact bundle to hydrate before warmup; "
+                        "the literal 'wire' streams it from the router "
+                        "over the control channel instead (tcp transport)")
+    p.add_argument("--host-label", default="",
+                   help="host-group identity self-reported at hello "
+                        "(routers spawn with --host-label {host})")
     p.add_argument("--chaos", default="",
                    help="in-process fault spec (wam_tpu.testing.faults)")
     p.add_argument("--slo", default="")
@@ -110,9 +115,11 @@ class _FakeEntry:
         return np.zeros(shape, np.float32)
 
 
-def build_worker_server(args, fleet_metrics):
+def build_worker_server(args, fleet_metrics, registry=None):
     """Construct (not yet started) the worker's `FleetServer` from parsed
-    args — the same recipe for first spawn and supervisor respawns."""
+    args — the same recipe for first spawn and supervisor respawns.
+    ``registry`` overrides ``args.registry`` with an already-built
+    `RegistryClient` (the wire-streamed bundle path)."""
     import jax
 
     from wam_tpu.config import ServeConfig
@@ -167,7 +174,8 @@ def build_worker_server(args, fleet_metrics):
         metrics_path=args.metrics_path or None,
         slo=args.slo or None,
         supervise=SupervisorConfig(seed=args.seed),
-        registry=args.registry or None,
+        registry=registry if registry is not None
+        else (args.registry or None),
         auto_start=False,
     )
 
@@ -200,8 +208,26 @@ def main(argv=None) -> int:
     # merged pod trace never sees two spans with one id
     obs_tracing.namespace_ids(os.getpid())
 
+    # wire registry: dial the router BEFORE building the fleet, probe for
+    # the compile-artifact bundle, and hydrate from the streamed bytes —
+    # a cold host joins at compile_count == 0 without a shared filesystem.
+    # The same channel carries hello afterwards (one connection per worker).
+    chan = None
+    wire_registry = None
+    if args.registry == "wire":
+        from wam_tpu.registry.client import RegistryClient
+
+        chan = connect_to_router(args.connect)
+        chan.send({"op": "registry_probe", "worker_id": args.worker_id})
+        reply = chan.recv()
+        files = dict(reply.get("files") or {})
+        # dict lookup as the fetcher: a miss raises KeyError, which the
+        # client's silent-miss ladder treats as artifact-not-in-bundle
+        wire_registry = RegistryClient("wire://pod-router",
+                                       fetcher=files.__getitem__)
+
     fleet_metrics = FleetMetrics()
-    server = build_worker_server(args, fleet_metrics)
+    server = build_worker_server(args, fleet_metrics, registry=wire_registry)
     server.start()
     warm_s = time.perf_counter() - t_start
     warm_traces = obs_sentinel.trace_count()
@@ -215,6 +241,7 @@ def main(argv=None) -> int:
             projected_drain_s=sig["projected_drain_s"],
             ema_service_s=sig["ema_service_s"],
             qos_depth=sig.get("qos_depth", {}),
+            queue_free=sig.get("queue_free", -1),
             slo_penalty_s=sig["slo_penalty_s"],
             quarantined=sig["quarantined"],
             live_replicas=sig["live_replicas"],
@@ -226,11 +253,13 @@ def main(argv=None) -> int:
             warm_s=warm_s,
         )
 
-    chan = connect_to_router(args.connect)
+    if chan is None:
+        chan = connect_to_router(args.connect)
     chan.send({
         "op": "hello",
         "worker_id": args.worker_id,
         "pid": os.getpid(),
+        "host": args.host_label,
         "snapshot": snapshot(),
         "buckets": args.buckets,
     })

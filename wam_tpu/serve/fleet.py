@@ -554,6 +554,7 @@ class FleetServer:
             "projected_drain_s": min(
                 (r.server.projected_drain_s() for r in live), default=0.0),
             "qos_depth": qos_depth,
+            "queue_free": sum(r.server.admission_free() for r in live),
             "ema_service_s": ema,
             "slo_penalty_s": max(penalties, default=0.0),
             "quarantined": bool(live)

@@ -773,6 +773,15 @@ class AttributionServer:
                 "batch": sum(len(q.batch) for q in self._queues.values()),
             }
 
+    def admission_free(self) -> int:
+        """Free admission slots right now (``queue_depth - pending``,
+        floored at 0) — the pod heartbeat's ``queue_free`` signal: 0
+        means a submit would bounce `QueueFullError`, and the pod router
+        deprioritizes the hop (a reject costs a cross-host round-trip
+        on the tcp transport)."""
+        with self._cond:
+            return max(0, self.queue_depth - self._pending)
+
     def health_ok(self) -> bool:
         """Quarantine predicate for the fleet router: True when no health
         monitor is attached, the replica is healthy, or its quarantine has
