@@ -193,6 +193,48 @@ class _FakeAnytimeEntry:
         return self.finalize(state)[0]
 
 
+class _MixCostEntry:
+    """Trace-costed fake entry for ``--online-tune``: every input array
+    carries its own per-item cost (milliseconds) in its ``[0, 0, 0]``
+    corner cell and a unique request id in ``[0, 0, 1]``, so one entry
+    serves a light-then-heavy trace — the cost is a property of the
+    TRACE, not the server. A batch sleeps (GIL released) for
+
+        dispatch + c_max * (1 + beta * (n_unique - 1))
+
+    the accelerator batch model: one device dispatch, wall time pinned by
+    the heaviest lane, with a small per-real-row marginal ``beta``. Pad
+    rows replicate real rows, so counting UNIQUE ids prices only real
+    work — padding costs dispatch, not compute. Under this model per-item
+    service falls with batch size, which is exactly the amortization the
+    online tuner's challenger must rediscover from the ledger after the
+    mix shifts heavy."""
+
+    beta = 0.1
+
+    def __init__(self, metrics, dispatch_ms: float):
+        self._metrics = metrics
+        self._dispatch_s = dispatch_ms / 1e3
+        self._seen = set()
+        self._lock = threading.Lock()
+
+    def __call__(self, xs, ys):
+        import numpy as np
+
+        shape = tuple(int(d) for d in xs.shape)
+        with self._lock:
+            if shape not in self._seen:
+                self._seen.add(shape)
+                self._metrics.note_compile()
+        arr = np.asarray(xs)
+        ids = arr[:, 0, 0, 1]
+        n_unique = max(1, len(np.unique(ids)))
+        c_max = float(arr[:, 0, 0, 0].max()) / 1e3
+        time.sleep(self._dispatch_s
+                   + c_max * (1.0 + self.beta * (n_unique - 1)))
+        return np.zeros(shape, np.float32)
+
+
 def run_bench(cfg, args, n_fleet: int):
     """One bench point: build the server (fleet when n_fleet > 1), drive it
     with closed-loop clients, return (summary, fleet_summary|None)."""
@@ -881,6 +923,18 @@ def run_open_loop(cfg, args) -> int:
     rng = random.Random(args.seed * 7919 + 13)
     weights = [1.0 / (r + 1) ** zipf_a for r in range(pool_n)]
     ranks = rng.choices(range(pool_n), weights=weights, k=n_requests)
+    mix_shift_at = None
+    if args.mix_shift is not None:
+        # seeded mid-run re-skew: from the given completion fraction on,
+        # rotate every rank a third of the pool forward, so the Zipf hot
+        # set jumps to a previously-cold slice.  Deterministic (pure
+        # index arithmetic on the already-seeded ranks), and identical
+        # across arms — the shift is a property of the TRACE
+        frac = min(1.0, max(0.0, args.mix_shift))
+        mix_shift_at = int(n_requests * frac)
+        rot = max(1, pool_n // 3)
+        ranks = [r if i < mix_shift_at else (r + rot) % pool_n
+                 for i, r in enumerate(ranks)]
     qos_tags = ["interactive" if rng.random() < qos_frac else "batch"
                 for _ in range(n_requests)]
     gaps = [rng.expovariate(rps) for _ in range(n_requests)]
@@ -1048,6 +1102,8 @@ def run_open_loop(cfg, args) -> int:
         "open_window_ms": window_ms,
         "open_cache_mb": cache_mb,
         "seed": args.seed,
+        "mix_shift": args.mix_shift,
+        "mix_shift_at": mix_shift_at,
         "deadline_ms": arm_deadline_ms,
         "confidence_floor": floor if anytime_ab else None,
         "arms": [base, coal] + ([anyt] if anyt is not None else []),
@@ -1063,6 +1119,339 @@ def run_open_loop(cfg, args) -> int:
         print(f"open-loop gates FAILED: {', '.join(failed)}", file=sys.stderr)
         return 1
     print("open-loop gates passed: " + ", ".join(sorted(gates)))
+    return 0
+
+
+def run_online_tune(cfg, args) -> int:
+    """--online-tune: the round-19 acceptance A/B for online schedule
+    learning, end to end on a virtual 2-replica CPU fleet.
+
+    One seeded open-loop trace whose per-item cost RE-SKEWS mid-run
+    (light 2 ms items flip to heavy 40 ms at ``--mix-shift``, default
+    0.30), served by two arms:
+
+    - ``static`` — the fleet keeps its preset ``bucket_cap`` for the whole
+      trace (what every round so far would do).
+    - ``online`` — the full champion/challenger loop: serve under the
+      preset, mine the fleet's own ledger at the tune point
+      (`tune.mix.mine_rows`), raise the drift alarm, shadow-sweep a
+      challenger (`OnlineTuner.sweep`: wamlive + `plan_serve_schedule`),
+      canary it on the batch-QoS lane (`FleetServer.pin_canary`), and on
+      a win promote + publish the registry bundle, then serve the final
+      phase under the promoted cap.
+
+    Phases are index slices of the SAME trace (shift at 30%, tune at 55%,
+    adopt at 75%) with a drain barrier at every boundary in BOTH arms, so
+    the final phase [adopt, end) is a clean A/B window: identical heavy
+    traffic, only the admission cap differs. The `_MixCostEntry` cost
+    model (dispatch + c_max·(1 + β·(n_unique−1))) makes a larger cap a
+    REAL capacity win on heavy items — the thing the tuner must
+    rediscover from the ledger alone.
+
+    Gates: drift fires on the shifted window and stays quiet on the
+    unshifted prefix (control); the canary verdict is a win; the flip
+    lands as a ``schedule_promotion`` row; the online arm beats static on
+    final-phase interactive p99 OR >1.05x throughput; zero lost/rejected
+    in both arms; and a FRESH schedule cache hydrated from the published
+    bundle alone resolves the promoted cap at the promoted fingerprint."""
+    import tempfile
+    from concurrent.futures import wait as _futures_wait
+
+    import numpy as np
+
+    from wam_tpu import obs
+    from wam_tpu.results import JsonlWriter, read_jsonl_stats
+    from wam_tpu.serve import FleetMetrics, FleetServer
+    from wam_tpu.serve.metrics import percentile_ms
+    from wam_tpu.tune.cache import (
+        invalidate_process_cache,
+        resolve_bucket_cap,
+        schedule_fingerprint,
+        schedule_key,
+    )
+    from wam_tpu.tune.mix import drift_report, mine_rows
+    from wam_tpu.tune.online import OnlineTuneConfig, OnlineTuner
+
+    toy = args.toy
+    n_requests = (args.requests if args.requests is not None
+                  else (600 if toy else 2400))
+    rps = args.rps if args.rps is not None else 200.0
+    qos_frac = (args.qos_interactive if args.qos_interactive is not None
+                else 0.25)
+    shape = (1, 16, 16)
+    replicas = 2
+    cap0 = 4  # the static preset every phase starts from
+    max_cap = 16
+    dispatch_ms, light_ms, heavy_ms = 2.0, 2.0, 40.0
+    threshold = 1.5
+    margin = 0.05
+    min_canary = 6 if toy else 8
+    shift_frac = args.mix_shift if args.mix_shift is not None else 0.30
+    shift_at = int(n_requests * min(1.0, max(0.0, shift_frac)))
+    tune_at = int(n_requests * 0.55)
+    adopt_at = int(n_requests * 0.75)
+    if not shift_at < tune_at < adopt_at < n_requests:
+        print("online-tune: --mix-shift must leave room for the tune "
+              "(55%) and adopt (75%) points", file=sys.stderr)
+        return 2
+
+    # this harness PROMOTES schedules — point the process at a throwaway
+    # schedule cache before any resolution so the user's table stays clean
+    tmp = tempfile.mkdtemp(prefix="wam_online_r19_")
+    os.environ["WAM_TPU_SCHEDULE_CACHE"] = os.path.join(tmp, "schedules.json")
+    invalidate_process_cache()
+
+    # one seeded trace shared by both arms: gaps, QoS tags, per-item costs
+    rng = random.Random(args.seed * 104729 + 19)
+    gaps = [rng.expovariate(rps) for _ in range(n_requests)]
+    qos_tags = ["interactive" if rng.random() < qos_frac else "batch"
+                for _ in range(n_requests)]
+    costs = [light_ms if i < shift_at else heavy_ms
+             for i in range(n_requests)]
+
+    def _request(i):
+        x = np.zeros(shape, np.float32)
+        x[0, 0, 0] = costs[i]  # per-item cost (trace property)
+        x[0, 0, 1] = float(i + 1)  # unique id: pad replicas don't re-bill
+        return x
+
+    def _fleet(cap: int) -> FleetServer:
+        return FleetServer(
+            lambda rid, m: _MixCostEntry(m, dispatch_ms),
+            [shape],
+            replicas=replicas,
+            max_batch=cap,
+            max_wait_ms=5.0,
+            queue_depth=512,
+            warmup=False,  # fake entry: nothing to compile
+            compilation_cache=False,
+            metrics=FleetMetrics(),
+        )
+
+    def _serve_range(fleet: FleetServer, lo: int, hi: int) -> dict:
+        """Serve trace indices [lo, hi) open-loop, then BARRIER (drain all
+        futures) so every phase starts from an empty queue in both arms."""
+        lat: dict[str, list[float]] = {"interactive": [], "batch": []}
+        lock = threading.Lock()
+        futures = []
+        rejected = 0
+        t0 = time.perf_counter()
+        next_t = t0
+        for i in range(lo, hi):
+            next_t += gaps[i]
+            now = time.perf_counter()
+            if next_t > now:
+                time.sleep(next_t - now)
+            q = qos_tags[i]
+            t_sub = time.perf_counter()
+            try:
+                fut = fleet.submit(_request(i), i % 4, qos=q)
+            except Exception:
+                rejected += 1
+                continue
+
+            def _done(f, q=q, t=t_sub):
+                if f.exception() is None:
+                    with lock:
+                        lat[q].append(time.perf_counter() - t)
+
+            fut.add_done_callback(_done)
+            futures.append(fut)
+        done, not_done = _futures_wait(futures, timeout=300.0)
+        wall = time.perf_counter() - t0
+        served = hi - lo - rejected - len(not_done)
+        return {
+            "requests": hi - lo,
+            "wall_s": round(wall, 3),
+            "throughput_rps": round(served / wall, 2) if wall > 0 else 0.0,
+            "latency_by_qos": {
+                q: {"n": len(s),
+                    "p50_ms": round(percentile_ms(s, 50), 3),
+                    "p99_ms": round(percentile_ms(s, 99), 3)}
+                for q, s in sorted(lat.items())
+            },
+            "rejected": rejected,
+            "resolved_error": sum(1 for f in done
+                                  if f.exception() is not None),
+            "lost": len(not_done),
+        }
+
+    phases = [(0, shift_at), (shift_at, tune_at),
+              (tune_at, adopt_at), (adopt_at, n_requests)]
+
+    # -- static arm: the preset cap end to end ------------------------------
+    print(f"online-tune: static arm (cap {cap0}, {n_requests} requests)")
+    obs.reset()
+    fleet = _fleet(cap0)
+    static_phases = [_serve_range(fleet, lo, hi) for lo, hi in phases]
+    fleet.close(emit_metrics=False)
+
+    # -- online arm: mine -> drift -> sweep -> canary -> promote ------------
+    print("online-tune: online arm")
+    obs.reset()
+    mine_path = os.path.join(tmp, "serve_ledger.jsonl")
+    rows_path = os.path.join(tmp, "tuner_rows.jsonl")
+    bundle_dir = os.path.join(tmp, "bundle")
+    fleet = _fleet(cap0)
+    online_phases = [_serve_range(fleet, *phases[0])]
+    t_shift_wall = time.time()  # ledger rows timestamp with time.time()
+    online_phases.append(_serve_range(fleet, *phases[1]))
+
+    # tune barrier: the fleet's own rows become the miner's ledger
+    fleet.metrics.emit(JsonlWriter(mine_path))
+    rows, corrupt = read_jsonl_stats(mine_path)
+    tuner = OnlineTuner(
+        OnlineTuneConfig(
+            ledger=mine_path,
+            out_ledger=rows_path,
+            drift_threshold=threshold,
+            replicas=replicas,
+            max_cap=max_cap,
+            default_cap=cap0,
+            n_samples=2 if toy else 4,
+            sweep_k=1 if toy else 2,
+            sweep_laps=1,
+            promote_margin=margin,
+            canary_min_batches=min_canary,
+            challenger_path=os.path.join(tmp, "challenger.json"),
+            bundle_dir=bundle_dir,
+            bundle_aot_keys=[],  # schedules-only bundle: a cap flip
+            # invalidates no compiled code
+        ),
+        log=lambda s: print(f"  [tuner] {s}"))
+    # control first (gauges end on the REAL drift values): the unshifted
+    # prefix of the same ledger must not alarm
+    pre_mix = mine_rows(
+        [r for r in rows if float(r.get("timestamp", 0.0)) <= t_shift_wall],
+        source="control:pre-shift", corrupt=corrupt)
+    control = (drift_report(pre_mix, threshold=threshold,
+                            predictions=tuner.predictions(pre_mix))
+               if pre_mix else {"drifted": [], "worst_ratio": 1.0})
+    full_mix = mine_rows(rows, source=mine_path, corrupt=corrupt)
+    drift = tuner.detect_drift(full_mix)
+    # the challenger is tuned for what the fleet serves NOW: post-shift only
+    post_mix = mine_rows(
+        [r for r in rows if float(r.get("timestamp", 0.0)) > t_shift_wall],
+        source="online:post-shift", corrupt=corrupt)
+    challenger = tuner.sweep(post_mix if post_mix is not None else full_mix)
+    champion_fp = schedule_fingerprint()
+    serve_key = schedule_key("serve", shape, replicas)
+    new_cap = int(challenger["entries"].get(serve_key, {}).get(
+        "bucket_cap", cap0))
+    print(f"online-tune: canary cap {cap0} -> {new_cap} "
+          f"(challenger {challenger['fingerprint']})")
+    fleet.pin_canary(challenger["fingerprint"],
+                     overrides={"max_batch": new_cap})
+    online_phases.append(_serve_range(fleet, *phases[2]))
+    verdict = fleet.canary_report(min_batches=min_canary, margin=margin)
+    verdict.setdefault("champion_fp", champion_fp)
+    verdict["challenger_fp"] = challenger["fingerprint"]
+    print(f"online-tune: canary verdict {verdict.get('verdict')} "
+          f"(improvement {verdict.get('improvement', 0.0):+.1%})")
+    promoted = None
+    if verdict.get("win"):
+        promoted = tuner.promote(challenger, verdict)
+        fleet.close(emit_metrics=False)
+        # rebuild exactly the way a worker restart would: resolve the cap
+        # from the (now promoted) schedule table, nothing hand-carried
+        cap_final = resolve_bucket_cap("auto", shape, replicas=replicas,
+                                       default=cap0)
+        fleet = _fleet(cap_final)
+    else:
+        fleet.clear_canary()
+        cap_final = cap0
+    online_phases.append(_serve_range(fleet, *phases[3]))
+    fleet.close(emit_metrics=False)
+
+    # -- reproducibility: a fresh cache + the bundle alone == the winner ----
+    repro: dict = {"checked": False}
+    if promoted is not None:
+        from wam_tpu.registry import RegistryClient
+
+        os.environ["WAM_TPU_SCHEDULE_CACHE"] = os.path.join(
+            tmp, "hydrated_schedules.json")
+        invalidate_process_cache()
+        report = RegistryClient(bundle_dir).hydrate()
+        cap_h = resolve_bucket_cap("auto", shape, replicas=replicas,
+                                   default=cap0)
+        fp_h = schedule_fingerprint()
+        repro = {
+            "checked": True,
+            "schedules_added": report.schedules_added,
+            "cap": cap_h,
+            "cap_matches": cap_h == new_cap,
+            "fingerprint_matches": fp_h == promoted["live_fingerprint"],
+        }
+
+    tuner_rows, _ = (read_jsonl_stats(rows_path)
+                     if os.path.exists(rows_path) else ([], 0))
+    drift_rows = [r for r in tuner_rows
+                  if r.get("metric") == "schedule_drift"]
+    promo_rows = [r for r in tuner_rows
+                  if r.get("metric") == "schedule_promotion"]
+    fin_s, fin_o = static_phases[3], online_phases[3]
+    p99_s = fin_s["latency_by_qos"]["interactive"]["p99_ms"]
+    p99_o = fin_o["latency_by_qos"]["interactive"]["p99_ms"]
+    lost = sum(p["lost"] + p["rejected"] + p["resolved_error"]
+               for p in static_phases + online_phases)
+    gates = {
+        "drift_fired": bool(drift["drifted"]) and bool(drift_rows),
+        "drift_quiet_on_control": not control["drifted"],
+        "canary_win": bool(verdict.get("win")),
+        "promotion_recorded": bool(promo_rows),
+        "online_beats_static": (
+            p99_o < p99_s
+            or fin_o["throughput_rps"] > 1.05 * fin_s["throughput_rps"]),
+        "zero_lost": lost == 0,
+        "bundle_reproduces": bool(repro.get("cap_matches")
+                                  and repro.get("fingerprint_matches")),
+    }
+    payload = {
+        "bench": "bench_serve_online_tune",
+        "device": cfg.device,
+        "replicas": replicas,
+        "shape": list(shape),
+        "requests": n_requests,
+        "rps": rps,
+        "qos_interactive_frac": qos_frac,
+        "dispatch_ms": dispatch_ms,
+        "cost_ms": {"light": light_ms, "heavy": heavy_ms},
+        "phase_at": {"shift": shift_at, "tune": tune_at, "adopt": adopt_at},
+        "cap": {"static": cap0, "promoted": new_cap, "final": cap_final},
+        "seed": args.seed,
+        "drift": {"worst_ratio": round(drift["worst_ratio"], 3),
+                  "drifted": drift["drifted"],
+                  "control_worst_ratio": round(control["worst_ratio"], 3)},
+        "mix": full_mix.to_dict() if full_mix else None,
+        "challenger": {k: challenger[k]
+                       for k in ("fingerprint", "keys", "sweep")},
+        "verdict": verdict,
+        "promotion": (promoted["row"] if promoted else None),
+        "repro": repro,
+        "arms": {"static": {"phases": static_phases},
+                 "online": {"phases": online_phases}},
+        "final_phase": {
+            "static": {"throughput_rps": fin_s["throughput_rps"],
+                       "interactive_p99_ms": p99_s},
+            "online": {"throughput_rps": fin_o["throughput_rps"],
+                       "interactive_p99_ms": p99_o},
+        },
+        "ledgers": {"mined": mine_path, "tuner_rows": rows_path,
+                    "bundle": bundle_dir},
+        "gates": gates,
+    }
+    print(json.dumps(payload["final_phase"], indent=2))
+    if args.emit:
+        os.makedirs(os.path.dirname(args.emit) or ".", exist_ok=True)
+        with open(args.emit, "w") as f:
+            json.dump(payload, f, indent=2)
+        print(f"emitted: {args.emit}")
+    failed = [k for k, ok in gates.items() if not ok]
+    if failed:
+        print(f"online-tune gates FAILED: {', '.join(failed)}",
+              file=sys.stderr)
+        return 1
+    print("online-tune gates passed: " + ", ".join(sorted(gates)))
     return 0
 
 
@@ -1552,18 +1941,23 @@ def _pre_scan_fleet(argv):
     pre.add_argument("--fleet", type=int, default=1)
     pre.add_argument("--fleet-sweep", type=str, default="")
     pre.add_argument("--device", type=str, default="auto")
+    pre.add_argument("--online-tune", action="store_true")
     known, _ = pre.parse_known_args(argv)
     sweep = (
         [int(s) for s in known.fleet_sweep.split(",") if s.strip()]
         if known.fleet_sweep
         else [max(1, known.fleet)]
     )
-    return sweep, known.device
+    if known.online_tune:
+        # the online-tune A/B serves on a 2-replica virtual CPU fleet
+        sweep = [max(2, max(sweep))]
+    return sweep, known.device, known.online_tune
 
 
 def main():
-    sweep, device = _pre_scan_fleet(sys.argv[1:])
-    cpu_fleet = max(sweep) > 1 and device in ("cpu", "auto")
+    sweep, device, online_tune = _pre_scan_fleet(sys.argv[1:])
+    cpu_fleet = ((max(sweep) > 1 or online_tune)
+                 and device in ("cpu", "auto"))
     if cpu_fleet:
         # virtual multi-device CPU platform; must precede any jax import
         _force_host_devices(max(sweep))
@@ -1653,6 +2047,19 @@ def main():
     parser.add_argument("--open-cache-mb", type=float, default=None,
                         help="open-loop coalesced-arm result-cache budget "
                              "(default 1.0; --toy 0.05)")
+    parser.add_argument("--mix-shift", type=float, default=None,
+                        metavar="FRAC",
+                        help="re-skew the trace mid-run at this completion "
+                             "fraction: --open-loop rotates the Zipf hot "
+                             "set a third of the pool forward; "
+                             "--online-tune flips per-item cost light -> "
+                             "heavy (its default 0.30)")
+    parser.add_argument("--online-tune", action="store_true",
+                        help="round-19 acceptance A/B: static-preset fleet "
+                             "vs the full online-tuning loop (ledger mine "
+                             "-> drift alarm -> shadow sweep -> canary -> "
+                             "bundle promotion) over one cost-shifted "
+                             "open-loop trace on a 2-replica CPU fleet")
     parser.add_argument("--emit", type=str, default="",
                         help="write the sweep/summary JSON here")
     parser.add_argument("--obs", choices=("on", "off"), default="on",
@@ -1719,6 +2126,9 @@ def main():
 
     if args.wire:
         return run_wire_bench(args)
+
+    if args.online_tune:
+        return run_online_tune(cfg, args)
 
     if args.open_loop:
         return run_open_loop(cfg, args)

@@ -1,0 +1,562 @@
+"""Online schedule learning (`wam_tpu/tune/mix.py`, `wam_tpu/tune/online.py`)
+plus its serving hooks: ledger mining under torn lines, the two-sided drift
+alarm (fires on a shifted mix, quiet on the unshifted control — the round-19
+acceptance pin), the mix-synthesized ``wamlive`` preset's determinism, the
+pure canary verdict, `plan_serve_schedule` grow/shrink with replica-count
+keying, the `OnlineTuner` kill switch, fingerprint stamping on ``serve_batch``
+rows, `FleetServer.pin_canary` routing + report, the autoscaler's cache-hit
+drain discount, and promote → bundle → hydrate reproducibility.
+
+Mining/drift/verdict tests are pure (synthetic rows, no fleet, no clocks
+beyond row timestamps); the fleet tests use gated fake entries per
+tests/test_fleet.py discipline."""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from conftest import need_devices
+from wam_tpu.results import JsonlWriter, LedgerCorruptWarning
+from wam_tpu.serve import FleetServer, ServeMetrics
+from wam_tpu.tune.cache import (
+    entries_fingerprint,
+    invalidate_process_cache,
+    load_schedule_cache,
+    resolve_bucket_cap,
+    schedule_fingerprint,
+    schedule_key,
+)
+from wam_tpu.tune.mix import (
+    DEFAULT_DRIFT_THRESHOLD,
+    drift_report,
+    mine_ledger,
+    mine_rows,
+)
+from wam_tpu.tune.online import (
+    ONLINE_TUNE_ENV,
+    OnlineTuneConfig,
+    OnlineTuner,
+    canary_verdict,
+    plan_serve_schedule,
+)
+
+
+@pytest.fixture
+def sched_cache(tmp_path, monkeypatch):
+    """Isolated user-layer schedule cache (same fixture as test_tune.py)."""
+    path = tmp_path / "schedules.json"
+    monkeypatch.setenv("WAM_TPU_SCHEDULE_CACHE", str(path))
+    monkeypatch.delenv("WAM_TPU_NO_SCHEDULE_CACHE", raising=False)
+    monkeypatch.delenv(ONLINE_TUNE_ENV, raising=False)
+    invalidate_process_cache()
+    yield path
+    invalidate_process_cache()
+
+
+def _row(ts, n_real=4, service_s=0.054, shape=(1, 16, 16), max_batch=4,
+         queue_depth=0, qos=None, fp=None):
+    r = {
+        "metric": "serve_batch",
+        "bucket": list(shape),
+        "n_real": n_real,
+        "fill_ratio": n_real / max_batch,
+        "occupancy": n_real / max_batch,
+        "pad_waste": 0.0,
+        "queue_depth": queue_depth,
+        "service_s": service_s,
+        "timestamp": ts,
+    }
+    if qos:
+        r["qos"] = qos
+    if fp:
+        r["schedule_fingerprint"] = fp
+    return r
+
+
+def _shifted_rows(n_light=30, n_heavy=10, t0=1000.0):
+    """A light-era run (1-row batches, 4 ms/item) that re-skews heavy
+    (full 4-row batches, 13.5 ms/item, standing queue) — the same shape as
+    the bench's --mix-shift trace."""
+    rows = [_row(t0 + i, n_real=1, service_s=0.004, queue_depth=0)
+            for i in range(n_light)]
+    rows += [_row(t0 + n_light + i, n_real=4, service_s=0.054,
+                  queue_depth=8) for i in range(n_heavy)]
+    return rows
+
+
+# -- ledger mining ------------------------------------------------------------
+
+
+def test_mine_rows_histograms_single_bucket():
+    rows = [
+        _row(1.0, n_real=2, service_s=0.02, qos={"interactive": 1, "batch": 1},
+             fp="aaaa"),
+        _row(2.0, n_real=4, service_s=0.04, qos={"batch": 4}, fp="aaaa"),
+        _row(3.0, n_real=4, service_s=0.04, qos={"batch": 4}, fp="bbbb"),
+    ]
+    mix = mine_rows(rows)
+    assert mix.rows == 3 and mix.corrupt_lines == 0
+    assert mix.window == (1.0, 3.0)
+    assert set(mix.buckets) == {"1x16x16"}
+    b = mix.buckets["1x16x16"]
+    assert b.batches == 3 and b.items == 10
+    assert b.mean_batch == pytest.approx(10 / 3)
+    assert b.mean_per_item_s == pytest.approx(0.01)
+    assert b.qos == {"interactive": 1, "batch": 9}
+    assert mix.qos == {"interactive": 1, "batch": 9}
+    assert mix.fingerprints == {"aaaa": 2, "bbbb": 1}
+    assert mix.weights() == {"1x16x16": 1.0}
+    # to_dict is the JSON body the tuner reports — must round-trip json
+    assert json.loads(json.dumps(mix.to_dict()))["total_items"] == 10
+
+
+def test_mine_rows_skips_foreign_and_incomplete_rows():
+    rows = [
+        {"metric": "serve_summary", "timestamp": 1.0},
+        {"metric": "serve_batch", "timestamp": 2.0},  # no n_real
+        {"metric": "serve_batch", "n_real": 3},  # no timestamp
+        _row(5.0),
+    ]
+    mix = mine_rows(rows)
+    assert mix.rows == 1
+    assert mine_rows([{"metric": "serve_summary"}]) is None
+    assert mine_rows([]) is None
+
+
+def test_mine_rows_window_anchored_at_latest_row():
+    rows = [_row(float(t)) for t in (0.0, 50.0, 95.0, 100.0)]
+    mix = mine_rows(rows, window_s=10.0)
+    # the window is the ledger's own clock: [latest - 10, latest]
+    assert mix.rows == 2 and mix.window == (95.0, 100.0)
+
+
+def test_mine_ledger_tolerates_torn_lines(tmp_path):
+    path = tmp_path / "serve.jsonl"
+    w = JsonlWriter(str(path))
+    for r in (_row(1.0), _row(2.0)):
+        w.write(r)
+    with open(path, "a") as f:
+        f.write('{"metric": "serve_batch", "n_real": 4, "torn...\n')
+    with pytest.warns(LedgerCorruptWarning):
+        mix = mine_ledger(str(path))
+    assert mix.rows == 2 and mix.corrupt_lines == 1
+
+
+def test_mine_ledger_missing_or_empty(tmp_path):
+    assert mine_ledger(str(tmp_path / "absent.jsonl")) is None
+    empty = tmp_path / "empty.jsonl"
+    empty.write_text("")
+    assert mine_ledger(str(empty)) is None
+
+
+# -- drift detection ----------------------------------------------------------
+
+
+def test_drift_fires_on_shift_quiet_on_control():
+    """The acceptance pin: the same detector that alarms on the re-skewed
+    window must stay quiet on the unshifted prefix of the SAME ledger."""
+    rows = _shifted_rows()
+    shifted = drift_report(mine_rows(rows))
+    assert shifted["drifted"] == ["1x16x16"]
+    b = shifted["buckets"]["1x16x16"]
+    assert b["source"] == "self" and b["ratio"] > DEFAULT_DRIFT_THRESHOLD
+    assert shifted["worst_ratio"] == b["ratio"]
+    control = drift_report(mine_rows(rows[:30]))
+    assert control["drifted"] == []
+    assert control["buckets"]["1x16x16"]["ratio"] == pytest.approx(1.0)
+
+
+def test_drift_is_two_sided():
+    # heavy era first, then light: observed/baseline < 1/threshold is
+    # drift too (the schedule now over-provisions)
+    rows = [_row(float(i), n_real=4, service_s=0.054) for i in range(30)]
+    rows += [_row(30.0 + i, n_real=1, service_s=0.004) for i in range(10)]
+    rep = drift_report(mine_rows(rows))
+    assert rep["drifted"] == ["1x16x16"]
+    assert rep["buckets"]["1x16x16"]["ratio"] < 1.0 / DEFAULT_DRIFT_THRESHOLD
+
+
+def test_drift_needs_min_batches():
+    rep = drift_report(mine_rows(_shifted_rows(n_light=3, n_heavy=2)),
+                       min_batches=6)
+    b = rep["buckets"]["1x16x16"]
+    assert rep["drifted"] == [] and b["source"] == "insufficient"
+    assert b["ratio"] == 1.0
+
+
+def test_drift_against_tuned_prediction():
+    rows = [_row(float(i), n_real=4, service_s=0.054) for i in range(12)]
+    rep = drift_report(mine_rows(rows),
+                       predictions={"1x16x16": 0.0045})
+    b = rep["buckets"]["1x16x16"]
+    assert b["source"] == "tuned"
+    assert b["baseline_s"] == pytest.approx(0.0045)
+    assert b["ratio"] == pytest.approx(0.0135 / 0.0045)
+    assert rep["drifted"] == ["1x16x16"]
+
+
+def test_drift_threshold_must_exceed_one():
+    with pytest.raises(ValueError):
+        drift_report(mine_rows([_row(1.0)]), threshold=1.0)
+
+
+# -- wamlive preset -----------------------------------------------------------
+
+
+def test_wamlive_requires_mix():
+    from wam_tpu.tune.workloads import get_workload
+
+    with pytest.raises(ValueError, match="mix"):
+        get_workload("wamlive")
+
+
+def test_wamlive_preset_deterministic_for_a_mix():
+    """The same mix must build the same sweep: candidate list, observed
+    geometry, and the runner's actual numerics (rank-keyed PRNG draws, no
+    wall-clock or global state in the body)."""
+    import jax
+
+    from wam_tpu.tune.workloads import get_workload
+
+    mix = mine_rows(_shifted_rows())
+    a = get_workload("wamlive", mix=mix, n_samples=2)
+    b = get_workload("wamlive", mix=mix, n_samples=2)
+    assert a.shape == b.shape == (16, 16)
+    assert a.batch == b.batch
+    assert a.items == b.items
+    assert [(c.sample_chunk, c.stream_noise) for c in a.candidates] == \
+           [(c.sample_chunk, c.stream_noise) for c in b.candidates]
+    run_a, args_a = a.build(a.candidates[0])
+    run_b, args_b = b.build(b.candidates[0])
+    out_a = jax.block_until_ready(run_a(*args_a))
+    out_b = jax.block_until_ready(run_b(*args_b))
+    assert float(out_a) == float(out_b)
+
+
+# -- canary verdict (pure) ----------------------------------------------------
+
+
+def test_canary_verdict_insufficient_then_win_then_hold():
+    champ = [_row(10.0 + i, n_real=4, service_s=0.054, fp="champ")
+             for i in range(8)]
+    chall = [_row(10.0 + i, n_real=8, service_s=0.07, fp="chall")
+             for i in range(8)]
+    few = canary_verdict(champ + chall[:3], "champ", "chall")
+    assert few["verdict"] == "insufficient" and not few["win"]
+    win = canary_verdict(champ + chall, "champ", "chall")
+    assert win["verdict"] == "challenger" and win["win"]
+    # 13.5 ms/item -> 8.75 ms/item
+    assert win["improvement"] == pytest.approx(1 - 0.00875 / 0.0135)
+    # a challenger inside the margin holds the champion
+    near = [_row(10.0 + i, n_real=4, service_s=0.053, fp="chall")
+            for i in range(8)]
+    hold = canary_verdict(champ + near, "champ", "chall", margin=0.05)
+    assert hold["verdict"] == "champion" and not hold["win"]
+
+
+def test_canary_verdict_since_drops_prewindow_champion_history():
+    # light-era champion history before the window opened would let the
+    # champion coast; ``since`` must exclude it
+    old = [_row(float(i), n_real=4, service_s=0.004, fp="champ")
+           for i in range(20)]
+    champ = [_row(100.0 + i, n_real=4, service_s=0.054, fp="champ")
+             for i in range(8)]
+    chall = [_row(100.0 + i, n_real=8, service_s=0.07, fp="chall")
+             for i in range(8)]
+    without = canary_verdict(old + champ + chall, "champ", "chall")
+    assert not without["win"]  # polluted champion mean looks unbeatable
+    windowed = canary_verdict(old + champ + chall, "champ", "chall",
+                              since=100.0)
+    assert windowed["win"] and windowed["champion_batches"] == 8
+
+
+# -- serve-plane planning -----------------------------------------------------
+
+
+def test_plan_serve_schedule_grow_shrink_hold():
+    hot = mine_rows([_row(float(i), n_real=4, max_batch=4, queue_depth=6)
+                     for i in range(10)])
+    plan = plan_serve_schedule(hot, current_cap=4, max_cap=16, replicas=2)
+    shape, replicas, entry = plan["1x16x16"]
+    assert shape == (1, 16, 16) and replicas == 2
+    assert entry["bucket_cap"] == 8  # saturated + queued -> double
+    cold = mine_rows([_row(float(i), n_real=1, max_batch=8, queue_depth=0)
+                      for i in range(10)])
+    plan = plan_serve_schedule(cold, current_cap=16, default_cap=4)
+    assert plan["1x16x16"][2]["bucket_cap"] == 8  # occ < 0.35 -> halve
+    warm = mine_rows([_row(float(i), n_real=3, max_batch=4, queue_depth=0)
+                      for i in range(10)])
+    plan = plan_serve_schedule(warm, current_cap=4)
+    assert plan["1x16x16"][2]["bucket_cap"] == 4  # in between holds
+    # growth respects the ceiling
+    plan = plan_serve_schedule(hot, current_cap=12, max_cap=16)
+    assert plan["1x16x16"][2]["bucket_cap"] == 16
+
+
+def test_plan_keys_by_replica_count(sched_cache):
+    """The promoted cap must be found by the width that tuned it: a
+    2-replica entry steers 2-replica resolution only."""
+    mix = mine_rows([_row(float(i), n_real=4, max_batch=4, queue_depth=6)
+                     for i in range(10)])
+    plan = plan_serve_schedule(mix, current_cap=4, replicas=2)
+    shape, replicas, entry = plan["1x16x16"]
+    cache = load_schedule_cache()
+    cache.put(schedule_key("serve", shape, replicas), entry)
+    cache.save()
+    invalidate_process_cache()
+    assert resolve_bucket_cap("auto", shape, replicas=2, default=4) == 8
+    assert resolve_bucket_cap("auto", shape, replicas=1, default=4) == 4
+
+
+# -- OnlineTuner --------------------------------------------------------------
+
+
+def test_online_tuner_kill_switch(tmp_path, monkeypatch):
+    ledger = tmp_path / "serve.jsonl"
+    w = JsonlWriter(str(ledger))
+    for r in _shifted_rows():
+        w.write(r)
+    out = tmp_path / "rows.jsonl"
+    monkeypatch.setenv(ONLINE_TUNE_ENV, "1")
+    tuner = OnlineTuner(OnlineTuneConfig(ledger=str(ledger),
+                                         out_ledger=str(out)))
+    assert tuner.step() == {"disabled": True}
+    assert not out.exists()
+
+
+def test_detect_drift_writes_schedule_drift_rows(tmp_path, sched_cache):
+    from wam_tpu.serve.metrics import SCHEMA_VERSION
+
+    ledger = tmp_path / "serve.jsonl"
+    out = tmp_path / "rows.jsonl"
+    w = JsonlWriter(str(ledger))
+    for r in _shifted_rows():
+        w.write(r)
+    tuner = OnlineTuner(OnlineTuneConfig(ledger=str(ledger),
+                                         out_ledger=str(out)))
+    mix = tuner.mine()
+    report = tuner.detect_drift(mix)
+    assert report["drifted"] == ["1x16x16"]
+    rows = [json.loads(line) for line in out.read_text().splitlines()]
+    assert len(rows) == 1
+    row = rows[0]
+    assert row["metric"] == "schedule_drift"
+    assert row["schema_version"] == SCHEMA_VERSION
+    assert row["bucket"] == "1x16x16"
+    assert row["ratio"] > DEFAULT_DRIFT_THRESHOLD
+    assert row["baseline_source"] == "self"
+    # quiet mix -> no new rows
+    tuner.detect_drift(mine_rows(_shifted_rows(n_light=30, n_heavy=0)))
+    assert len(out.read_text().splitlines()) == 1
+
+
+def test_promote_installs_publishes_and_hydrates(tmp_path, sched_cache,
+                                                 monkeypatch):
+    """Promotion end state is reproducible from the bundle ALONE: a fresh
+    schedule cache hydrated from the published bundle resolves the promoted
+    cap under the promoted fingerprint (the round-19 acceptance repro)."""
+    from wam_tpu.registry import RegistryClient
+    from wam_tpu.serve.metrics import SCHEMA_VERSION
+
+    shape = (1, 16, 16)
+    skey = schedule_key("serve", shape, 2)
+    entry = {"bucket_cap": 8, "source": "online:plan_serve_schedule"}
+    merged = dict(load_schedule_cache().entries)
+    merged[skey] = entry
+    challenger = {"entries": {skey: entry}, "keys": [skey],
+                  "fingerprint": entries_fingerprint(merged)}
+    out = tmp_path / "rows.jsonl"
+    bundle_dir = tmp_path / "bundle"
+    tuner = OnlineTuner(OnlineTuneConfig(
+        ledger=str(tmp_path / "unused.jsonl"), out_ledger=str(out),
+        replicas=2, bundle_dir=str(bundle_dir), bundle_aot_keys=[]))
+    verdict = {"verdict": "challenger", "win": True, "improvement": 0.35,
+               "champion_fp": "champ", "champion_batches": 9,
+               "challenger_batches": 9}
+    promoted = tuner.promote(challenger, verdict)
+    # installed live: the serve path resolves the promoted cap
+    assert resolve_bucket_cap("auto", shape, replicas=2, default=4) == 8
+    assert promoted["live_fingerprint"] == challenger["fingerprint"]
+    assert promoted["bundle"]["artifacts"] == 0  # schedules-only
+    row = json.loads(out.read_text().splitlines()[-1])
+    assert row["metric"] == "schedule_promotion"
+    assert row["schema_version"] == SCHEMA_VERSION
+    assert row["challenger_fp"] == challenger["fingerprint"]
+    assert row["live_fp"] == promoted["live_fingerprint"]
+    assert row["keys"] == [skey] and row["improvement"] == 0.35
+    # fresh cache + bundle alone == the promoted table
+    monkeypatch.setenv("WAM_TPU_SCHEDULE_CACHE",
+                       str(tmp_path / "hydrated.json"))
+    invalidate_process_cache()
+    assert resolve_bucket_cap("auto", shape, replicas=2, default=4) == 4
+    report = RegistryClient(str(bundle_dir)).hydrate()
+    assert report.schedules_added >= 1
+    assert resolve_bucket_cap("auto", shape, replicas=2, default=4) == 8
+    assert schedule_fingerprint() == promoted["live_fingerprint"]
+
+
+def test_online_cli_once(tmp_path):
+    """--once exits 0 (emitting the mix JSON) on a minable ledger and 1 on
+    one with no serve_batch rows — the verify-skill smoke contract."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["WAM_TPU_SCHEDULE_CACHE"] = str(tmp_path / "schedules.json")
+    ledger = tmp_path / "serve.jsonl"
+    w = JsonlWriter(str(ledger))
+    # steady mix: mine succeeds, nothing drifts, no sweep -> fast pass
+    for r in _shifted_rows(n_light=12, n_heavy=0):
+        w.write(r)
+    ok = subprocess.run(
+        [sys.executable, "-m", "wam_tpu.tune.online", "--once",
+         "--ledger", str(ledger), "--device", "cpu"],
+        capture_output=True, text=True, timeout=300, env=env)
+    assert ok.returncode == 0, ok.stderr
+    out = json.loads(ok.stdout.splitlines()[-1])
+    assert out["mix"]["rows"] == 12 and out["drift"]["drifted"] == []
+    empty = tmp_path / "empty.jsonl"
+    empty.write_text("")
+    bad = subprocess.run(
+        [sys.executable, "-m", "wam_tpu.tune.online", "--once",
+         "--ledger", str(empty), "--device", "cpu"],
+        capture_output=True, text=True, timeout=300, env=env)
+    assert bad.returncode == 1, bad.stdout
+
+
+# -- serve_batch fingerprint stamping (satellite 1) ---------------------------
+
+
+def test_serve_batch_rows_stamp_fingerprint_and_qos(sched_cache):
+    m = ServeMetrics()
+    m.note_batch(bucket_shape=(1, 16, 16), n_real=2, max_batch=4,
+                 pad_waste=0.5, queue_depth=1, service_s=0.02,
+                 queue_waits_s=[0.0, 0.0], latencies_s=[0.02, 0.02],
+                 qos=["interactive", "batch"])
+    row = m.batch_sample()[0]
+    assert row["schedule_fingerprint"] == schedule_fingerprint()
+    assert row["qos"] == {"interactive": 1, "batch": 1}
+    # the canary hook overrides the process-global champion fingerprint
+    m.schedule_fingerprint = "challenger-fp"
+    m.note_batch(bucket_shape=(1, 16, 16), n_real=1, max_batch=4,
+                 pad_waste=0.75, queue_depth=0, service_s=0.01,
+                 queue_waits_s=[0.0], latencies_s=[0.01], qos=["batch"])
+    assert m.batch_sample()[1]["schedule_fingerprint"] == "challenger-fp"
+
+
+# -- fleet canary hook --------------------------------------------------------
+
+
+class _GateEntry:
+    def __init__(self):
+        self.entered = threading.Event()
+        self.release = threading.Event()
+
+    def __call__(self, xs, ys):
+        self.entered.set()
+        assert self.release.wait(timeout=10), "test gate never released"
+        return np.asarray(xs) * 2.0
+
+
+def test_pin_canary_routes_batch_lane_to_challenger():
+    need_devices(2)
+    gates = {rid: _GateEntry() for rid in range(2)}
+    fallback = lambda xs, ys: np.asarray(xs)
+    fleet = FleetServer(lambda rid, m: gates.get(rid, fallback), [(4,)],
+                        replicas=2, max_batch=1, max_wait_ms=0.0,
+                        warmup=False)
+    x = np.zeros((4,), np.float32)
+    try:
+        rid = fleet.pin_canary("chall-fp")
+        assert rid == 1  # defaults to the highest live rid
+        with pytest.raises(ValueError):
+            fleet.pin_canary("other-fp")  # one canary at a time
+        f0 = fleet.submit(x, 0, qos="batch")  # batch lane -> canary
+        assert gates[1].entered.wait(timeout=10)
+        f1 = fleet.submit(x, 0, qos="interactive")  # -> champion
+        assert gates[0].entered.wait(timeout=10)
+        assert fleet.metrics.replica(1).schedule_fingerprint == "chall-fp"
+        for g in gates.values():
+            g.release.set()
+        f0.result(timeout=10), f1.result(timeout=10)
+        fleet.clear_canary()
+        assert fleet.metrics.replica(1).schedule_fingerprint is None
+        assert fleet.canary_report()["verdict"] == "none"
+    finally:
+        for g in gates.values():
+            g.release.set()
+        fleet.close()
+
+
+def test_canary_report_windows_out_prepin_history():
+    need_devices(2)
+    fleet = FleetServer(lambda rid, m: (lambda xs, ys: np.asarray(xs)),
+                        [(4,)], replicas=2, max_batch=1, max_wait_ms=0.0,
+                        warmup=False)
+
+    def _note(rid, service_s, n=4):
+        fleet.metrics.replica(rid).note_batch(
+            bucket_shape=(4,), n_real=n, max_batch=8, pad_waste=0.0,
+            queue_depth=0, service_s=service_s,
+            queue_waits_s=[0.0] * n, latencies_s=[service_s] * n)
+
+    try:
+        # light-era history on the future champion: must NOT count
+        for _ in range(8):
+            _note(0, 0.004)
+        time.sleep(0.02)  # rows strictly before the pin's t0
+        fleet.pin_canary("chall-fp")
+        report = fleet.canary_report(min_batches=4)
+        assert report["verdict"] == "insufficient"
+        assert report["champion_batches"] == 0
+        for _ in range(6):
+            _note(0, 0.054)  # champion at 13.5 ms/item
+            _note(1, 0.07, n=8)  # challenger at 8.75 ms/item
+        report = fleet.canary_report(min_batches=4, margin=0.05)
+        assert report["champion_batches"] == 6
+        assert report["challenger_batches"] == 6
+        assert report["verdict"] == "challenger" and report["win"]
+        assert report["improvement"] == pytest.approx(1 - 0.00875 / 0.0135)
+    finally:
+        fleet.close()
+
+
+def test_pin_canary_needs_two_live_replicas():
+    fleet = FleetServer(lambda rid, m: (lambda xs, ys: np.asarray(xs)),
+                        [(4,)], replicas=1, max_batch=1, warmup=False)
+    try:
+        with pytest.raises(ValueError, match="2 live replicas"):
+            fleet.pin_canary("fp")
+    finally:
+        fleet.close()
+
+
+# -- autoscaler cache-hit drain discount (satellite 3) ------------------------
+
+
+def test_autoscaler_discounts_grow_drain_by_cache_hit_rate():
+    from wam_tpu.pod.autoscaler import AutoscaleConfig, decide
+    from wam_tpu.pod.protocol import WorkerSnapshot
+
+    def snap(drain, hit=-1.0, penalty=0.0):
+        return WorkerSnapshot(worker_id=0, pid=0, t_worker=0.0,
+                              projected_drain_s=drain,
+                              slo_penalty_s=penalty, cache_hit_rate=hit)
+
+    cfg = AutoscaleConfig(min_workers=1, max_workers=4,
+                          grow_drain_s=0.5, shrink_drain_s=0.05)
+    # deep queue but a hot cache serves most of it: phantom load, hold
+    assert decide(cfg, [snap(2.0, hit=0.9)], 2) == 0
+    # same queue, cold cache -> genuine pressure, grow
+    assert decide(cfg, [snap(2.0, hit=0.0)], 2) == 1
+    # pre-round-19 worker (hit unknown = -1) keeps the raw drain
+    assert decide(cfg, [snap(2.0)], 2) == 1
+    # shrink reads the RAW drain: a hot cache must not shrink away
+    # capacity that real traffic still needs (0.2 raw > shrink_drain_s)
+    assert decide(cfg, [snap(0.2, hit=0.9)], 2) == 0
+    # SLO burn still grows regardless of the discount
+    assert decide(cfg, [snap(2.0, hit=0.9, penalty=0.1)], 2) == 1

@@ -51,6 +51,9 @@ KNOB_DOCS = {
     "WAM_TPU_NO_RESULT_CACHE":
         "`1` bypasses the serve result cache; read per call, so it can "
         "be flipped live",
+    "WAM_TPU_NO_ONLINE_TUNE":
+        "`1` disables the online schedule tuner: no drift rows, no shadow "
+        "sweeps, no canary promotion (kill switch; gauges still update)",
     "WAM_TPU_NO_ANYTIME":
         "`1` disables anytime serving: servers over anytime entries fall "
         "back to full-n synchronous attribution (kill switch)",

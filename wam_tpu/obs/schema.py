@@ -99,6 +99,10 @@ METRIC_NAMES = frozenset({
     "wam_tpu_registry_artifacts_total",
     "wam_tpu_registry_hydrations_total",
     "wam_tpu_registry_schedules_total",
+    # online schedule tuner (tune/online.py, tune/mix.py)
+    "wam_tpu_tune_drift_ratio",
+    "wam_tpu_tune_promotions_total",
+    "wam_tpu_tune_sweeps_total",
     # compile observability + fan engine + chaos + stager
     "wam_tpu_chaos_injected_total",
     "wam_tpu_compile_aot_events_total",
@@ -120,6 +124,8 @@ LEDGER_ROW_TYPES = frozenset({
     "registry_hydration",
     "replica_restart",
     "result_cache",
+    "schedule_drift",
+    "schedule_promotion",
     "serve_batch",
     "serve_summary",
     "slo_status",

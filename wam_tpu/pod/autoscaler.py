@@ -13,7 +13,10 @@ worker bring-up — seconds — to change the signals it acted on).
 Grow triggers on EITHER pressure signal:
 
 - mean ``projected_drain_s`` above ``grow_drain_s`` — the pod's queues
-  are deeper than the drain target, more hands needed;
+  are deeper than the drain target, more hands needed. The grow-side
+  drain is discounted by each worker's reported result-cache hit rate
+  (round 19): a hot cache serves that slice for free, so its queue
+  depth is phantom load a new worker would not absorb;
 - any worker with ``slo_penalty_s > 0`` — its burn rate crossed 1.0
   (the SLO error budget is being spent faster than earned; see
   `obs.slo.SLOTracker`), and the cheapest way to buy burn headroom is
@@ -47,6 +50,20 @@ class AutoscaleConfig:
     cooldown_s: float = 5.0  # sit-out after any action
 
 
+def _grow_drain(s) -> float:
+    """One worker's drain as the GROW trigger sees it: discounted by the
+    result-cache hit rate when the heartbeat reports one. A hot cache
+    answers that fraction of admitted traffic without compute, so its
+    projected drain (EMA × queued items, cache hits included) overstates
+    the work a new worker would actually absorb — growing on it buys
+    warm-up cost for phantom load. Pre-round-19 workers report -1
+    (unknown) and keep their raw drain."""
+    hit = getattr(s, "cache_hit_rate", -1.0)
+    if hit < 0.0:
+        return s.projected_drain_s
+    return s.projected_drain_s * (1.0 - min(1.0, hit))
+
+
 def decide(cfg: AutoscaleConfig, snapshots, n_live: int) -> int:
     """-1 (shrink), 0 (hold), or +1 (grow) from the live workers' last
     snapshots. Pure: no clocks, no side effects."""
@@ -54,9 +71,13 @@ def decide(cfg: AutoscaleConfig, snapshots, n_live: int) -> int:
         return 1
     if not snapshots:
         return 0
+    # grow reads the hit-rate-discounted drain; shrink keeps the RAW
+    # drain, so a hot-cache fleet neither grows on phantom queue depth
+    # nor shrinks away capacity that real (uncached) traffic still needs
+    grow_drain = sum(_grow_drain(s) for s in snapshots) / len(snapshots)
     drain = sum(s.projected_drain_s for s in snapshots) / len(snapshots)
     burning = any(s.slo_penalty_s > 0.0 for s in snapshots)
-    if (drain > cfg.grow_drain_s or burning) and n_live < cfg.max_workers:
+    if (grow_drain > cfg.grow_drain_s or burning) and n_live < cfg.max_workers:
         return 1
     if (drain < cfg.shrink_drain_s and not burning
             and n_live > cfg.min_workers):

@@ -28,6 +28,7 @@ Message grammar::
     worker -> router   {"op": "result", req_id, ok, value | error}
     router -> worker   {"op": "health", t_send}
     worker -> router   {"op": "health_reply", t_send, t_worker, snapshot}
+    router -> worker   {"op": "canary", fingerprint | None, overrides}
     router -> worker   {"op": "close"}
     worker -> router   {"op": "bye", snapshot, spans}
 
@@ -37,7 +38,10 @@ compile-artifact bundle and hydrates from the streamed ``files``
 (relpath -> raw bytes frames) BEFORE warmup, so a cold host joins at
 ``compile_count == 0`` without sharing a filesystem with the router.
 ``hello`` is sent AFTER the worker's fleet warmed — readiness and
-liveness are the same signal. ``health_reply`` echoes the router's
+liveness are the same signal. ``canary`` pins (fingerprint + optional
+server-kw overrides) or clears (fingerprint None) a schedule-A/B canary
+replica inside the worker's fleet (`FleetServer.pin_canary`) — how the
+online tuner's challenger reaches every worker in a pod. ``health_reply`` echoes the router's
 ``t_send`` so the router can estimate the worker's perf_counter clock
 offset from the round-trip (spans shipped at ``bye`` are re-based onto
 the router's timebase with it; `wam_tpu.obs.tracing.spans_to_events`).
@@ -96,6 +100,10 @@ class WorkerSnapshot:
     # costs a cross-host round-trip on the tcp transport). -1 = unknown
     # (pre-round-18 worker).
     queue_free: int = -1
+    # result-cache hit fraction of admitted traffic; a hot cache absorbs
+    # load without queueing, so the autoscaler discounts drain by it
+    # before growing. -1 = unknown (pre-round-19 worker).
+    cache_hit_rate: float = -1.0
     slo_penalty_s: float = 0.0
     quarantined: bool = False  # EVERY live replica quarantined
     live_replicas: int = 1

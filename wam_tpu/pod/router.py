@@ -773,6 +773,36 @@ class PodRouter:
             pass
         return w.wid
 
+    # -- canary plane -------------------------------------------------------
+
+    def pin_canary(self, fingerprint: str, *, overrides=None) -> list[int]:
+        """Broadcast an online-tuner challenger pin to every live worker:
+        each worker pins ONE of its fleet replicas to the challenger
+        schedule (`FleetServer.pin_canary`), so the canary slice spans the
+        whole pod at the same per-worker blast radius. Best-effort —
+        single-replica workers skip the pin on their side. Returns the
+        wids the pin reached."""
+        return self._broadcast_canary(fingerprint, overrides)
+
+    def clear_canary(self) -> list[int]:
+        """End the schedule A/B on every live worker (champion-only
+        routing resumes; override-built canary replicas rebuild)."""
+        return self._broadcast_canary(None, None)
+
+    def _broadcast_canary(self, fingerprint, overrides) -> list[int]:
+        with self._lock:
+            workers = [w for w in self._workers.values()
+                       if w.alive and not w.draining and w.chan is not None]
+        reached = []
+        for w in workers:
+            try:
+                w.chan.send({"op": "canary", "fingerprint": fingerprint,
+                             "overrides": overrides})
+            except OSError:
+                continue  # death paths will handle it
+            reached.append(w.wid)
+        return reached
+
     # -- client side --------------------------------------------------------
 
     def submit(self, x, y=None, deadline_ms: float | None = None,

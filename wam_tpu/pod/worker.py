@@ -242,6 +242,7 @@ def main(argv=None) -> int:
             ema_service_s=sig["ema_service_s"],
             qos_depth=sig.get("qos_depth", {}),
             queue_free=sig.get("queue_free", -1),
+            cache_hit_rate=sig.get("cache_hit_rate", -1.0),
             slo_penalty_s=sig["slo_penalty_s"],
             quarantined=sig["quarantined"],
             live_replicas=sig["live_replicas"],
@@ -306,6 +307,19 @@ def main(argv=None) -> int:
                            "snapshot": snapshot()})
             except OSError:
                 break
+        elif op == "canary":
+            # online-tuner challenger pin: fingerprint present = pin one
+            # replica to the challenger schedule (optional server-kw
+            # overrides), fingerprint None = clear the A/B. Best-effort —
+            # a one-replica worker cannot A/B and just skips the pin.
+            try:
+                fp = msg.get("fingerprint")
+                if fp is None:
+                    server.clear_canary()
+                else:
+                    server.pin_canary(fp, overrides=msg.get("overrides"))
+            except (ValueError, RuntimeError):
+                pass
         elif op == "close":
             graceful = True
             break

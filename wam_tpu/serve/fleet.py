@@ -237,6 +237,9 @@ class FleetServer:
     _GUARDED_BY = {
         "_closed": "_lock",
         "_started": "_lock",
+        "_canary": "_lock",
+        "_canary_fp": "_lock",
+        "_canary_t0": "_lock",
         "_seq_entry": "_os_lock",
     }
 
@@ -292,6 +295,12 @@ class FleetServer:
         self._lock = threading.Lock()
         self._closed = False
         self._started = False
+        # online-tuner canary (pin_canary): rid of the replica serving the
+        # CHALLENGER schedule, None = no A/B in progress
+        self._canary = None
+        self._canary_fp = None
+        self._canary_t0 = 0.0
+        self._canary_overrides = False
         self._registry = registry
         self.registry_report = None  # latest fleet-wide HydrationReport
 
@@ -500,6 +509,7 @@ class FleetServer:
             "labeled": self.labeled,
             "oversize": self.oversize,
             "seq_route": self._seq_factory is not None,
+            "canary": self._canary,
             "supervised": self._supervisor is not None,
             "supervision": (
                 self._supervisor.describe() if self._supervisor is not None
@@ -550,6 +560,11 @@ class FleetServer:
         for r in live:
             for cls, depth in r.server.qos_depths().items():
                 qos_depth[cls] = qos_depth.get(cls, 0) + depth
+        submitted = sum(s["submitted"] for s in snaps) + os_snap["submitted"]
+        # fleet-tier cache hits resolve BEFORE routing, so they never enter
+        # ``submitted`` — the hit rate the autoscaler discounts drain by is
+        # hits / total front-door traffic (hits + routed submits)
+        cache_hits = self.metrics.cache_hits
         return {
             "projected_drain_s": min(
                 (r.server.projected_drain_s() for r in live), default=0.0),
@@ -561,13 +576,146 @@ class FleetServer:
             and not any(r.server.health_ok() for r in live),
             "live_replicas": len(live),
             "dead_replicas": len(replicas) - len(live),
-            "submitted": sum(s["submitted"] for s in snaps)
-            + os_snap["submitted"],
+            "submitted": submitted,
             "completed": sum(s["completed"] for s in snaps)
             + os_snap["completed"],
             "compile_count": sum(s["compile_count"] for s in snaps)
             + os_snap["compile_count"],
+            "cache_hits": cache_hits,
+            "cache_hit_rate": cache_hits / max(1, cache_hits + submitted),
         }
+
+    # -- online-tuner canary (wam_tpu.tune.online) ---------------------------
+
+    def pin_canary(self, fingerprint: str, *, replica_id: int | None = None,
+                   overrides: dict | None = None) -> int:
+        """Pin one replica as the CHALLENGER arm of a schedule A/B: its
+        ``serve_batch`` rows are stamped with ``fingerprint`` (instead of
+        the process-global champion fingerprint) and the batch-QoS lane
+        prefers it at routing time, so the canary slice is the throughput
+        lane — interactive traffic only lands there as a last resort.
+
+        ``overrides`` merges challenger serving knobs into the replica
+        recipe (e.g. ``{"max_batch": 16}`` from a retuned ``bucket_cap``)
+        and rebuilds the replica with them — in-flight work re-routes to
+        the champions exactly like a supervisor restart. Defaults to the
+        highest live rid (the replica the stable-tie router loads LAST).
+        Returns the pinned rid."""
+        with self._lock:
+            if self._canary is not None:
+                raise ValueError(
+                    f"replica {self._canary} is already the canary; "
+                    "clear_canary() first")
+            live = [r for r in self._replicas if r.alive]
+            if len(live) < 2:
+                raise ValueError(
+                    "canary A/B needs >= 2 live replicas (one per arm), "
+                    f"have {len(live)}")
+            if replica_id is None:
+                replica_id = max(r.rid for r in live)
+            replica = self._replicas[replica_id]
+            if not replica.alive:
+                raise ValueError(f"replica {replica_id} is dead")
+        if overrides:
+            replica.server.close(emit_metrics=False)
+            kw = dict(self._server_kw)
+            kw.update(overrides)
+            server = AttributionServer(
+                self._entry_factory(replica_id, replica.metrics),
+                self.table, metrics=replica.metrics,
+                device=self.devices[replica_id], replica_id=replica_id,
+                **kw)
+            server.start()
+            with self._lock:
+                replica.server = server
+        replica.metrics.schedule_fingerprint = fingerprint
+        with self._lock:
+            self._canary = replica_id
+            self._canary_fp = fingerprint
+            self._canary_t0 = time.time()
+            self._canary_overrides = bool(overrides)
+        return replica_id
+
+    def clear_canary(self) -> None:
+        """End the A/B: the replica's rows stamp the champion fingerprint
+        again, and a replica rebuilt with challenger overrides goes back to
+        the fleet recipe (same path as a supervisor restart)."""
+        with self._lock:
+            rid = self._canary
+            had_overrides = self._canary_overrides
+            self._canary = None
+            self._canary_fp = None
+            self._canary_t0 = 0.0
+            self._canary_overrides = False
+        if rid is None:
+            return
+        self._replicas[rid].metrics.schedule_fingerprint = None
+        if had_overrides:
+            self._rebuild_replica(rid)
+
+    def canary_report(self, *, min_batches: int = 8,
+                      margin: float = 0.05) -> dict:
+        """Champion-vs-challenger comparison from the replicas' OWN batch
+        ledgers (`ServeMetrics.batch_sample`) — self-contained, no tuner
+        import, same verdict rule as `tune.online.canary_verdict`: the
+        challenger wins when both arms hold ≥ ``min_batches`` batches and
+        its mean per-item service beats the champion mean by ≥ ``margin``.
+        SLO burn is compared alongside (a faster canary that is burning an
+        objective is NOT a win). Only rows from the OPEN canary window
+        count: the challenger arm is filtered to rows stamped with the
+        challenger fingerprint, the champion arm to rows dispatched after
+        the pin — neither arm coasts on its pre-A/B history."""
+        with self._lock:
+            rid = self._canary
+            fp = self._canary_fp
+            t0 = self._canary_t0
+            replicas = list(self._replicas)
+        if rid is None:
+            return {"canary": None, "verdict": "none", "win": False}
+
+        def _per_item(rows, want_fp=None):
+            return [float(r.get("service_s", 0.0)) / max(1, int(r["n_real"]))
+                    for r in rows
+                    if r.get("n_real")
+                    and float(r.get("timestamp", 0.0)) >= t0
+                    and (want_fp is None
+                         or r.get("schedule_fingerprint") == want_fp)]
+
+        def _penalty(r):
+            return max((r.server.slo_penalty_s(b.shape) for b in self.table),
+                       default=0.0)
+
+        chall = _per_item(replicas[rid].metrics.batch_sample(), want_fp=fp)
+        champ: list[float] = []
+        champ_pen: list[float] = []
+        for r in replicas:
+            if r.rid != rid and r.alive:
+                champ.extend(_per_item(r.metrics.batch_sample()))
+                champ_pen.append(_penalty(r))
+        out = {
+            "canary": rid,
+            "challenger_batches": len(chall),
+            "champion_batches": len(champ),
+            "margin": margin,
+            "challenger_slo_penalty_s": _penalty(replicas[rid]),
+            "champion_slo_penalty_s": max(champ_pen, default=0.0),
+        }
+        if len(chall) < min_batches or len(champ) < min_batches:
+            out.update(verdict="insufficient", win=False)
+            return out
+        champ_s = sum(champ) / len(champ)
+        chall_s = sum(chall) / len(chall)
+        win = (chall_s <= champ_s * (1.0 - margin)
+               and out["challenger_slo_penalty_s"]
+               <= out["champion_slo_penalty_s"])
+        out.update(
+            champion_per_item_s=champ_s,
+            challenger_per_item_s=chall_s,
+            improvement=(champ_s - chall_s) / champ_s if champ_s > 0 else 0.0,
+            verdict="challenger" if win else "champion",
+            win=win,
+        )
+        return out
 
     # -- client side --------------------------------------------------------
 
@@ -771,6 +919,15 @@ class FleetServer:
         else:
             remaining_ms = None
         cands.sort(key=lambda r: self._score(r, req.bucket))  # stable: rid ties
+        with self._lock:
+            canary = self._canary
+        if canary is not None:
+            # schedule-A/B traffic split (pin_canary): the batch lane IS
+            # the canary slice — it prefers the challenger replica; the
+            # interactive lane avoids it except as a last resort. Stable
+            # sorts preserve the score order within each arm.
+            cands.sort(key=lambda r: (r.rid != canary) if req.qos == "batch"
+                       else (r.rid == canary))
         ok = {r.rid: r.server.health_ok() for r in cands}
         if not all(ok.values()):
             # numeric-health partition: quarantined replicas are routed

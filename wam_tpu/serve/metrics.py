@@ -145,6 +145,21 @@ _h_any_conf = _obs_registry.histogram(
 EMA_SEED_S = 0.05
 
 
+def _live_schedule_fingerprint():
+    """The active tuned-schedule fingerprint (`tune.cache
+    .schedule_fingerprint`) stamped onto every ``serve_batch`` row so the
+    online tuner can attribute each observed service time to the schedule
+    that produced it (champion vs challenger in the canary A/B). Lazy
+    import + memoized digest — the first call loads the schedule table,
+    every later one returns the cached sha."""
+    try:
+        from wam_tpu.tune.cache import schedule_fingerprint
+
+        return schedule_fingerprint()
+    except Exception:
+        return None
+
+
 def percentile_ms(latencies_s, q: float) -> float:
     """Linear-interpolated percentile of a latency sample, in ms (NaN when
     empty — a summary of zero requests has no latency)."""
@@ -181,6 +196,10 @@ class ServeMetrics:
         # runtime attaches its ResultCache so emit() can flush a
         # result_cache row next to this replica's summary (None = no cache)
         self.result_cache = None
+        # per-row schedule attribution: None = stamp the process-global
+        # tuned-table fingerprint; the fleet's canary hook overrides this
+        # so the challenger replica's rows carry the CHALLENGER fingerprint
+        self.schedule_fingerprint = None
         self.warmup_s: dict[str, float] = {}  # bucket key -> warmup seconds
         self._ema_service_s: dict[str, float] = {}  # bucket key -> EMA
         # runtime attaches its SLOTracker so emit() can flush a slo_status
@@ -273,8 +292,15 @@ class ServeMetrics:
         the per-bucket service-time EMA update (first observation seeds the
         EMA directly; later ones blend 0.8/0.2). ``qos`` is the per-request
         class list parallel to ``latencies_s`` — it splits the latency
-        sample into per-class percentiles (`snapshot` ``latency_by_qos``)."""
+        sample into per-class percentiles (`snapshot` ``latency_by_qos``)
+        and stamps per-class counts onto the batch row (the workload-mix
+        miner's bucket × qos histogram, `tune.mix`)."""
         occupancy = n_real / max_batch
+        # resolved OUTSIDE the accumulator lock: the first call may load
+        # the schedule-cache files (tune.cache takes its own lock)
+        fp = self.schedule_fingerprint
+        if fp is None:
+            fp = _live_schedule_fingerprint()
         with self._lock:
             self.completed += len(latencies_s)
             self.latencies_s.extend(latencies_s)
@@ -299,6 +325,13 @@ class ServeMetrics:
                 "service_s": service_s,
                 "timestamp": time.time(),
             }
+            if fp is not None:
+                row["schedule_fingerprint"] = fp
+            if qos is not None:
+                counts: dict[str, int] = {}
+                for cls in qos:
+                    counts[cls] = counts.get(cls, 0) + 1
+                row["qos"] = counts
             if self.replica_id is not None:
                 row["replica_id"] = self.replica_id
             self.batch_rows.append(row)
@@ -374,6 +407,12 @@ class ServeMetrics:
         """Copy of the per-request latency sample (fleet pooling)."""
         with self._lock:
             return list(self.latencies_s)
+
+    def batch_sample(self) -> list[dict]:
+        """Copy of the dispatched-batch rows (the canary comparison and
+        the workload-mix miner read per-batch service times from these)."""
+        with self._lock:
+            return list(self.batch_rows)
 
     def snapshot(self) -> dict:
         """Aggregate window stats; keys are the schema-v2 ledger row

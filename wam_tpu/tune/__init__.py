@@ -14,6 +14,11 @@ harvests it on two fronts:
 - **Fused backward kernels** (`fused_relu`): a packed-sign-mask
   `custom_vjp` ReLU (residual 1/32 the bytes, backward one masked multiply)
   enabled by ``models.bind_inference(..., fused_relu_vjp=True)``.
+- **Online schedule learning** (`mix`, `online`, round 19): a shadow tuner
+  that mines the serve ledger into a `WorkloadMix`, re-sweeps against the
+  observed distribution (``wamlive`` preset), canary-A/Bs the challenger on
+  one fleet replica, and on a clear win publishes it as a registry bundle —
+  ``python -m wam_tpu.tune.online`` (kill switch ``WAM_TPU_NO_ONLINE_TUNE``).
 """
 
 from wam_tpu.tune.cache import (
@@ -21,6 +26,7 @@ from wam_tpu.tune.cache import (
     ScheduleCache,
     apply_tuned_synth_impl,
     default_cache_path,
+    entries_fingerprint,
     invalidate_process_cache,
     load_schedule_cache,
     lookup_schedule,
@@ -55,6 +61,12 @@ __all__ = [
     "autotune",
     "Candidate",
     "chunk_candidates",
+    "entries_fingerprint",
+    "WorkloadMix",
+    "mine_ledger",
+    "drift_report",
+    "OnlineTuner",
+    "OnlineTuneConfig",
 ]
 
 
@@ -69,4 +81,14 @@ def __getattr__(name):
         from wam_tpu.tune import workloads
 
         return getattr(workloads, name)
+    if name in ("WorkloadMix", "BucketObservation", "mine_ledger",
+                "mine_rows", "drift_report"):
+        from wam_tpu.tune import mix
+
+        return getattr(mix, name)
+    if name in ("OnlineTuner", "OnlineTuneConfig", "plan_serve_schedule",
+                "canary_verdict"):
+        from wam_tpu.tune import online
+
+        return getattr(online, name)
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -48,6 +48,7 @@ __all__ = [
     "resolve_bucket_cap",
     "apply_tuned_synth_impl",
     "invalidate_process_cache",
+    "entries_fingerprint",
 ]
 
 SCHEDULE_CACHE_VERSION = 1
@@ -164,6 +165,23 @@ def invalidate_process_cache() -> None:
         _process_cache = None
 
 
+def entries_fingerprint(entries: dict, *, disabled: bool = False) -> str:
+    """Digest of a schedule table body — the shared hash behind
+    `schedule_fingerprint`, exported so the online tuner can fingerprint a
+    CHALLENGER table (its candidate entries merged over the live ones)
+    exactly the way the serving fingerprint would come out AFTER a
+    promotion. Identical entries ⇒ identical digest, which is what lets
+    the canary A/B match ``serve_batch`` rows back to the schedule that
+    produced them."""
+    import hashlib
+
+    body = json.dumps(
+        {"version": SCHEDULE_CACHE_VERSION, "disabled": disabled,
+         "schedules": entries},
+        sort_keys=True, default=str)
+    return hashlib.sha256(body.encode()).hexdigest()[:16]
+
+
 def schedule_fingerprint() -> str:
     """Digest of the loaded schedule table (entries + schema version) — the
     "schedule version" component of serve result-cache keys
@@ -172,8 +190,6 @@ def schedule_fingerprint() -> str:
     only valid against the exact table it was computed under. Memoized on
     the `ScheduleCache` instance: `invalidate_process_cache` (or a
     `refresh=True` reload) naturally drops the memo with the instance."""
-    import hashlib
-
     cache = load_schedule_cache()
     # _disabled() is part of the identity (with lookups killed the entries
     # serve under the fallback law, not the table), so the memo is keyed
@@ -182,11 +198,7 @@ def schedule_fingerprint() -> str:
     memo = getattr(cache, "_fingerprint", None)
     if memo is not None and memo[0] == disabled:
         return memo[1]
-    body = json.dumps(
-        {"version": SCHEDULE_CACHE_VERSION, "disabled": disabled,
-         "schedules": cache.entries},
-        sort_keys=True, default=str)
-    fp = hashlib.sha256(body.encode()).hexdigest()[:16]
+    fp = entries_fingerprint(cache.entries, disabled=disabled)
     cache._fingerprint = (disabled, fp)
     return fp
 

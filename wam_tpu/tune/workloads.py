@@ -40,6 +40,14 @@ Presets:
   `xattr.video`) over a toy 3D conv, sweeping chunks, stream_noise and the
   synthesis impl; persists under the ``wamvid3d`` key
   `WaveletAttributionVideo(sample_batch_size="auto")` resolves.
+- ``wamlive`` — the ONLINE preset (round 19): synthesized from a
+  ledger-mined `WorkloadMix` (`wam_tpu.tune.mix`) instead of a canned
+  geometry. The dominant observed buckets become toy-engine smoothgrad
+  bodies sized/batched from what the fleet actually served, repeated in
+  items-weight proportion inside ONE jitted runner, so the sweep ranks
+  candidates under the live distribution. Deterministic for a given mix
+  (fixed PRNG keys, stable bucket ordering) — the shadow-tuner round-trip
+  test pins this.
 - ``wamseq1d`` / ``wamseq2d`` — the sequence-sharded long-context loops
   (`parallel.seq_estimators.SeqShardedWam`) over the largest power-of-two
   device mesh available, sweeping the sample chunk × the fused-vs-split
@@ -493,6 +501,86 @@ def _wamvit2d_workload(n_samples: int = 8, batch: int = 4,
                     candidates=cands, build=build)
 
 
+def _wamlive_workload(mix=None, n_samples: int = 8, top_n: int = 3,
+                      total_reps: int = 4) -> Workload:
+    """Live-mix sweep: the `WorkloadMix`'s dominant buckets become toy-conv
+    smoothgrad bodies with the OBSERVED geometry — per-item size from the
+    bucket shape's trailing dim (clamped to the CPU-fast [8, 64] band),
+    batch from the observed mean real rows per dispatch (clamped [1, 8]) —
+    executed in items-weight proportion inside one jitted runner. Every
+    random draw uses a fixed key derived from the bucket's RANK in the mix,
+    so the same mix always builds the same runner (determinism is pinned by
+    tests/test_tune_online.py)."""
+    if mix is None:
+        raise ValueError(
+            "wamlive synthesizes its preset from an observed mix: pass "
+            "mix=<WorkloadMix> (wam_tpu.tune.mix.mine_ledger)")
+    from wam_tpu.core.engine import WamEngine
+    from wam_tpu.core.estimators import smoothgrad
+    from wam_tpu.models.toy import toy_conv_model
+
+    weights = mix.weights()
+    specs = []  # (size, batch, weight) per dominant bucket, heaviest first
+    for b in mix.dominant(top_n):
+        size = int(b.shape[-1]) if b.shape else 16
+        size = max(8, min(64, size))
+        batch = max(1, min(8, int(round(b.mean_batch)) or 1))
+        specs.append((size, batch, weights.get(b.key, 0.0)))
+    wsum = sum(w for _, _, w in specs) or 1.0
+    reps = [max(1, int(round(total_reps * w / wsum))) for _, _, w in specs]
+    dom_size, dom_batch, _ = specs[0]
+
+    model = toy_conv_model(ndim=2)
+    inputs = []  # one (x, y) per bucket, keyed by rank — mix-deterministic
+    for rank, (size, batch, _w) in enumerate(specs):
+        x = jax.random.normal(jax.random.PRNGKey(rank + 1),
+                              (batch, size, size))
+        y = jnp.arange(batch, dtype=jnp.int32) % 4
+        inputs.append((x, y))
+
+    def build(cand: Candidate):
+        from wam_tpu.wavelets.transform import set_synth2_impl
+
+        set_synth2_impl(cand.synth_impl if cand.synth_impl is not None
+                        else "auto")
+        engine = WamEngine(model, ndim=2, wavelet="haar", level=2,
+                           mode="reflect")
+        chunk = cand.sample_chunk
+        stream = bool(cand.stream_noise)
+
+        @jax.jit
+        def run(key):
+            # one smoothgrad body per (bucket, rep); weight-proportional
+            # reps make the heavy bucket dominate the measured time the
+            # way it dominates live traffic. Reduced to one scalar so the
+            # runner's output transfer is O(1) regardless of mix width.
+            total = jnp.float32(0.0)
+            i = 0
+            for (x, y), r in zip(inputs, reps):
+                def step(noisy, y=y):
+                    _, grads = engine.attribute(noisy, y)
+                    return grads
+                for _ in range(r):
+                    g = smoothgrad(step, x, jax.random.fold_in(key, i),
+                                   n_samples=n_samples, stdev_spread=0.25,
+                                   batch_size=chunk,
+                                   materialize_noise=not stream)
+                    for leaf in jax.tree_util.tree_leaves(g):
+                        total = total + jnp.sum(jnp.abs(leaf))
+                    i += 1
+            return total
+
+        return run, (jax.random.PRNGKey(42),)
+
+    chunks = chunk_candidates(dom_batch, n_samples, targets=(8, 16))
+    cands = [Candidate(sample_chunk=c, stream_noise=False) for c in chunks]
+    cands.append(Candidate(sample_chunk=chunks[0], stream_noise=True))
+    items = sum(b * r for (_s, b, _w), r in zip(specs, reps))
+    return Workload(name="wamlive", workload="wamlive",
+                    shape=(dom_size, dom_size), batch=dom_batch,
+                    items=items, candidates=cands, build=build)
+
+
 def _wamvid3d_workload(n_samples: int = 8, batch: int = 2, frames: int = 8,
                        size: int = 16) -> Workload:
     """Video WAM sweep (anisotropic 2-spatial/1-temporal decomposition over
@@ -555,6 +643,7 @@ WORKLOADS: dict[str, Callable[..., Workload]] = {
     "mu2d": _mu2d_workload,
     "fan2d": _fan2d_workload,
     "mel1d": _mel1d_workload,
+    "wamlive": _wamlive_workload,
     "wamvit2d": _wamvit2d_workload,
     "wamvid3d": _wamvid3d_workload,
     "wamseq1d": _wamseq1d_workload,
