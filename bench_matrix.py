@@ -329,6 +329,51 @@ def main():
     record(f"wam3d_video_smooth_r3d18_b{cb}_f{frames}_{vsz}sq_s2t1_n{cn}", cb,
            _sampled(run7, k=k, laps=laps), "clips/s", run=run7)
 
+    # 8. mixed-fleet serving (round 20): ONE AttributionServer multiplexing
+    #    the audio (row 3), resnet base (row 1) and a ViT-B/16 base engine
+    #    as paged ModelSpecs — request interleaving exercises page-in, the
+    #    (model, bucket) lanes and the model-keyed EMAs end-to-end. resnet
+    #    and vit deliberately SHARE a bucket shape: only the model key
+    #    separates their lanes. Wall-clock only (the burst spans the serve
+    #    worker thread, so xplane device capture does not apply); page-in +
+    #    compile happen on the warmup lap inside _sampled.
+    from wam_tpu.serve import AttributionServer, ModelSpec
+
+    import numpy as np
+
+    vit_base = BaseWAM2D(vision_fn(vit_b16, image), wavelet="haar", J=3,
+                         mode="reflect")
+    reps = 2 if q else 8
+    serve_batch = 2 if q else 8
+    xa = np.asarray(jax.random.normal(
+        jax.random.PRNGKey(8), (wave_len,)), np.float32)
+    xi = np.asarray(jax.random.normal(
+        jax.random.PRNGKey(9), (3, image, image)), np.float32)
+    server8 = AttributionServer(
+        lambda xs, ys: xs,  # default entry unused: every request is paged
+        [(wave_len,), (3, image, image)], max_batch=serve_batch,
+        warmup=False,
+        models=[
+            ModelSpec("audio", lambda: ex3.serve_entry(),
+                      buckets=[(wave_len,)]),
+            ModelSpec("resnet", lambda: base.serve_entry(),
+                      buckets=[(3, image, image)]),
+            ModelSpec("vit", lambda: vit_base.serve_entry(),
+                      buckets=[(3, image, image)]),
+        ])
+    reqs8 = [("audio", xa), ("resnet", xi), ("vit", xi)] * reps
+
+    def run8():
+        futs = [server8.submit(x, 0, model=m) for m, x in reqs8]
+        for f in futs:
+            f.result()
+
+    try:
+        record(f"serve_multimodel_audio_resnet50_vitb16_r{reps}x3",
+               len(reqs8), _sampled(run8, k=k, laps=1), "reqs/s")
+    finally:
+        server8.close()
+
 
 if __name__ == "__main__":
     main()

@@ -1,0 +1,476 @@
+"""Multi-model residency + tenant fairness (`wam_tpu/serve/models.py`,
+round 20): the pager's residency state machine (page-in at
+``compile_count == 0`` from a registry bundle, watermark-driven eviction,
+evict-while-busy refusal, the kill switch), tenant-fair lane ordering,
+per-tenant admission quotas and cache partitions, the ``@class@tenant``
+SLO ladder, and the model-keyed EMA / ledger-row plumbing.
+
+Like test_serve.py, the operational tests drive the worker loop with
+gated fake entries (threading.Event handshakes, no sleeps); the
+zero-compile page-in test reuses test_registry.py's publish → hydrate
+round-trip at the server level."""
+
+import json
+import threading
+
+import jax
+import numpy as np
+import pytest
+
+from wam_tpu.registry import publish_bundle
+from wam_tpu.serve import (
+    AttributionServer,
+    Bucket,
+    MemoryAdmissionError,
+    ModelPager,
+    ModelSpec,
+    QueueFullError,
+    ServeMetrics,
+)
+from wam_tpu.serve.result_cache import ResultCache
+from wam_tpu.serve.runtime import _Lanes, _Request
+
+
+# -- spec validation ----------------------------------------------------------
+
+
+def test_model_spec_validation():
+    f = lambda: None
+    with pytest.raises(ValueError):
+        ModelSpec("", f)
+    with pytest.raises(ValueError):
+        ModelSpec("a|b", f)  # '|' delimits model-prefixed EMA keys
+    with pytest.raises(ValueError):
+        ModelSpec("a@b", f)  # '@' delimits SLO ladder segments
+    with pytest.raises(TypeError):
+        ModelSpec("m", "not-callable")
+    with pytest.raises(ValueError):
+        ModelPager([ModelSpec("m", f), ModelSpec("m", f)])
+
+
+# -- pager state machine (unit) -----------------------------------------------
+
+
+def _fake_page_in(spec):
+    return (lambda xs, ys: xs), int(spec.est_bytes)
+
+
+def test_pager_pages_in_once_and_touches_lru():
+    pager = ModelPager([ModelSpec("m1", lambda: None, est_bytes=100)])
+    built = []
+
+    def page_in(spec):
+        built.append(spec.model_id)
+        return object(), spec.est_bytes
+
+    e1 = pager.ensure("m1", page_in)
+    e2 = pager.ensure("m1", page_in)  # resident: no second build
+    assert e1 is e2
+    assert built == ["m1"]
+    assert pager.resident() == {"m1": 100}
+    assert pager.resident_bytes() == 100
+    assert pager.entry("m1") is e1
+    with pytest.raises(KeyError):
+        pager.ensure("nope", page_in)
+    with pytest.raises(KeyError):
+        pager.entry("m2")  # configured but cold: callers ensure first
+
+
+def test_pager_budget_evicts_lru_weighted_by_ema():
+    """Two residents, room for one more: the idle-and-cheap model pages
+    out first (score = idle_s / max(ema, seed)), the recently-hot or
+    expensive one stays."""
+    emas = {"cheap": 0.001, "costly": 5.0}
+    pager = ModelPager(
+        [ModelSpec(m, lambda: None, est_bytes=100)
+         for m in ("cheap", "costly", "third")],
+        budget_bytes=250, ema_fn=lambda m: emas.get(m, 0.0))
+    pager.ensure("cheap", _fake_page_in)
+    pager.ensure("costly", _fake_page_in)
+    # same idle clock, wildly different EMA weight -> "cheap" scores
+    # higher (idle/0.001 >> idle/5.0) and is the victim
+    pager.ensure("third", _fake_page_in)
+    assert set(pager.resident()) == {"costly", "third"}
+    assert pager.pageouts == 1
+    assert pager.describe()["pageouts"] == 1
+
+
+def test_pager_refuses_when_only_busy_models_pin_budget():
+    pager = ModelPager(
+        [ModelSpec("busy", lambda: None, est_bytes=200),
+         ModelSpec("in", lambda: None, est_bytes=200)],
+        budget_bytes=250, busy_fn=lambda m: True, retry_after_s=0.5)
+    pager.ensure("busy", _fake_page_in)
+    with pytest.raises(MemoryAdmissionError) as ei:
+        pager.ensure("in", _fake_page_in)
+    assert ei.value.retry_after_s == 0.5
+    assert "model:in" in str(ei.value)
+    assert pager.resident() == {"busy": 200}  # nothing was evicted
+
+
+def test_pager_kill_switch_disables_eviction(monkeypatch):
+    monkeypatch.setenv("WAM_TPU_NO_MODEL_PAGING", "1")
+    pager = ModelPager(
+        [ModelSpec("a", lambda: None, est_bytes=200),
+         ModelSpec("b", lambda: None, est_bytes=200)],
+        budget_bytes=250)
+    pager.ensure("a", _fake_page_in)
+    pager.ensure("b", _fake_page_in)  # over budget, but paging is off
+    assert set(pager.resident()) == {"a", "b"}
+    assert pager.pageouts == 0
+    assert pager.describe()["paging_disabled"]
+
+
+# -- server-level residency ---------------------------------------------------
+
+
+class _GateEntry:
+    """Fake entry that parks calls until released — deterministic
+    in-flight state without sleeps (test_serve.py's gate). The gate
+    starts OPEN so page-in warmup dispatches pass straight through;
+    tests arm it with `hold()` when they need a parked batch."""
+
+    def __init__(self, scale=2.0):
+        self.scale = scale
+        self.calls = 0
+        self.entered = threading.Event()
+        self.release = threading.Event()
+        self.release.set()  # gating is opt-in via hold()
+
+    def hold(self):
+        self.entered.clear()
+        self.release.clear()
+
+    def __call__(self, xs, ys):
+        self.calls += 1
+        self.entered.set()
+        assert self.release.wait(timeout=10), "test gate never released"
+        return np.asarray(xs) * self.scale
+
+
+def test_server_multiplexes_models_with_isolated_results(tmp_path):
+    """One server, two paged models + the pinned default entry: each
+    (model, bucket) lane serves its own entry, EMA keys are
+    model-prefixed, and the serve_batch ledger rows carry model_id."""
+    ledger = str(tmp_path / "serve.jsonl")
+    server = AttributionServer(
+        lambda xs, ys: np.asarray(xs) * 1.0, [(4,)], max_batch=2,
+        max_wait_ms=0.0, warmup=False, labeled=False, metrics_path=ledger,
+        models=[ModelSpec("m2", lambda: _GateEntry(2.0), est_bytes=64),
+                ModelSpec("m3", lambda: _GateEntry(3.0), est_bytes=64)],
+    )
+    x = np.ones((4,), np.float32)
+    try:
+        np.testing.assert_array_equal(server.attribute(x), x)
+        np.testing.assert_array_equal(server.attribute(x, model="m2"), x * 2)
+        np.testing.assert_array_equal(server.attribute(x, model="m3"), x * 3)
+        assert server.models_resident() == {"m2": 64, "m3": 64}
+        emas = server.metrics.ema_service_s()
+        assert "m2|4" in emas and "m3|4" in emas and "4" in emas
+        with pytest.raises(ValueError):
+            server.attribute(x, model="unknown")
+        desc = server.describe()
+        assert desc["models"]["pageins"] == 2
+    finally:
+        server.close()
+    rows = [json.loads(line) for line in open(ledger)]
+    batch_models = {r.get("model_id") for r in rows
+                    if r.get("metric") == "serve_batch"}
+    assert batch_models == {None, "m2", "m3"}
+    snap = [r for r in rows if r.get("metric") == "obs_snapshot"]
+    assert snap and snap[-1]["models_resident"] == {"m2": 64, "m3": 64}
+
+
+def test_server_evict_while_in_flight_refused():
+    """A model with a parked in-flight batch is never evicted: paging in
+    a third model under a budget with only busy residents is refused as
+    memory backpressure; after the batch completes the page-in
+    succeeds and the idle model is the victim."""
+    gate = _GateEntry(2.0)
+    est = 10 * 2**20
+    server = AttributionServer(
+        lambda xs, ys: np.asarray(xs), [(4,)], max_batch=1,
+        max_wait_ms=0.0, warmup=False, labeled=False,
+        memory=int(est * 1.5),
+        models=[ModelSpec("busy", lambda: gate, est_bytes=est),
+                ModelSpec("other", lambda: _GateEntry(3.0), est_bytes=est)],
+    )
+    x = np.ones((4,), np.float32)
+    try:
+        # page "busy" in and serve once (gate open: warmup + serve pass)
+        np.testing.assert_array_equal(server.attribute(x, model="busy"),
+                                      x * 2)
+        gate.hold()
+        fut = server.submit(x, model="busy")
+        assert gate.entered.wait(timeout=10)  # parked in dispatch
+        with pytest.raises(MemoryAdmissionError):
+            server.submit(x, model="other")
+        gate.release.set()
+        np.testing.assert_array_equal(fut.result(timeout=10), x * 2)
+        np.testing.assert_array_equal(
+            server.attribute(x, model="other"), x * 3)
+        assert server.models_resident() == {"other": est}  # busy evicted
+    finally:
+        gate.release.set()
+        server.close()
+
+
+def test_min_confidence_rejected_for_paged_models():
+    server = AttributionServer(
+        lambda xs, ys: np.asarray(xs), [(4,)], max_batch=1, warmup=False,
+        labeled=False,
+        models=[ModelSpec("m", lambda: (lambda xs, ys: np.asarray(xs)))],
+    )
+    try:
+        with pytest.raises(ValueError):
+            server.submit(np.ones((4,), np.float32), model="m",
+                          min_confidence=0.5)
+    finally:
+        server.close()
+
+
+def _toy_wam2d():
+    from wam_tpu.models.toy import toy_conv_model
+    from wam_tpu.wam2d import BaseWAM2D
+
+    toy = toy_conv_model(jax.random.PRNGKey(0), ndim=2)
+    return BaseWAM2D(lambda x: toy(x.mean(axis=1)), J=2)
+
+
+def test_model_pages_in_from_bundle_at_zero_compiles(tmp_path, monkeypatch):
+    """The tentpole acceptance invariant: a cold paged model whose spec
+    carries a registry bundle serves its FIRST request with zero entry
+    traces — page-in is a hydration, not a compile — bit-identical to
+    the publisher."""
+    pub = tmp_path / "pub-aot"
+    monkeypatch.setenv("WAM_TPU_AOT_CACHE", str(pub))
+    monkeypatch.setenv("WAM_TPU_SCHEDULE_CACHE", str(tmp_path / "s.json"))
+    wam = _toy_wam2d()
+    x = np.asarray(jax.random.normal(jax.random.PRNGKey(1), (1, 16, 16)))
+
+    cold = []
+    server = AttributionServer(
+        wam.serve_entry(), [(1, 16, 16)], max_batch=2, warmup=False,
+        models=[ModelSpec(
+            "toy", lambda: wam.serve_entry(
+                on_trace=lambda: cold.append(1), aot_key="mm-toy"))],
+    )
+    try:
+        ref = server.attribute(x, 2, model="toy")  # pages in + compiles
+    finally:
+        server.close()
+    assert cold == [1]  # publisher page-in exported the executable
+
+    bundle = str(tmp_path / "bundle")
+    publish_bundle(bundle, aot_dir=str(pub), include_xla=False,
+                   schedule_path=str(tmp_path / "s.json"))
+    monkeypatch.setenv("WAM_TPU_AOT_CACHE", str(tmp_path / "cold-aot"))
+
+    warm = []
+    metrics = ServeMetrics()
+    server = AttributionServer(
+        wam.serve_entry(), [(1, 16, 16)], max_batch=2, warmup=False,
+        metrics=metrics,
+        models=[ModelSpec(
+            "toy", lambda: wam.serve_entry(
+                on_trace=lambda: warm.append(1), aot_key="mm-toy"),
+            registry=bundle)],
+    )
+    try:
+        got = server.attribute(x, 2, model="toy")
+        assert server.models_resident().keys() == {"toy"}
+        assert server.describe()["models"]["resident"]["toy"]["pagein_s"] > 0
+    finally:
+        server.close()
+    assert warm == []  # the bundle, not a compile, paid the page-in
+    np.testing.assert_allclose(got, ref, atol=1e-6)
+
+
+# -- tenant fairness ----------------------------------------------------------
+
+
+def _req(tenant, t=0.0, qos="interactive"):
+    return _Request(np.zeros((4,), np.float32), None, Bucket.of((4,)),
+                    t, None, qos=qos, tenant=tenant)
+
+
+def test_lanes_pop_round_robins_across_tenants():
+    lanes = _Lanes()
+    for r in [_req("a", 0), _req("a", 1), _req("a", 2), _req("b", 3),
+              _req("b", 4), _req("c", 5)]:
+        lanes.append(r)
+    take = lanes.pop(3)
+    # one from each tenant present, FIFO within each — not a:0,1,2
+    assert sorted(r.tenant for r in take) == ["a", "b", "c"]
+    assert [r.t_submit for r in take if r.tenant == "a"] == [0]
+    take2 = lanes.pop(3)
+    assert sorted(r.tenant for r in take2) == ["a", "a", "b"]
+    assert len(lanes) == 0
+
+
+def test_lanes_single_tenant_is_exact_fifo():
+    lanes = _Lanes()
+    for t in range(5):
+        lanes.append(_req(None, t))
+    assert [r.t_submit for r in lanes.pop(3)] == [0, 1, 2]
+    assert [r.t_submit for r in lanes.pop(3)] == [3, 4]
+
+
+def test_tenant_quota_floods_bounce_others_admit():
+    gate = _GateEntry()
+    gate.hold()
+    server = AttributionServer(
+        gate, [(4,)], max_batch=1, max_wait_ms=0.0, queue_depth=8,
+        warmup=False, labeled=False, tenant_quota=0.25,
+    )
+    x = np.zeros((4,), np.float32)
+    try:
+        first = server.submit(x)  # parks the worker (no tenant, no quota)
+        assert gate.entered.wait(timeout=10)
+        server.submit(x, tenant="flood")
+        server.submit(x, tenant="flood")  # cap = ceil(8 * 0.25) = 2
+        with pytest.raises(QueueFullError):
+            server.submit(x, tenant="flood")
+        # the flooding tenant's quota does not tax the others
+        server.submit(x, tenant="quiet")
+        server.submit(x)
+        gate.release.set()
+        first.result(timeout=10)
+    finally:
+        gate.release.set()
+        server.close()
+    assert server.metrics.rejected == 1
+    assert server.metrics.completed == 5
+
+
+def test_tenant_quota_validation():
+    with pytest.raises(ValueError):
+        AttributionServer(lambda xs, ys: xs, [(4,)], warmup=False,
+                          labeled=False, tenant_quota=1.5)
+
+
+# -- per-tenant result-cache partitions ---------------------------------------
+
+
+def test_cache_tenant_shards_isolate_and_fair_share():
+    cache = ResultCache(4096, cache_id="t")
+    v = np.zeros((128,), np.float32)  # 512B each; 8 fit globally
+    cache.put("kb", v, tenant="b")
+    assert cache.get("kb", tenant="a") is None  # shard isolation
+    assert cache.get("kb", tenant="b") is not None
+    # tenant "a" floods: fair share (4096 // 2 live shards = 2048 = 4
+    # entries) bounds its own shard; "b"'s entry survives
+    for i in range(16):
+        cache.put(f"ka{i}", v, tenant="a")
+    assert cache.get("kb", tenant="b") is not None
+    st = cache.stats()
+    assert st["tenants"]["a"]["entries"] <= 4
+    assert st["tenants"]["a"]["bytes"] <= 2048
+    assert st["tenants"]["b"]["hits"] == 2 and st["tenants"]["b"]["misses"] == 0
+    assert st["tenants"]["a"]["misses"] == 1
+    assert st["entries"] == st["tenants"]["a"]["entries"] + 1
+
+
+def test_cache_key_folds_model_identity():
+    cache = ResultCache(4096, cache_id="e")
+    x = np.ones((4,), np.float32)
+    assert cache.key(x, 1) != cache.key(x, 1, model="m")
+    assert cache.key(x, 1, model="m") != cache.key(x, 1, model="n")
+    assert cache.key(x, 1, model="m").endswith("|m")
+
+
+def test_server_tenant_cache_hits_are_per_tenant():
+    server = AttributionServer(
+        lambda xs, ys: np.asarray(xs) * 2.0, [(4,)], max_batch=1,
+        max_wait_ms=0.0, warmup=False, labeled=False,
+        result_cache=1 << 20,
+    )
+    x = np.ones((4,), np.float32)
+    try:
+        server.attribute(x, tenant="a")
+        server.attribute(x, tenant="a")  # exact replay: a's shard hit
+        server.attribute(x, tenant="b")  # same bytes, b's shard: a miss
+        st = server.metrics.result_cache.stats()
+        assert st["tenants"]["a"]["hits"] == 1
+        assert st["tenants"]["b"]["hits"] == 0
+        assert st["tenants"]["b"]["misses"] == 1
+    finally:
+        server.close()
+
+
+# -- SLO tenant ladder --------------------------------------------------------
+
+
+def test_slo_ladder_resolves_tenant_windows():
+    from wam_tpu.obs.slo import SLObjectives, SLOTracker, parse_slo
+
+    tr = SLOTracker({
+        "4@interactive@vip": SLObjectives(p99_ms=10.0),
+        "*@interactive@vip": SLObjectives(p99_ms=20.0),
+        "4@interactive": SLObjectives(p99_ms=30.0),
+        "*@interactive": SLObjectives(p99_ms=40.0),
+        "4": SLObjectives(p99_ms=50.0),
+        "*": SLObjectives(p99_ms=60.0),
+    })
+    assert tr.objectives_for("4@interactive@vip").p99_ms == 10.0
+    assert tr.objectives_for("8@interactive@vip").p99_ms == 20.0
+    assert tr.objectives_for("4@interactive@other").p99_ms == 30.0
+    assert tr.objectives_for("8@interactive@other").p99_ms == 40.0
+    assert tr.objectives_for("4@batch@vip").p99_ms == 50.0
+    assert tr.objectives_for("8@batch").p99_ms == 60.0
+    with pytest.raises(ValueError):
+        parse_slo("4@@vip: p99_ms=10")  # empty QoS segment
+
+    tr.note("4", latency_s=0.001, qos="interactive", tenant="vip", now=1.0)
+    row = tr.snapshot_row(publish=False, now=1.5)
+    assert "4@interactive@vip" in row["buckets"]
+    assert row["tenants"] == ["vip"]
+
+
+# -- ledger mining ------------------------------------------------------------
+
+
+def test_mix_mines_model_and_tenant_dimensions():
+    from wam_tpu.tune.mix import mine_rows
+
+    rows = [
+        {"metric": "serve_batch", "timestamp": 1.0 + i, "n_real": 2,
+         "bucket": [4], "service_s": 0.01, "qos": {"interactive": 2},
+         "model_id": "m1", "tenants": {"a": 1, "b": 1}}
+        for i in range(4)
+    ] + [
+        {"metric": "serve_batch", "timestamp": 10.0, "n_real": 1,
+         "bucket": [4], "service_s": 0.02, "qos": {"batch": 1}},
+    ]
+    mix = mine_rows(rows)
+    assert set(mix.buckets) == {"m1|4", "4"}
+    assert mix.buckets["m1|4"].model_id == "m1"
+    assert mix.buckets["m1|4"].items == 8
+    assert mix.tenants == {"a": 4, "b": 4}
+    d = mix.to_dict()
+    assert d["buckets"]["m1|4"]["model_id"] == "m1"
+    assert "model_id" not in d["buckets"]["4"]
+    assert d["tenants"] == {"a": 4, "b": 4}
+
+
+def test_serve_batch_rows_carry_tenant_counts(tmp_path):
+    ledger = str(tmp_path / "serve.jsonl")
+    server = AttributionServer(
+        lambda xs, ys: np.asarray(xs), [(4,)], max_batch=4,
+        max_wait_ms=20.0, warmup=False, labeled=False,
+        metrics_path=ledger,
+    )
+    x = np.ones((4,), np.float32)
+    try:
+        futs = [server.submit(x, tenant=t) for t in ("a", "a", "b", None)]
+        for f in futs:
+            f.result(timeout=10)
+    finally:
+        server.close()
+    rows = [json.loads(line) for line in open(ledger)]
+    batches = [r for r in rows if r.get("metric") == "serve_batch"]
+    counts: dict = {}
+    for r in batches:
+        for t, n in (r.get("tenants") or {}).items():
+            counts[t] = counts.get(t, 0) + n
+    assert counts == {"a": 2, "b": 1}  # None submits are not counted

@@ -358,6 +358,10 @@ class ServeConfig:
     health_quarantine_n: int = 3  # consecutive non-finite batches -> degraded
     health_recovery_s: float = 30.0  # quarantine probation window
     hbm_budget_mb: float = 0.0  # per-replica HBM budget (MiB); 0 = no limit
+    # per-tenant admission quota as a fraction of queue_depth (serve/runtime
+    # "Tenant-fair admission"): one tenant may hold at most
+    # max(1, queue_depth * tenant_quota) queued requests. 0 = no quota.
+    tenant_quota: float = 0.0
     # per-bucket SLOs, e.g. "p99_ms=50,error_rate=0.01,health_rate=0.999"
     # optionally bucket-prefixed: "3x224x224: p99_ms=30; *: p99_ms=80"
     slo: str = ""

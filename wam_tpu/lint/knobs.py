@@ -57,6 +57,10 @@ KNOB_DOCS = {
     "WAM_TPU_NO_ANYTIME":
         "`1` disables anytime serving: servers over anytime entries fall "
         "back to full-n synchronous attribution (kill switch)",
+    "WAM_TPU_NO_MODEL_PAGING":
+        "`1` freezes multi-model residency: no eviction, page-in degrades "
+        "to grow-only (kill switch; read per call, so it can be flipped "
+        "live)",
     "WAM_TPU_DWT2_IMPL":
         "2-D DWT backend override (`auto`/`conv`/`matmul`/`pallas`)",
     "WAM_TPU_DWT1_IMPL":

@@ -35,6 +35,12 @@ METRIC_NAMES = frozenset({
     "wam_tpu_serve_restarts_total",
     "wam_tpu_serve_service_seconds",
     "wam_tpu_serve_submitted_total",
+    # multi-model residency (serve/models.py)
+    "wam_tpu_serve_model_pagein_seconds",
+    "wam_tpu_serve_model_pagein_total",
+    "wam_tpu_serve_model_pageout_total",
+    "wam_tpu_serve_model_resident",
+    "wam_tpu_serve_model_resident_bytes",
     # serve result cache (serve/result_cache.py)
     "wam_tpu_serve_cache_bytes",
     "wam_tpu_serve_cache_entries",

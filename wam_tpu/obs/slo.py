@@ -42,6 +42,15 @@ Objective resolution for a classed window walks ``bucket@class`` →
 plain-bucket windows and ladder. `penalty_s` aggregates a bucket's
 windows across classes (max burn), so the fleet's per-bucket routing
 penalty sees a violated class even when the bucket aggregate looks fine.
+
+**Tenant dimension** (multi-tenant serving): a classed key may carry a
+second suffix — ``bucket@class@tenant`` — so each tenant's traffic burns
+its own window (``note(bkey, ..., qos="interactive", tenant="acme")``).
+Resolution for a tenant window walks the exact key →
+``*@class@tenant`` → ``bucket@class`` → ``*@class`` → ``bucket`` → ``*``,
+so a tenant with no dedicated objective inherits its class's. `penalty_s`
+already aggregates by bucket prefix, so tenant windows feed the same
+routing penalty.
 """
 
 from __future__ import annotations
@@ -118,7 +127,8 @@ def parse_slo(spec) -> dict | None:
                          "min_confidence"):
                 raise ValueError(f"unknown SLO objective {k!r} in {spec!r}")
             kwargs[k] = float(v)
-        if "@" in bucket and not bucket.rsplit("@", 1)[1]:
+        parts = bucket.split("@")
+        if any(not p for p in parts[1:]):
             raise ValueError(f"empty QoS class in SLO key {bucket!r}")
         policy[bucket] = SLObjectives(**kwargs)
     return policy or None
@@ -165,14 +175,22 @@ class SLOTracker:
         self._last_publish = 0.0
 
     def objectives_for(self, bucket_key: str) -> SLObjectives | None:
-        """Policy lookup for a (possibly class-suffixed) window key:
-        ``bucket@class`` → ``*@class`` → ``bucket`` → ``*``."""
+        """Policy lookup for a (possibly suffixed) window key:
+        ``bucket@class`` → ``*@class`` → ``bucket`` → ``*``, and for a
+        tenant window (``bucket@class@tenant``) the exact key →
+        ``*@class@tenant`` → ``bucket@class`` → ``*@class`` → ``bucket``
+        → ``*`` (module docstring)."""
         obj = self.policy.get(bucket_key)
         if obj is not None:
             return obj
         if "@" in bucket_key:
-            bare, qos = bucket_key.rsplit("@", 1)
-            for k in (f"*@{qos}", bare):
+            bare, rest = bucket_key.split("@", 1)
+            candidates = [f"*@{rest}"]
+            if "@" in rest:
+                qos = rest.split("@", 1)[0]
+                candidates += [f"{bare}@{qos}", f"*@{qos}"]
+            candidates.append(bare)
+            for k in candidates:
                 obj = self.policy.get(k)
                 if obj is not None:
                     return obj
@@ -183,13 +201,18 @@ class SLOTracker:
     def note(self, bucket_key: str, *, latency_s: float = 0.0,
              ok: bool = True, healthy: bool = True,
              confidence: float = 1.0,
-             now: float | None = None, qos: str | None = None) -> None:
+             now: float | None = None, qos: str | None = None,
+             tenant: str | None = None) -> None:
         """One resolved request. ``qos`` lands the sample in the
-        ``bucket@class`` window (module docstring). ``confidence`` is the
-        anytime confidence-at-delivery (1.0 for full-n results, so plain
-        servers never burn a confidence budget). Errors and expiries go
-        through `note_error` (they have no meaningful latency sample)."""
+        ``bucket@class`` window and ``tenant`` (only meaningful with a
+        class) narrows it to ``bucket@class@tenant`` (module docstring).
+        ``confidence`` is the anytime confidence-at-delivery (1.0 for
+        full-n results, so plain servers never burn a confidence budget).
+        Errors and expiries go through `note_error` (they have no
+        meaningful latency sample)."""
         key = f"{bucket_key}@{qos}" if qos else bucket_key
+        if qos and tenant:
+            key = f"{key}@{tenant}"
         if self.objectives_for(key) is None:
             return
         now = time.perf_counter() if now is None else now
@@ -205,10 +228,13 @@ class SLOTracker:
             self.snapshot_row(now=now)
 
     def note_error(self, bucket_key: str, n: int = 1,
-                   now: float | None = None, qos: str | None = None) -> None:
+                   now: float | None = None, qos: str | None = None,
+                   tenant: str | None = None) -> None:
         """Failed/expired requests: counted against the error AND health
         budgets, no latency sample."""
         key = f"{bucket_key}@{qos}" if qos else bucket_key
+        if qos and tenant:
+            key = f"{key}@{tenant}"
         if self.objectives_for(key) is None:
             return
         now = time.perf_counter() if now is None else now
@@ -313,10 +339,15 @@ class SLOTracker:
                 _g_n.set(st["n"], replica=self._rl, bucket=bkey)
                 _g_conf.set(st["mean_confidence"], replica=self._rl,
                             bucket=bkey)
-        return {
+        row = {
             "metric": "slo_status",
             "replica_id": self.replica_id,
             "objectives": {k: asdict(v) for k, v in self.policy.items()},
             "buckets": buckets,
             "timestamp": time.time(),
         }
+        tenants = sorted({k.rsplit("@", 1)[1] for k in keys
+                          if k.count("@") >= 2})
+        if tenants:
+            row["tenants"] = tenants
+        return row

@@ -24,7 +24,8 @@ Message grammar::
     router -> worker   {"op": "registry_bundle", files}
     worker -> router   {"op": "hello", worker_id, pid, host, snapshot,
                         buckets}
-    router -> worker   {"op": "submit", req_id, x, y, deadline_ms, ctx}
+    router -> worker   {"op": "submit", req_id, x, y, deadline_ms, qos,
+                        model, tenant, ctx}
     worker -> router   {"op": "result", req_id, ok, value | error}
     router -> worker   {"op": "health", t_send}
     worker -> router   {"op": "health_reply", t_send, t_worker, snapshot}
@@ -113,6 +114,9 @@ class WorkerSnapshot:
     compile_count: int = 0
     post_warm_compiles: int = 0
     warm_s: float = 0.0  # wall time from process start to ready
+    # paged models resident on this worker's fleet (model_id -> bytes);
+    # empty = none resident OR a pre-round-20 worker (back-compat default)
+    models_resident: dict = field(default_factory=dict)
 
 
 def encode_error(exc: Exception) -> dict:

@@ -75,7 +75,7 @@ from wam_tpu.pod.protocol import AUTHKEY_ENV, Channel, decode_error
 from wam_tpu.pod.supervisor import PodSupervisor
 from wam_tpu.serve.buckets import BucketTable, bucket_key
 from wam_tpu.serve.metrics import EMA_SEED_S
-from wam_tpu.serve.fleet import INTERACTIVE_DEPTH_WEIGHT
+from wam_tpu.serve.fleet import INTERACTIVE_DEPTH_WEIGHT, MODEL_PAGEIN_PENALTY_S
 from wam_tpu.serve.runtime import (
     DeadlineExceededError,
     QueueFullError,
@@ -141,6 +141,8 @@ class _PodRequest:
     future: Future
     t_submit: float
     qos: str = "interactive"
+    model: str | None = None
+    tenant: str | None = None
     tried: set = field(default_factory=set)
     # tightest QueueFullError retry_after per HOST that rejected; the
     # terminal error min-reduces ACROSS hosts (satellite: a pod is now
@@ -806,10 +808,14 @@ class PodRouter:
     # -- client side --------------------------------------------------------
 
     def submit(self, x, y=None, deadline_ms: float | None = None,
-               qos: str = "interactive") -> Future:
+               qos: str = "interactive", model: str | None = None,
+               tenant: str | None = None) -> Future:
         """Admit one item and route it to the best live worker. ``qos``
         rides the wire to the worker fleet's admission lanes (and weighs
-        into routing via each worker's heartbeat ``qos_depth``). The
+        into routing via each worker's heartbeat ``qos_depth``); so do
+        ``model`` (a paged-model id, validated worker-side, weighed into
+        routing via heartbeat ``models_resident``) and ``tenant`` (the
+        fair-share lane / cache-partition key). The
         returned future survives worker death by re-routing; it fails
         typed (`QueueFullError` / `NoLiveWorkerError` / deadline) when
         the pod genuinely cannot take the work."""
@@ -822,7 +828,8 @@ class PodRouter:
         now = time.perf_counter()
         deadline_at = now + deadline_ms / 1e3 if deadline_ms else None
         req = _PodRequest(next(self._req_ids), x, y, bucket_key(bucket.shape),
-                          deadline_at, Future(), now, qos=qos)
+                          deadline_at, Future(), now, qos=qos, model=model,
+                          tenant=tenant)
         if obs_tracing._STATE.enabled:
             root = obs_tracing.start_span("request", cat="pod",
                                           bucket=req.bkey)
@@ -841,8 +848,10 @@ class PodRouter:
         return req.future
 
     def attribute(self, x, y=None, deadline_ms: float | None = None,
-                  qos: str = "interactive"):
-        return self.submit(x, y, deadline_ms=deadline_ms, qos=qos).result()
+                  qos: str = "interactive", model: str | None = None,
+                  tenant: str | None = None):
+        return self.submit(x, y, deadline_ms=deadline_ms, qos=qos,
+                           model=model, tenant=tenant).result()
 
     def submit_with_retry(self, x, y=None, *, policy=None, stats=None,
                           rng=None, deadline_ms: float | None = None) -> Future:
@@ -910,11 +919,13 @@ class PodRouter:
         with self._lock:
             return self._spawn_ema_s
 
-    def _score(self, w: _Worker, bkey: str) -> float:
+    def _score(self, w: _Worker, bkey: str,
+               model: str | None = None) -> float:
         s = w.snapshot
         if s is None:
             return float("inf")
-        ema = s.ema_service_s.get(bkey)
+        ema = s.ema_service_s.get(f"{model}|{bkey}" if model else bkey,
+                                  s.ema_service_s.get(bkey))
         if ema is None:
             ema = (sum(s.ema_service_s.values()) / len(s.ema_service_s)
                    if s.ema_service_s else EMA_SEED_S)
@@ -934,8 +945,14 @@ class PodRouter:
         # cannot under-count.
         drain = max(0.0, s.projected_drain_s
                     - (time.monotonic() - w.snapshot_t))
-        return (drain + inflight * ema + s.slo_penalty_s
-                + INTERACTIVE_DEPTH_WEIGHT * interactive_depth * ema)
+        score = (drain + inflight * ema + s.slo_penalty_s
+                 + INTERACTIVE_DEPTH_WEIGHT * interactive_depth * ema)
+        # paged-model affinity: a worker whose fleet already holds the
+        # model resident skips the page-in stall, same discipline the
+        # in-process fleet applies per replica (serve.fleet)
+        if model is not None and model not in (s.models_resident or {}):
+            score += MODEL_PAGEIN_PENALTY_S
+        return score
 
     def _route(self, req: _PodRequest, raise_errors: bool) -> None:
         def _fail(exc: Exception) -> None:
@@ -993,7 +1010,8 @@ class PodRouter:
             # the drain term's job). A hard tier would starve remote
             # hosts whenever local workers merely have queue room.
             penalty = 0.0 if local else host_rtt.get(host, 0.0)
-            return (full, self._score(w, req.bkey) + penalty, w.wid)
+            return (full, self._score(w, req.bkey, req.model) + penalty,
+                    w.wid)
 
         while cands:
             # score->choose->inflight-insert is atomic under _route_lock
@@ -1022,7 +1040,7 @@ class PodRouter:
                 chosen.chan.send({
                     "op": "submit", "req_id": req.req_id, "x": req.x,
                     "y": req.y, "deadline_ms": remaining_ms, "ctx": req.ctx,
-                    "qos": req.qos,
+                    "qos": req.qos, "model": req.model, "tenant": req.tenant,
                 })
             except (OSError, AttributeError):
                 # died between the candidate snapshot and the send: undo

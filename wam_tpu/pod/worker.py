@@ -252,6 +252,7 @@ def main(argv=None) -> int:
             compile_count=sig["compile_count"],
             post_warm_compiles=obs_sentinel.trace_count() - warm_traces,
             warm_s=warm_s,
+            models_resident=sig.get("models_resident", {}),
         )
 
     if chan is None:
@@ -294,7 +295,9 @@ def main(argv=None) -> int:
                 with obs_tracing.use_context(ctx):
                     fut = server.submit(msg["x"], msg.get("y"),
                                         deadline_ms=msg.get("deadline_ms"),
-                                        qos=msg.get("qos", "interactive"))
+                                        qos=msg.get("qos", "interactive"),
+                                        model=msg.get("model"),
+                                        tenant=msg.get("tenant"))
             except Exception as e:  # noqa: BLE001 - typed over the wire
                 _send_result(req_id, _failed_future(e))
                 continue

@@ -22,6 +22,7 @@ from wam_tpu.serve.buckets import Bucket, BucketTable, NoBucketError, bucket_key
 from wam_tpu.serve.entry import fleet_aot_key, jit_entry
 from wam_tpu.serve.fleet import OVERSIZE_ENTRY_ID, FleetServer, NoLiveReplicaError
 from wam_tpu.serve.metrics import SCHEMA_VERSION, FleetMetrics, ServeMetrics, percentile_ms
+from wam_tpu.serve.models import ModelPager, ModelSpec, model_paging_disabled
 from wam_tpu.serve.result_cache import ResultCache, result_cache_key
 from wam_tpu.serve.retry import RetryBudgetExceededError, RetryPolicy, RetryStats
 from wam_tpu.serve.runtime import (
@@ -63,6 +64,9 @@ __all__ = [
     "percentile_ms",
     "ResultCache",
     "result_cache_key",
+    "ModelSpec",
+    "ModelPager",
+    "model_paging_disabled",
     "QOS_CLASSES",
     "jit_entry",
     "fleet_aot_key",

@@ -91,6 +91,7 @@ from wam_tpu.serve.buckets import (
     pad_item,
 )
 from wam_tpu.serve.metrics import EMA_SEED_S, FleetMetrics, ServeMetrics
+from wam_tpu.serve.models import ModelSpec
 from wam_tpu.serve.result_cache import ResultCache
 from wam_tpu.serve.runtime import (
     QOS_CLASSES,
@@ -103,10 +104,17 @@ from wam_tpu.serve.runtime import (
 )
 
 __all__ = ["FleetServer", "NoLiveReplicaError", "OVERSIZE_ENTRY_ID",
-           "INTERACTIVE_DEPTH_WEIGHT"]
+           "INTERACTIVE_DEPTH_WEIGHT", "MODEL_PAGEIN_PENALTY_S"]
 
 # entry_factory's replica_id for the fleet-wide oversize pjit entry
 OVERSIZE_ENTRY_ID = "fleet"
+
+# routing penalty (seconds) for sending a paged model's request to a
+# replica where that model is NOT resident: a page-in (hydration + first
+# dispatch) is far dearer than a warm dispatch, so the router prefers
+# replicas already holding the model — but a loaded resident replica can
+# still lose to an idle cold one once its drain exceeds this
+MODEL_PAGEIN_PENALTY_S = 0.25
 
 # routing weight on a replica's queued-interactive depth (`_score`): each
 # max_batch worth of queued interactive work on a replica makes it look
@@ -152,6 +160,11 @@ class _FleetRequest:
     # anytime serving: the per-request confidence floor, threaded to
     # whichever replica wins the route (wam_tpu.anytime)
     min_confidence: float = 0.0
+    # multi-model routing: which paged model serves this request (None =
+    # the default entry); survives re-routes like the rest of the state
+    model: str | None = None
+    # fair-share identity: lanes/quota/cache-partition/SLO-window key
+    tenant: str | None = None
     # fleet-tier result-cache key (None = cache off): computed once at
     # submit, survives re-routes, populated from whichever replica wins
     ckey: str | None = None
@@ -230,6 +243,16 @@ class FleetServer:
         Replicas themselves carry no cache.
     cache_id : entry identity baked into fleet cache keys (defaults to
         the entry factory's ``__name__``).
+    models : additional paged model families served by every replica
+        (`serve.models.ModelSpec` list/dict; `AttributionServer` docs).
+        Fleet-level spec factories take ``(replica_id, metrics)`` like
+        ``entry_factory`` — each replica wraps them into its own zero-arg
+        closures, so per-replica compile accounting holds for paged
+        models too. Route with ``submit(model=...)``; the router prefers
+        replicas where the model is already resident
+        (`MODEL_PAGEIN_PENALTY_S`).
+    tenant_quota : per-tenant admission-queue share in (0, 1], forwarded
+        to every replica (`AttributionServer` docs); 0 disables quotas.
     """
 
     # checked by the lock-discipline lint rule: mutations outside __init__
@@ -273,6 +296,8 @@ class FleetServer:
         registry=None,
         result_cache=None,
         cache_id: str | None = None,
+        models=None,
+        tenant_quota: float = 0.0,
     ):
         if not callable(entry_factory):
             raise TypeError("entry_factory must be callable(replica_id, metrics)")
@@ -328,7 +353,21 @@ class FleetServer:
             # in _harvest), so a hit never costs a routing decision and
             # N replicas never hold N copies of the same hot row
             result_cache=None,
+            tenant_quota=tenant_quota,
         )
+        self.tenant_quota = float(tenant_quota)
+
+        # paged model families (serve.models): normalized to a spec map;
+        # factories stay fleet-level 2-arg here, wrapped per replica in
+        # _server_models so each replica owns its entries
+        specs = []
+        if models:
+            for spec in (models.values() if isinstance(models, dict)
+                         else models):
+                if isinstance(spec, dict):
+                    spec = ModelSpec(**spec)
+                specs.append(spec)
+        self._models = {s.model_id: s for s in specs}
 
         # fleet-tier content-addressed result cache (serve.result_cache):
         # an int byte budget builds one; an instance is shared as-is
@@ -378,6 +417,25 @@ class FleetServer:
 
     # -- lifecycle ----------------------------------------------------------
 
+    def _server_models(self, rid, metrics):
+        """Per-replica `ModelSpec` list: the fleet-level 2-arg factories
+        (``factory(replica_id, metrics)`` — the ``entry_factory``
+        convention) become this replica's zero-arg closures, so a paged
+        model's compiles count into ITS replica's ledger."""
+        if not self._models:
+            return None
+        return [
+            ModelSpec(
+                s.model_id,
+                (lambda f=s.factory, r=rid, m=metrics: f(r, m)),
+                registry=s.registry,
+                buckets=s.buckets,
+                est_bytes=s.est_bytes,
+                cache_id=s.cache_id,
+            )
+            for s in self._models.values()
+        ]
+
     def _make_server(self, rid, metrics) -> AttributionServer:
         """Build one replica's `AttributionServer` from the fleet recipe —
         first construction and supervisor restarts share this, so a
@@ -389,6 +447,7 @@ class FleetServer:
             metrics=metrics,
             device=self.devices[rid],
             replica_id=rid,
+            models=self._server_models(rid, metrics),
             **self._server_kw,
         )
 
@@ -517,6 +576,8 @@ class FleetServer:
             ),
             "registry": (getattr(self._registry, "bundle", None)
                          or (str(self._registry) if self._registry else None)),
+            "models": sorted(self._models) if self._models else None,
+            "tenant_quota": self.tenant_quota,
         }
 
     def _restart_hint_s(self) -> float | None:
@@ -554,6 +615,20 @@ class FleetServer:
             pen = [r.server.slo_penalty_s(b.shape) for r in live]
             if pen:
                 penalties.append(sum(pen) / len(pen))
+        # paged-model lanes ride along under their model|bucket keys, so
+        # the pod router's heartbeat sees per-model service costs too
+        model_ema: dict[str, list[float]] = {}
+        for r in live:
+            for k, v in r.metrics.ema_service_s().items():
+                if "|" in k:
+                    model_ema.setdefault(k, []).append(v)
+        for k, vals in model_ema.items():
+            ema[k] = sum(vals) / len(vals)
+        models_resident: dict[str, int] = {}
+        for r in live:
+            for mid, nbytes in r.server.models_resident().items():
+                models_resident[mid] = max(
+                    models_resident.get(mid, 0), int(nbytes))
         snaps = [r.metrics.snapshot() for r in replicas]
         os_snap = self.metrics.oversize.snapshot()
         qos_depth = dict.fromkeys(QOS_CLASSES, 0)
@@ -583,6 +658,7 @@ class FleetServer:
             + os_snap["compile_count"],
             "cache_hits": cache_hits,
             "cache_hit_rate": cache_hits / max(1, cache_hits + submitted),
+            "models_resident": models_resident,
         }
 
     # -- online-tuner canary (wam_tpu.tune.online) ---------------------------
@@ -624,6 +700,7 @@ class FleetServer:
                 self._entry_factory(replica_id, replica.metrics),
                 self.table, metrics=replica.metrics,
                 device=self.devices[replica_id], replica_id=replica_id,
+                models=self._server_models(replica_id, replica.metrics),
                 **kw)
             server.start()
             with self._lock:
@@ -721,7 +798,9 @@ class FleetServer:
 
     def submit(self, x, y=None, deadline_ms: float | None = None,
                qos: str = "interactive",
-               min_confidence: float = 0.0) -> Future:
+               min_confidence: float = 0.0,
+               model: str | None = None,
+               tenant: str | None = None) -> Future:
         """Admit one item and route it to the least-loaded live replica.
         Returns a fleet-level future — it survives a replica death by
         re-routing to survivors. ``qos`` is the request's admission class
@@ -729,9 +808,13 @@ class FleetServer:
         interactive-depth weight). ``min_confidence`` is the anytime
         convergence floor, threaded to the winning replica (only
         meaningful for fleets over anytime entries —
-        `wam_tpu.anytime`). Raises `QueueFullError` only when every
-        live replica rejected; a zero/negative ``deadline_ms`` fails at
-        admission with `InvalidDeadlineError` before any routing."""
+        `wam_tpu.anytime`). ``model`` routes to a configured paged model
+        family (None = the default entry) — the router prefers replicas
+        where it is already resident. ``tenant`` is the request's
+        fair-share identity (`AttributionServer.submit`). Raises
+        `QueueFullError` only when every live replica rejected; a
+        zero/negative ``deadline_ms`` fails at admission with
+        `InvalidDeadlineError` before any routing."""
         if self.labeled and y is None:
             raise ValueError("labeled fleet: submit(x, y) needs a class label")
         if not self.labeled and y is not None:
@@ -740,14 +823,18 @@ class FleetServer:
             raise ValueError(f"qos must be one of {QOS_CLASSES}, got {qos!r}")
         if deadline_ms is not None and deadline_ms <= 0:
             raise InvalidDeadlineError(deadline_ms)
+        if model is not None and model not in self._models:
+            raise ValueError(
+                f"unknown model {model!r}; configured fleet models: "
+                f"{sorted(self._models)}")
         x = np.asarray(x, self.dtype)
         bucket = self.table.select(x.shape)  # NoBucketError before any queueing
         ckey = None
         if self._cache is not None:
             # fleet-tier consult BEFORE routing: a hit never costs a
             # replica queue slot or a scoring pass
-            ckey = self._cache.key(x, y)
-            hit = self._cache.get(ckey)
+            ckey = self._cache.key(x, y, model=model)
+            hit = self._cache.get(ckey, tenant=tenant)
             if hit is not None:
                 self.metrics.note_cache_hit()
                 fut: Future = Future()
@@ -760,6 +847,7 @@ class FleetServer:
             deadline_at = now + deadline_ms / 1e3
         req = _FleetRequest(x, y, bucket, deadline_at, Future(),
                             qos=qos, min_confidence=float(min_confidence),
+                            model=model, tenant=tenant,
                             ckey=ckey)
         if obs_tracing._STATE.enabled:
             # detached per-request root: ends on whichever thread resolves
@@ -780,10 +868,12 @@ class FleetServer:
         return req.future
 
     def attribute(self, x, y=None, deadline_ms: float | None = None,
-                  qos: str = "interactive", min_confidence: float = 0.0):
+                  qos: str = "interactive", min_confidence: float = 0.0,
+                  model: str | None = None, tenant: str | None = None):
         """Blocking convenience wrapper: submit + wait."""
         return self.submit(x, y, deadline_ms=deadline_ms, qos=qos,
-                           min_confidence=min_confidence).result()
+                           min_confidence=min_confidence,
+                           model=model, tenant=tenant).result()
 
     def submit_with_retry(self, x, y=None, *, policy=None, stats=None,
                           rng=None, deadline_ms: float | None = None) -> Future:
@@ -860,7 +950,8 @@ class FleetServer:
 
     # -- routing ------------------------------------------------------------
 
-    def _score(self, replica: _Replica, bucket: Bucket) -> float:
+    def _score(self, replica: _Replica, bucket: Bucket,
+               model: str | None = None) -> float:
         """Projected completion estimate for a new item on this replica:
         its whole-queue drain plus one batch of the item's own bucket at
         the replica's OWN per-bucket EMA (an idle-but-slow replica loses
@@ -870,10 +961,13 @@ class FleetServer:
         plus the interactive-depth weight: queued interactive work counts
         EXTRA beyond its share of raw drain (`INTERACTIVE_DEPTH_WEIGHT`),
         so interactive-loaded replicas shed new work to keep the
-        latency-sensitive lane short."""
-        ema = replica.metrics.ema_service_s(bucket.shape)
+        latency-sensitive lane short. A paged-model request reads the
+        model's own lane EMA and pays `MODEL_PAGEIN_PENALTY_S` on
+        replicas where the model is not resident, concentrating each
+        model's traffic instead of thrashing page-ins across the fleet."""
+        ema = replica.metrics.ema_service_s(bucket.shape, model=model)
         interactive_depth = replica.server.qos_depths()["interactive"]
-        return (
+        score = (
             replica.server.projected_drain_s()
             + ema
             + replica.server.slo_penalty_s(bucket.shape)
@@ -881,6 +975,9 @@ class FleetServer:
             * (interactive_depth / replica.server.max_batch)
             * ema
         )
+        if model is not None and model not in replica.server.models_resident():
+            score += MODEL_PAGEIN_PENALTY_S
+        return score
 
     def _route(self, req: _FleetRequest, raise_errors: bool) -> None:
         """Submit ``req`` to the best untried live replica; on total
@@ -918,7 +1015,7 @@ class FleetServer:
                 return _fail(DeadlineExceededError("deadline lapsed during re-route"))
         else:
             remaining_ms = None
-        cands.sort(key=lambda r: self._score(r, req.bucket))  # stable: rid ties
+        cands.sort(key=lambda r: self._score(r, req.bucket, req.model))  # stable: rid ties
         with self._lock:
             canary = self._canary
         if canary is not None:
@@ -939,7 +1036,8 @@ class FleetServer:
             try:
                 inner = r.server.submit(req.x, req.y, deadline_ms=remaining_ms,
                                         qos=req.qos,
-                                        min_confidence=req.min_confidence)
+                                        min_confidence=req.min_confidence,
+                                        model=req.model, tenant=req.tenant)
             except QueueFullError as e:
                 retry_after = (
                     e.retry_after_s
@@ -974,7 +1072,7 @@ class FleetServer:
                 # populate at the fleet tier (replicas carry no cache);
                 # degraded CPU-rebuilt entries are skipped — their rounding
                 # differs from the accelerator rows the cache promises
-                self._cache.put(req.ckey, result)
+                self._cache.put(req.ckey, result, tenant=req.tenant)
             req.future.set_result(result)
             return
         if isinstance(exc, ServerClosedError):

@@ -205,6 +205,9 @@ class ServeMetrics:
         # runtime attaches its SLOTracker so emit() can flush a slo_status
         # row next to this replica's summary (None = no SLO policy)
         self.slo = None
+        # multi-model residency: the runtime stamps {model_id: bytes} here
+        # at close so the obs_snapshot row records what was resident
+        self.models_resident = None
         # anytime serving (wam_tpu.anytime): stride-loop counters + samples
         self.anytime_batches = 0
         self.anytime_strides = 0
@@ -266,14 +269,20 @@ class ServeMetrics:
             self.warmup_s[key] = float(seconds)
         _g_warmup.set(float(seconds), replica=self._rl, bucket=key)
 
-    def ema_service_s(self, bucket_shape=None):
+    def ema_service_s(self, bucket_shape=None, model=None):
         """Per-bucket EMA batch service time — the retry-after and fleet
         routing signal. With a shape: that bucket's EMA (``EMA_SEED_S``
-        until its first batch lands). Without: a copy of the whole map."""
+        until its first batch lands). Without: a copy of the whole map.
+        ``model`` scopes the lookup to a paged model's own lane
+        (``model|bucket`` keys) so service stats cannot pollute across
+        models sharing a fleet; None reads the default entry's keys."""
         with self._lock:
             if bucket_shape is None:
                 return dict(self._ema_service_s)
-            return self._ema_service_s.get(bucket_key(bucket_shape), EMA_SEED_S)
+            key = bucket_key(bucket_shape)
+            if model is not None:
+                key = f"{model}|{key}"
+            return self._ema_service_s.get(key, EMA_SEED_S)
 
     def note_batch(
         self,
@@ -287,6 +296,8 @@ class ServeMetrics:
         queue_waits_s: list[float],
         latencies_s: list[float],
         qos: list[str] | None = None,
+        model_id: str | None = None,
+        tenants: list | None = None,
     ) -> None:
         """One dispatched batch: aggregate row + per-request samples, and
         the per-bucket service-time EMA update (first observation seeds the
@@ -294,7 +305,10 @@ class ServeMetrics:
         class list parallel to ``latencies_s`` — it splits the latency
         sample into per-class percentiles (`snapshot` ``latency_by_qos``)
         and stamps per-class counts onto the batch row (the workload-mix
-        miner's bucket × qos histogram, `tune.mix`)."""
+        miner's bucket × qos histogram, `tune.mix`). ``model_id`` scopes
+        the EMA update to the model's own ``model|bucket`` key and stamps
+        the batch row; ``tenants`` (per-request, parallel to
+        ``latencies_s``) stamps per-tenant counts onto the row."""
         occupancy = n_real / max_batch
         # resolved OUTSIDE the accumulator lock: the first call may load
         # the schedule-cache files (tune.cache takes its own lock)
@@ -310,6 +324,8 @@ class ServeMetrics:
                     self._latency_by_qos.setdefault(cls, []).append(lat)
             self.busy_s += service_s
             key = bucket_key(bucket_shape)
+            if model_id is not None:
+                key = f"{model_id}|{key}"
             prev = self._ema_service_s.get(key)
             self._ema_service_s[key] = (
                 service_s if prev is None else 0.8 * prev + 0.2 * service_s
@@ -332,6 +348,15 @@ class ServeMetrics:
                 for cls in qos:
                     counts[cls] = counts.get(cls, 0) + 1
                 row["qos"] = counts
+            if model_id is not None:
+                row["model_id"] = model_id
+            if tenants is not None:
+                tcounts: dict[str, int] = {}
+                for t in tenants:
+                    if t is not None:
+                        tcounts[t] = tcounts.get(t, 0) + 1
+                if tcounts:
+                    row["tenants"] = tcounts
             if self.replica_id is not None:
                 row["replica_id"] = self.replica_id
             self.batch_rows.append(row)
@@ -500,7 +525,7 @@ class ServeMetrics:
         if self.result_cache is not None:
             write_result_cache(writer, self.result_cache)
         if obs_snapshot:
-            write_obs_snapshot(writer)
+            write_obs_snapshot(writer, models=self.models_resident)
         return summary
 
 
@@ -528,15 +553,19 @@ def write_result_cache(writer: JsonlWriter, cache) -> dict:
     return row
 
 
-def write_obs_snapshot(writer: JsonlWriter) -> dict:
+def write_obs_snapshot(writer: JsonlWriter, models=None) -> dict:
     """One ``obs_snapshot`` ledger row: the registry's flattened values at
-    this instant (a NEW row kind — existing v2 rows are untouched)."""
+    this instant (a NEW row kind — existing v2 rows are untouched).
+    ``models`` is the resident-model map (``{model_id: bytes}``) stamped
+    as ``models_resident`` when the emitting server pages models."""
     row = {
         "metric": "obs_snapshot",
         "schema_version": SCHEMA_VERSION,
         "registry": _obs_registry.collect(),
         "timestamp": time.time(),
     }
+    if models is not None:
+        row["models_resident"] = models
     writer.write(row)
     return row
 

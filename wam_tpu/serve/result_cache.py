@@ -90,22 +90,28 @@ def cache_disabled() -> bool:
     return os.environ.get("WAM_TPU_NO_RESULT_CACHE", "") not in ("", "0")
 
 
-def result_cache_key(x: np.ndarray, y, cache_id: str) -> str:
+def result_cache_key(x: np.ndarray, y, cache_id: str,
+                     model: str | None = None) -> str:
     """Content address for one request: input digest + label + entry id +
     the live tuned-schedule fingerprint (module docstring) + the live
     precision tag. Tuned-entry precision flips already move the schedule
     fingerprint; the tag covers the ENV route (``WAM_TPU_FAN_DTYPE`` /
     ``WAM_TPU_MEL_BF16``), read per call like the fingerprint, so flipping
     a precision knob can never replay a result computed under the other
-    policy."""
+    policy. ``model`` folds a paged model's identity into the key (multi-
+    model fleets share one cache), so exact-replay hits can never cross
+    models; None keeps the historical single-model key unchanged."""
     from wam_tpu.config import precision_tag
     from wam_tpu.tune.cache import schedule_fingerprint
 
     h = hashlib.sha256()
     h.update(x.tobytes())
     h.update(repr((x.shape, str(x.dtype))).encode())
-    return (f"{h.hexdigest()}|{y}|{cache_id}|{schedule_fingerprint()}"
-            f"|{precision_tag()}")
+    key = (f"{h.hexdigest()}|{y}|{cache_id}|{schedule_fingerprint()}"
+           f"|{precision_tag()}")
+    if model is not None:
+        key = f"{key}|{model}"
+    return key
 
 
 def _tree_bytes(value) -> int:
@@ -125,6 +131,15 @@ class ResultCache:
     `submit`, worker threads `put` at harvest; one lock covers both (the
     critical sections are dict moves, not hashing — keys are computed
     outside).
+
+    Tenant partitioning: entries live in per-tenant LRU shards (the
+    ``None`` shard is the tenant-less default and recovers the historical
+    single-LRU behavior exactly). Each live shard gets an equal slice of
+    the byte budget, a hot tenant trims its OWN shard first, and the
+    global bound evicts from the LARGEST shard — so one hot tenant can
+    never flush everyone else's working set. A tenant's `get` only sees
+    its own shard: hit/miss accounting (and the nonzero-hit-rate isolation
+    gate) is per tenant.
     """
 
     def __init__(self, max_bytes: int, *, cache_id: str = ""):
@@ -133,53 +148,90 @@ class ResultCache:
         self.max_bytes = int(max_bytes)
         self.cache_id = str(cache_id)
         self._lock = threading.Lock()
-        self._data: OrderedDict[str, tuple[object, int]] = OrderedDict()
+        # tenant (None | str) -> LRU shard of key -> (value, nbytes)
+        self._shards: dict = {None: OrderedDict()}
+        self._shard_bytes: dict = {None: 0}
         self._bytes = 0
         self.hits = 0
         self.misses = 0
         self.evictions = 0
+        self._tstats: dict = {}  # tenant -> {"hits": n, "misses": n}
 
-    def key(self, x: np.ndarray, y) -> str:
-        return result_cache_key(x, y, self.cache_id)
+    def key(self, x: np.ndarray, y, model: str | None = None) -> str:
+        return result_cache_key(x, y, self.cache_id, model=model)
 
-    def get(self, key: str):
+    def _evict_one_locked(self, tenant) -> None:
+        shard = self._shards[tenant]
+        _, (_, sz) = shard.popitem(last=False)
+        self._shard_bytes[tenant] -= sz
+        self._bytes -= sz
+        self.evictions += 1
+
+    def get(self, key: str, tenant: str | None = None):
         """The cached pytree, or None. Counts a hit or a miss — call it
         once per admission decision, not speculatively."""
         if cache_disabled():
             return None
         with self._lock:
-            entry = self._data.get(key)
+            shard = self._shards.get(tenant)
+            entry = shard.get(key) if shard is not None else None
+            if tenant is not None:
+                ts = self._tstats.setdefault(
+                    tenant, {"hits": 0, "misses": 0})
             if entry is None:
                 self.misses += 1
+                if tenant is not None:
+                    ts["misses"] += 1
                 _c_misses.inc()
                 return None
-            self._data.move_to_end(key)
+            shard.move_to_end(key)
             self.hits += 1
+            if tenant is not None:
+                ts["hits"] += 1
         _c_hits.inc()
         return entry[0]
 
-    def put(self, key: str, value) -> bool:
-        """Insert (host-side pytree), evicting LRU entries down to the byte
-        budget. A single value over the whole budget is refused (returns
-        False) instead of flushing everything for an uncacheable row."""
+    def put(self, key: str, value, tenant: str | None = None) -> bool:
+        """Insert (host-side pytree) into the tenant's shard, evicting LRU
+        entries down to the fair-share and global byte budgets. A single
+        value over the whole budget is refused (returns False) instead of
+        flushing everything for an uncacheable row."""
         if cache_disabled():
             return False
         nbytes = _tree_bytes(value)
         if nbytes > self.max_bytes:
             return False
-        evicted = 0
+        evicted0 = self.evictions
         with self._lock:
-            old = self._data.pop(key, None)
+            shard = self._shards.get(tenant)
+            if shard is None:
+                shard = self._shards[tenant] = OrderedDict()
+                self._shard_bytes[tenant] = 0
+            old = shard.pop(key, None)
             if old is not None:
+                self._shard_bytes[tenant] -= old[1]
                 self._bytes -= old[1]
-            while self._bytes + nbytes > self.max_bytes and self._data:
-                _, (_, sz) = self._data.popitem(last=False)
-                self._bytes -= sz
-                self.evictions += 1
-                evicted += 1
-            self._data[key] = (value, nbytes)
+            # fair share: every LIVE (non-empty, plus the inserting) shard
+            # gets an equal budget slice; a hot tenant evicts from its OWN
+            # shard before touching others
+            live = {t for t, s in self._shards.items() if s} | {tenant}
+            cap = self.max_bytes // len(live)
+            while self._shard_bytes[tenant] + nbytes > cap and shard:
+                self._evict_one_locked(tenant)
+            # global bound: trim the LARGEST shard (ties break arbitrarily)
+            while self._bytes + nbytes > self.max_bytes:
+                victim = max(
+                    (t for t, s in self._shards.items() if s),
+                    key=lambda t: self._shard_bytes[t], default=None)
+                if victim is None:
+                    break
+                self._evict_one_locked(victim)
+            shard[key] = (value, nbytes)
+            self._shard_bytes[tenant] += nbytes
             self._bytes += nbytes
-            nbytes_now, entries_now = self._bytes, len(self._data)
+            evicted = self.evictions - evicted0
+            nbytes_now = self._bytes
+            entries_now = sum(len(s) for s in self._shards.values())
         if evicted:
             _c_evictions.inc(evicted)
         _g_bytes.set(nbytes_now)
@@ -188,7 +240,7 @@ class ResultCache:
 
     def __len__(self) -> int:
         with self._lock:
-            return len(self._data)
+            return sum(len(s) for s in self._shards.values())
 
     @property
     def total_bytes(self) -> int:
@@ -200,17 +252,30 @@ class ResultCache:
         bench's hit-rate report)."""
         with self._lock:
             hits, misses = self.hits, self.misses
-            return {
+            out = {
                 "hits": hits,
                 "misses": misses,
                 "evictions": self.evictions,
-                "entries": len(self._data),
+                "entries": sum(len(s) for s in self._shards.values()),
                 "bytes": self._bytes,
                 "max_bytes": self.max_bytes,
                 "hit_rate": hits / (hits + misses) if hits + misses else 0.0,
                 "cache_id": self.cache_id,
                 "disabled": cache_disabled(),
             }
+            if self._tstats:
+                out["tenants"] = {
+                    str(t): {
+                        "hits": ts["hits"],
+                        "misses": ts["misses"],
+                        "hit_rate": (ts["hits"] / (ts["hits"] + ts["misses"])
+                                     if ts["hits"] + ts["misses"] else 0.0),
+                        "entries": len(self._shards.get(t, ())),
+                        "bytes": self._shard_bytes.get(t, 0),
+                    }
+                    for t, ts in sorted(self._tstats.items())
+                }
+            return out
 
     def row(self) -> dict:
         """The v2 ``result_cache`` ledger row (schema stamped by

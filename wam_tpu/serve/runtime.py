@@ -64,6 +64,21 @@ Operational semantics (DESIGN.md "Serving runtime"):
   staged batch (and its warmup zeros) to its own chip, so N servers in one
   process drive N chips concurrently instead of all landing on the default
   device (`serve.fleet.FleetServer` passes one device per replica).
+- **Multi-model residency** (``models=``, `serve.models`): the server
+  multiplexes extra models behind the same admission plane — queues,
+  in-flight accounting, EMA service times, result-cache keys, and memory
+  watermarks all key on ``(model, bucket)``; ``submit(model=...)`` pages
+  a cold model in synchronously (registry hydration + warmup under a
+  ``model_switch`` span, ``compile_count == 0`` on a warm bundle) and the
+  pager evicts idle models under the HBM budget. The default entry is
+  model ``None``: pinned, never paged, byte-identical behavior to a
+  single-model server.
+- **Tenant fairness** (``submit(tenant=)``): within each QoS lane the pop
+  round-robins across tenants (single-tenant traffic keeps exact FIFO),
+  ``tenant_quota`` caps one tenant's share of the bounded queue, and the
+  SLO ladder extends to ``bucket@class@tenant`` windows — one flooding
+  tenant cannot monopolize admission, dispatch order, or the error
+  budget accounting of the others.
 """
 
 from __future__ import annotations
@@ -84,6 +99,7 @@ from wam_tpu.obs import tracing as obs_tracing
 from wam_tpu.pipeline.stager import put_committed
 from wam_tpu.serve.buckets import Bucket, BucketTable, bucket_key, pad_item
 from wam_tpu.serve.metrics import ServeMetrics
+from wam_tpu.serve.models import ModelPager, ModelSpec
 from wam_tpu.serve.result_cache import ResultCache
 
 __all__ = [
@@ -182,17 +198,24 @@ class _Request:
     # anytime serving: per-request confidence floor for the convergence
     # early exit (0.0 = any converged delivery clears it)
     min_confidence: float = 0.0
+    model: str | None = None  # paged model id (None = the default entry)
+    tenant: str | None = None  # fair-share identity (None = untracked)
 
 
 class _Lanes:
     """One bucket's queue as two FIFO lanes (module docstring "QoS
-    lanes"). Only ever touched under the server's ``_cond``."""
+    lanes"), tenant-fair within each lane: `pop` round-robins across the
+    tenants present (FIFO within a tenant, rotating start so no tenant
+    owns slot 0), which degenerates to exact FIFO when every request
+    carries the same (or no) tenant. Only ever touched under the
+    server's ``_cond``."""
 
-    __slots__ = ("interactive", "batch")
+    __slots__ = ("interactive", "batch", "_rr")
 
     def __init__(self):
         self.interactive: list[_Request] = []
         self.batch: list[_Request] = []
+        self._rr = 0  # rotating round-robin start across tenants
 
     def __len__(self) -> int:
         return len(self.interactive) + len(self.batch)
@@ -230,15 +253,54 @@ class _Lanes:
             self.batch = [r for r in self.batch if id(r) not in gone]
         return expired
 
+    def _fair_take(self, lane: str, k: int) -> list[_Request]:
+        """Up to ``k`` requests from one lane, round-robin across the
+        tenants present (FIFO within each tenant). One tenant in the lane
+        is EXACTLY the historical FIFO slice — the fair path only engages
+        on genuinely multi-tenant traffic."""
+        reqs = getattr(self, lane)
+        if k <= 0 or not reqs:
+            return []
+        order: list = []
+        by_tenant: dict = {}
+        for r in reqs:
+            if r.tenant not in by_tenant:
+                by_tenant[r.tenant] = []
+                order.append(r.tenant)
+            by_tenant[r.tenant].append(r)
+        if len(order) <= 1:
+            take = reqs[:k]
+            del reqs[:k]
+            return take
+        start = self._rr % len(order)
+        self._rr += 1
+        order = order[start:] + order[:start]
+        take: list[_Request] = []
+        idx = dict.fromkeys(order, 0)
+        while len(take) < k:
+            progressed = False
+            for t in order:
+                if len(take) >= k:
+                    break
+                queued = by_tenant[t]
+                if idx[t] < len(queued):
+                    take.append(queued[idx[t]])
+                    idx[t] += 1
+                    progressed = True
+            if not progressed:
+                break
+        gone = set(map(id, take))
+        setattr(self, lane, [r for r in reqs if id(r) not in gone])
+        return take
+
     def pop(self, k: int) -> list[_Request]:
         """Up to ``k`` requests: the interactive lane drains first, the
-        batch lane backfills the remaining rows."""
-        take = self.interactive[:k]
-        del self.interactive[:k]
+        batch lane backfills the remaining rows; each lane drains
+        tenant-fair (`_fair_take`)."""
+        take = self._fair_take("interactive", k)
         fill = k - len(take)
         if fill > 0 and self.batch:
-            take += self.batch[:fill]
-            del self.batch[:fill]
+            take += self._fair_take("batch", fill)
         return take
 
     def clear(self) -> list[_Request]:
@@ -270,6 +332,7 @@ class _Inflight:
     # both None on a plain full-n batch
     cvec: object = None
     anytime: dict | None = None
+    model: str | None = None  # paged model id (None = the default entry)
 
 
 _NOT_READY = object()  # non-blocking _take_batch: nothing poppable yet
@@ -355,6 +418,21 @@ class AttributionServer:
     cache_id : entry/model identity baked into cache keys; defaults to the
         entry's ``__name__`` (or type name). Pass an explicit id when one
         `ResultCache` instance must distinguish entries.
+    models : extra paged models this server multiplexes
+        (`serve.models.ModelSpec` iterable or ``{model_id: spec}`` map;
+        None = single-model server, byte-identical historical behavior).
+        Each spec's entry pages in on the first ``submit(model=...)`` —
+        registry hydration + warmup under a ``model_switch`` span — and
+        pages out under the memory budget's byte bound when idle
+        (module docstring "Multi-model residency"). Paged models get no
+        degradation fallback and no anytime semantics; those stay
+        properties of the pinned default entry.
+    tenant_quota : one tenant's maximum share of ``queue_depth`` as a
+        fraction (0 = off). With it, a ``submit(tenant=...)`` whose
+        tenant already holds ``ceil(queue_depth × quota)`` queued items
+        is rejected with `QueueFullError` while other tenants (and
+        tenant-less submits) still admit — per-tenant admission
+        isolation in front of the fair lanes.
     """
 
     # checked by the lock-discipline lint rule: these attributes may only
@@ -364,6 +442,7 @@ class AttributionServer:
         "_popped": "_cond",
         "_active": "_cond",
         "_pending": "_cond",
+        "_tenant_pending": "_cond",
         "_closed": "_cond",
         "_started": "_cond",
     }
@@ -395,6 +474,8 @@ class AttributionServer:
         registry=None,
         result_cache=None,
         cache_id: str | None = None,
+        models=None,
+        tenant_quota: float = 0.0,
     ):
         if max_batch < 1:
             raise ValueError("max_batch must be >= 1")
@@ -476,16 +557,47 @@ class AttributionServer:
             # the ledger hook: ServeMetrics.emit writes the result_cache row
             self.metrics.result_cache = self._cache
 
+        # multi-model residency (serve.models): the pager owns page-in /
+        # eviction; queues and in-flight accounting key on (model, bucket)
+        # with model None = the pinned default entry
+        if models:
+            self._pager = ModelPager(
+                models,
+                budget_bytes=(self._memory.budget_bytes
+                              if self._memory is not None else None),
+                replica_id=replica_id,
+                ema_fn=self._model_ema_s,
+                busy_fn=self._model_busy,
+                retry_after_s=(self._memory.retry_after_s
+                               if self._memory is not None else 1.0))
+        else:
+            self._pager = None
+        self.tenant_quota = float(tenant_quota)
+        if not 0.0 <= self.tenant_quota <= 1.0:
+            raise ValueError(
+                f"tenant_quota must be in [0, 1], got {tenant_quota}")
+
         self._cond = threading.Condition()
-        self._queues: dict[Bucket, _Lanes] = {b: _Lanes() for b in self.table}
+        # queue/in-flight keys: (model_id | None, Bucket) — one lane pair
+        # per model × admitted bucket, precreated so the locked paths never
+        # mutate the dict structure
+        self._queues: dict[tuple, _Lanes] = {
+            (None, b): _Lanes() for b in self.table}
+        if self._pager is not None:
+            for mid, spec in self._pager.specs.items():
+                for b in self._model_buckets(spec):
+                    self._queues[(mid, b)] = _Lanes()
         # popped-but-unresolved requests: the crash guard's reach into
         # batches already taken off the queues (see _fail_pending)
         self._popped: list[_Request] = []
-        # popped-but-unfinished batches per bucket: the in-flight half of the
-        # projected drain time (queued items alone would read an actively
-        # serving replica as idle)
-        self._active: dict[Bucket, int] = {b: 0 for b in self.table}
+        # popped-but-unfinished batches per (model, bucket): the in-flight
+        # half of the projected drain time (queued items alone would read
+        # an actively serving replica as idle)
+        self._active: dict[tuple, int] = dict.fromkeys(self._queues, 0)
         self._pending = 0
+        # queued items per tenant (admission quota accounting; tenant-less
+        # submits are not tracked)
+        self._tenant_pending: dict[str, int] = {}
         self._closed = False
         self._started = False
         self._worker: threading.Thread | None = None
@@ -594,6 +706,8 @@ class AttributionServer:
             writer = JsonlWriter(self.metrics_path)
             if self.registry_report is not None:
                 writer.write(self.registry_report.row())
+            if self._pager is not None:
+                self.metrics.models_resident = self.models_resident()
             self.metrics.emit(writer, config=self.describe())
         with self._cond:
             self._started = False
@@ -627,18 +741,28 @@ class AttributionServer:
             "memory": self._memory.describe() if self._memory is not None else None,
             "registry": (getattr(self._registry, "bundle", None)
                          or (str(self._registry) if self._registry else None)),
+            "models": (self._pager.describe()
+                       if self._pager is not None else None),
+            "tenant_quota": self.tenant_quota,
         }
 
     # -- client side --------------------------------------------------------
 
     def submit(self, x, y=None, deadline_ms: float | None = None,
                qos: str = "interactive",
-               min_confidence: float = 0.0) -> Future:
+               min_confidence: float = 0.0,
+               model: str | None = None,
+               tenant: str | None = None) -> Future:
         """Enqueue one item (NO leading batch axis — a client batch is a
         sequence of submits, coalesced back together by the worker).
         ``qos`` picks the admission lane (module docstring "QoS lanes").
-        Returns a `concurrent.futures.Future` resolving to the item's
-        attribution (leading axis stripped), or raising `ServeError`.
+        ``model`` routes to a configured paged model (None = the default
+        entry), paying the synchronous page-in when it is cold. ``tenant``
+        is the request's fair-share identity: it keys the per-tenant lane
+        round-robin, the admission quota, the result-cache partition, and
+        the ``bucket@class@tenant`` SLO window. Returns a
+        `concurrent.futures.Future` resolving to the item's attribution
+        (leading axis stripped), or raising `ServeError`.
 
         On an ANYTIME server (entry built by
         `wam_tpu.anytime.make_anytime_entry`) the future resolves to an
@@ -664,39 +788,61 @@ class AttributionServer:
             if not 0.0 <= min_confidence <= 1.0:
                 raise ValueError(
                     f"min_confidence must be in [0, 1], got {min_confidence}")
+        if model is not None:
+            if self._pager is None or model not in self._pager.specs:
+                known = (sorted(self._pager.specs)
+                         if self._pager is not None else [])
+                raise ValueError(
+                    f"unknown model {model!r}; configured paged models: "
+                    f"{known}")
+            if min_confidence:
+                raise ValueError(
+                    "min_confidence is an anytime semantic of the default "
+                    "entry; paged models serve plain full-n results")
         x = np.asarray(x, self.dtype)
         bucket = self.table.select(x.shape)  # NoBucketError before any queueing
+        if model is not None and (model, bucket) not in self._queues:
+            raise ValueError(
+                f"model {model!r} does not serve bucket "
+                f"{bucket_key(bucket.shape)}")
         self.metrics.note_submit()
         ckey = None
-        if self._cache is not None and self._anytime:
+        if self._cache is not None and self._anytime and model is None:
             # anytime results are NOT cached: what a request gets back
             # depends on the batch's deadline/convergence trajectory, so a
             # cached partial would violate the bit-identical-hit contract
             pass
         elif self._cache is not None:
             # consult BEFORE admission: a hit resolves immediately and
-            # never touches the queue, memory admission, or a batch slot
-            ckey = self._cache.key(x, y)
-            hit = self._cache.get(ckey)
+            # never touches the queue, memory admission, a batch slot —
+            # or, for a cold paged model, the page-in itself
+            ckey = self._cache.key(x, y, model=model)
+            hit = self._cache.get(ckey, tenant=tenant)
             if hit is not None:
                 self.metrics.note_cache_hit()
                 fut: Future = Future()
                 fut.set_result(hit)
                 return fut
+        if model is not None:
+            # synchronous page-in on the submitting thread: the first
+            # request for a cold model pays (and measures) the switch;
+            # `MemoryAdmissionError` here is ordinary backpressure
+            self._ensure_model(model)
         if self._memory is not None:
             retry_after = self._memory.admit(
-                bucket_key(bucket.shape), self._estimate_bytes(bucket))
+                self._lkey(model, bucket), self._estimate_bytes(bucket))
             if retry_after is not None:
                 self.metrics.note_reject()
                 raise MemoryAdmissionError(
-                    retry_after, bucket=bucket_key(bucket.shape))
+                    retry_after, bucket=self._lkey(model, bucket))
         now = time.perf_counter()
         if deadline_ms is None:
             deadline = (now + self.default_deadline_s) if self.default_deadline_s else None
         else:
             deadline = now + deadline_ms / 1e3
         req = _Request(x, y, bucket, now, deadline, qos=qos, ckey=ckey,
-                       min_confidence=float(min_confidence))
+                       min_confidence=float(min_confidence),
+                       model=model, tenant=tenant)
         if obs_tracing._STATE.enabled:
             ctx = obs_tracing.current_context()
             if ctx is None:
@@ -725,16 +871,30 @@ class AttributionServer:
                 # unrelated hot bucket (the all-bucket sum stays the
                 # fleet routing signal, projected_drain_s)
                 raise QueueFullError(retry_after_s=self._drain_locked(bucket))
-            self._queues[bucket].append(req)
+            if tenant is not None and self.tenant_quota > 0.0:
+                # per-tenant admission quota: one tenant's queued share is
+                # capped, so a flooding tenant hits backpressure while the
+                # others keep admitting into the same bounded queue
+                cap = max(1, int(self.queue_depth * self.tenant_quota))
+                if self._tenant_pending.get(tenant, 0) >= cap:
+                    self.metrics.note_reject()
+                    raise QueueFullError(
+                        retry_after_s=self._drain_locked(bucket))
+            self._queues[(model, bucket)].append(req)
             self._pending += 1
+            if tenant is not None:
+                self._tenant_pending[tenant] = (
+                    self._tenant_pending.get(tenant, 0) + 1)
             self._cond.notify_all()
         return req.future
 
     def attribute(self, x, y=None, deadline_ms: float | None = None,
-                  qos: str = "interactive", min_confidence: float = 0.0):
+                  qos: str = "interactive", min_confidence: float = 0.0,
+                  model: str | None = None, tenant: str | None = None):
         """Blocking convenience wrapper: submit + wait."""
         return self.submit(x, y, deadline_ms=deadline_ms, qos=qos,
-                           min_confidence=min_confidence).result()
+                           min_confidence=min_confidence,
+                           model=model, tenant=tenant).result()
 
     # -- load signal --------------------------------------------------------
 
@@ -748,12 +908,13 @@ class AttributionServer:
         Without: the all-bucket sum — the fleet's routing score. Caller
         holds ``_cond``."""
         total = 0.0
-        for b, q in self._queues.items():
+        for (mid, b), q in self._queues.items():
             if bucket is not None and b is not bucket:
                 continue
-            n_batches = -(-len(q) // self.max_batch) + self._active[b]
+            n_batches = -(-len(q) // self.max_batch) + self._active[(mid, b)]
             if n_batches:
-                total += n_batches * self.metrics.ema_service_s(b.shape)
+                total += n_batches * self.metrics.ema_service_s(
+                    b.shape, model=mid)
         return total
 
     def projected_drain_s(self) -> float:
@@ -795,6 +956,94 @@ class AttributionServer:
         if self._slo is None:
             return 0.0
         return self._slo.penalty_s(bucket_key(bucket_shape))
+
+    # -- multi-model residency (serve.models) --------------------------------
+
+    @staticmethod
+    def _lkey(model: str | None, bucket: Bucket) -> str:
+        """Ledger/EMA/watermark key for one (model, bucket) lane: the
+        plain bucket key for the default model (every historical key is
+        preserved verbatim), ``model|bucket`` for paged models."""
+        bkey = bucket_key(bucket.shape)
+        return bkey if model is None else f"{model}|{bkey}"
+
+    def _model_buckets(self, spec: ModelSpec) -> list[Bucket]:
+        """The server buckets a spec serves: its declared subset (each
+        shape must be an admitted bucket) or every bucket."""
+        if spec.buckets is None:
+            return list(self.table)
+        out = []
+        for shape in spec.buckets:
+            shape = tuple(shape)
+            match = next((b for b in self.table if b.shape == shape), None)
+            if match is None:
+                raise ValueError(
+                    f"model {spec.model_id!r} declares bucket {shape}, "
+                    "which is not in the server's bucket table")
+            out.append(match)
+        return out
+
+    def _model_ema_s(self, model_id: str) -> float:
+        """Mean EMA batch service time across one model's buckets — the
+        pager's eviction weight (0.0 until the model served a batch)."""
+        prefix = f"{model_id}|"
+        emas = [v for k, v in self.metrics.ema_service_s().items()
+                if k.startswith(prefix)]
+        return sum(emas) / len(emas) if emas else 0.0
+
+    def _model_busy(self, model_id: str) -> bool:
+        """Does this model have queued or in-flight work? Evictions of
+        busy models are refused (`ModelPager._make_room`)."""
+        with self._cond:
+            for key, q in self._queues.items():
+                if key[0] == model_id and (len(q) or self._active[key]):
+                    return True
+        return False
+
+    def models_resident(self) -> dict[str, int]:
+        """``{model_id: footprint_bytes}`` of resident paged models — the
+        fleet heartbeat signal and the pod router's model affinity."""
+        return self._pager.resident() if self._pager is not None else {}
+
+    def _ensure_model(self, model: str) -> None:
+        """Make ``model`` resident, paying the page-in synchronously on
+        this (submit) thread — the measured model-switch latency."""
+        self._pager.ensure(model, self._page_in)
+
+    def _page_in(self, spec: ModelSpec):
+        """One model's page-in, under its build lock (`ModelPager.ensure`):
+        hydrate its registry bundle (seeded AOT executables make the
+        warmups below replays, not compiles), build the entry, and warm
+        every bucket the model serves — all inside one ``model_switch``
+        span so traces show the switch cost end-to-end. Returns
+        ``(entry, footprint_bytes)``."""
+        buckets = self._model_buckets(spec)
+        est = int(spec.est_bytes) or sum(
+            self._estimate_bytes(b) for b in buckets)
+        with obs_tracing.span(
+            "model_switch", cat="serve", model=spec.model_id,
+            replica=self.replica_id,
+        ):
+            client = None
+            if spec.registry is not None and spec.registry != "":
+                from wam_tpu.registry.client import resolve_client
+
+                client = resolve_client(spec.registry)
+            if client is not None:
+                client.hydrate()
+            entry = spec.factory()
+            for bucket in buckets:
+                with obs_sentinel.label(
+                    replica=self.replica_id,
+                    bucket=self._lkey(spec.model_id, bucket),
+                    phase="pagein",
+                ):
+                    jax.block_until_ready(entry(*self._stage_zeros(bucket)))
+                if self._memory is not None:
+                    self._memory.capture_watermark(
+                        self._lkey(spec.model_id, bucket),
+                        self._estimate_bytes(bucket))
+        return entry, est
 
     # -- worker side --------------------------------------------------------
 
@@ -858,10 +1107,24 @@ class AttributionServer:
         except Exception:
             return self._recover(xs, ys)
 
+    def _tenants_left_locked(self, reqs: list[_Request]) -> None:
+        """Release the per-tenant admission slots for requests leaving the
+        lanes (popped into a batch or expired at pop). Callers already
+        hold ``_cond``; the re-entrant acquire (Condition wraps an RLock)
+        keeps the guarded mutation lexically inside the lock."""
+        with self._cond:
+            for r in reqs:
+                if r.tenant is not None and r.tenant in self._tenant_pending:
+                    n = self._tenant_pending[r.tenant] - 1
+                    if n > 0:
+                        self._tenant_pending[r.tenant] = n
+                    else:
+                        del self._tenant_pending[r.tenant]
+
     def _take_batch(self, block: bool = True):
         """Pop a ready batch (bucket full, admission window expired,
-        deadline pressure, or draining at close). Returns ``(bucket,
-        requests, queue_depth_at_pop, expired)``, None when closed and
+        deadline pressure, or draining at close). Returns ``((model,
+        bucket), requests, queue_depth_at_pop, expired)``, None when closed and
         drained, or — with ``block=False`` — the `_NOT_READY` sentinel as
         soon as nothing is poppable *right now* (the pipelined worker uses
         this to go harvest the in-flight batch instead of sleeping on the
@@ -878,14 +1141,15 @@ class AttributionServer:
                         return _NOT_READY
                     self._cond.wait(0.05)
                     continue
-                # serve the oldest head, preferring buckets with
+                # serve the oldest head, preferring lanes with
                 # interactive work (lanes drain interactive-first)
-                bucket = min(
-                    (b for b, q in self._queues.items() if len(q)),
-                    key=lambda b: (0 if self._queues[b].interactive else 1,
-                                   self._queues[b].head().t_submit),
+                key = min(
+                    (k for k, q in self._queues.items() if len(q)),
+                    key=lambda k: (0 if self._queues[k].interactive else 1,
+                                   self._queues[k].head().t_submit),
                 )
-                q = self._queues[bucket]
+                bucket = key[1]
+                q = self._queues[key]
                 now = time.perf_counter()
                 # deadline hygiene: expiries leave the lanes BEFORE slot
                 # accounting, so they cannot displace live requests from
@@ -897,12 +1161,13 @@ class AttributionServer:
                 expired = [] if self._anytime else q.drop_expired(now)
                 if expired:
                     self._pending -= len(expired)
+                    self._tenants_left_locked(expired)
                     # crash-guard reach: until the worker fails them they
                     # live nowhere else (_fail_pending scans _popped)
                     self._popped = [r for r in self._popped
                                     if not r.future.done()]
                     self._popped.extend(expired)
-                    return bucket, [], self._pending, expired
+                    return key, [], self._pending, expired
                 head_wait = now - q.head().t_submit
                 # the admission window: coalesce_ms when set, else the
                 # historical max_wait bound (coalesce_ms=0 == old behavior)
@@ -913,7 +1178,8 @@ class AttributionServer:
                     # early release: the tightest queued deadline cannot
                     # survive sitting out the rest of the window plus one
                     # EMA batch service — go now, don't hold it to death
-                    ema = self.metrics.ema_service_s(bucket.shape)
+                    ema = self.metrics.ema_service_s(
+                        bucket.shape, model=key[0])
                     pressed = dmin - now <= (window_s - head_wait) + ema
                 if (
                     len(q) >= self.max_batch
@@ -923,13 +1189,14 @@ class AttributionServer:
                 ):
                     take = q.pop(self.max_batch)
                     self._pending -= len(take)
-                    self._active[bucket] += 1  # in flight until _finish_active
+                    self._tenants_left_locked(take)
+                    self._active[key] += 1  # in flight until _finish_active
                     # only the worker thread mutates _popped; resolved
                     # entries age out here (at most ~2 batches stay live)
                     self._popped = [r for r in self._popped
                                     if not r.future.done()]
                     self._popped.extend(take)
-                    return bucket, take, self._pending + len(take), []
+                    return key, take, self._pending + len(take), []
                 if not block:
                     return _NOT_READY
                 wait_s = window_s - head_wait
@@ -957,6 +1224,7 @@ class AttributionServer:
             self._closed = True
             reqs = [r for q in self._queues.values() for r in q.clear()]
             self._pending = 0
+            self._tenant_pending = {}
             reqs += [r for r in self._popped if not r.future.done()]
             self._popped = []
             self._cond.notify_all()
@@ -980,10 +1248,10 @@ class AttributionServer:
                 self._complete(inflight)
                 inflight = None
                 continue
-            bucket, reqs, depth, expired_at_pop = got
+            key, reqs, depth, expired_at_pop = got
             # pop-time expiries never held a take slot (_take_batch drops
             # them before slot accounting); fail them outside the lock
-            self._fail_expired(bucket, expired_at_pop)
+            self._fail_expired(key[1], expired_at_pop)
             if not reqs:
                 continue  # expiry-only wake: nothing was popped
             now = time.perf_counter()
@@ -995,13 +1263,13 @@ class AttributionServer:
                 # deadlines too (best-so-far delivery, never a drop).
                 (expired if not self._anytime and r.deadline is not None
                  and now > r.deadline else live).append(r)
-            self._fail_expired(bucket, expired)
+            self._fail_expired(key[1], expired)
             if not live:
-                self._finish_active(bucket)
+                self._finish_active(key)
                 continue
-            batch = self._launch_batch(bucket, live, depth)
+            batch = self._launch_batch(key, live, depth)
             if batch is None:  # failed at dispatch; futures already failed
-                self._finish_active(bucket)
+                self._finish_active(key)
                 continue
             if not self.pipelined:
                 self._complete(batch)
@@ -1024,19 +1292,21 @@ class AttributionServer:
         self.metrics.note_expired(len(expired))
         if self._slo is not None:
             bkey = bucket_key(bucket.shape)
-            for qos in QOS_CLASSES:
-                n = sum(1 for r in expired if r.qos == qos)
-                if n:
-                    self._slo.note_error(bkey, n, qos=qos)
+            groups: dict[tuple, int] = {}
+            for r in expired:
+                groups[(r.qos, r.tenant)] = groups.get((r.qos, r.tenant), 0) + 1
+            for (qos, tenant), n in groups.items():
+                self._slo.note_error(bkey, n, qos=qos, tenant=tenant)
 
-    def _finish_active(self, bucket: Bucket) -> None:
+    def _finish_active(self, key: tuple) -> None:
         with self._cond:
-            self._active[bucket] -= 1
+            self._active[key] -= 1
 
-    def _launch_batch(self, bucket: Bucket, live: list[_Request], depth: int):
+    def _launch_batch(self, key: tuple, live: list[_Request], depth: int):
         """Assemble the padded host batch, stage it to the device (async
         upload, committed to this server's device when pinned), and
         dispatch the entry WITHOUT harvesting the result."""
+        mid, bucket = key
         n_real = len(live)
         with self.metrics.stages.stage("assemble"):
             xs = np.stack([pad_item(r.x, bucket) for r in live])
@@ -1059,13 +1329,14 @@ class AttributionServer:
         hvec = None
         cvec = None
         anytime_info = None
+        entry = self._entry if mid is None else self._pager.entry(mid)
         try:
             with obs_sentinel.label(
                 replica=self.replica_id,
-                bucket=bucket_key(bucket.shape),
+                bucket=self._lkey(mid, bucket),
                 phase="serve",
             ), self.metrics.stages.stage("dispatch"):
-                if self._anytime:
+                if self._anytime and mid is None:
                     # progressive refinement: drive the begin/step/finalize
                     # stride loop (`anytime.driver` — the shared policy).
                     # Batch policy over the LIVE rows only (pad rows
@@ -1081,10 +1352,15 @@ class AttributionServer:
                         min_confidence=max(
                             (r.min_confidence for r in live), default=0.0),
                         n_rows=n_real)
-                else:
+                elif mid is None:
                     out = self._call_entry(*staged)
+                else:
+                    # paged-model dispatch: the model's own compiled entry,
+                    # no fallback/degradation ladder (those are properties
+                    # of the default entry)
+                    out = entry(*staged)
                 if self._health is not None:
-                    if getattr(self._entry, "wam_health", False):
+                    if getattr(entry, "wam_health", False):
                         # fused entry: the vector is a leaf of the same
                         # compiled program
                         out, hvec = out
@@ -1095,6 +1371,8 @@ class AttributionServer:
                         hvec = obs_health.batch_stats(out)
         except Exception:
             try:
+                if mid is not None:
+                    raise  # no fallback entry for paged models
                 out = self._recover(xs, ys)  # already host-side on success
                 hvec = None
             except Exception as e:
@@ -1109,7 +1387,7 @@ class AttributionServer:
                             self._slo.note_error(bkey, k, qos=qos)
                 return None
         return _Inflight(bucket, live, depth, xs, ys, t0, out, hvec,
-                         cvec=cvec, anytime=anytime_info)
+                         cvec=cvec, anytime=anytime_info, model=mid)
 
     def _complete(self, batch: _Inflight):
         """Harvest an in-flight batch (block on the device result — where
@@ -1146,6 +1424,8 @@ class AttributionServer:
                         hvec_host = None
             except Exception:
                 try:
+                    if batch.model is not None:
+                        raise  # no fallback entry for paged models
                     out = self._recover(batch.xs, batch.ys)
                     hvec_host = None
                     # the fallback entry is a plain full-n one: replayed
@@ -1198,7 +1478,7 @@ class AttributionServer:
                         # float rounding differs from the accelerator's,
                         # and mixing provenances would break the
                         # bit-identical-hit contract
-                        self._cache.put(r.ckey, row)
+                        self._cache.put(r.ckey, row, tenant=r.tenant)
                     r.future.set_result(row)
             if obs_tracing._STATE.enabled:
                 # retroactive per-request phases: the worker only knows a
@@ -1224,6 +1504,8 @@ class AttributionServer:
                 queue_waits_s=[batch.t0 - r.t_submit for r in live],
                 latencies_s=latencies_s,
                 qos=[r.qos for r in live],
+                model_id=batch.model,
+                tenants=[r.tenant for r in live],
             )
             if batch.anytime is not None:
                 self.metrics.note_anytime(
@@ -1238,7 +1520,7 @@ class AttributionServer:
                 for i, (r, lat) in enumerate(zip(live, latencies_s)):
                     self._slo.note(
                         bkey, latency_s=lat, ok=True, healthy=healthy,
-                        qos=r.qos,
+                        qos=r.qos, tenant=r.tenant,
                         confidence=confidences[i] if confidences else 1.0)
         finally:
-            self._finish_active(batch.bucket)
+            self._finish_active((batch.model, batch.bucket))

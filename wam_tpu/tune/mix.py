@@ -69,6 +69,7 @@ class BucketObservation:
 
     key: str
     shape: tuple
+    model_id: str | None = None  # paged-model identity, None = default entry
     batches: int = 0
     items: int = 0  # total real rows served
     per_item_s: list = dataclasses.field(default_factory=list)
@@ -101,6 +102,9 @@ class WorkloadMix:
     buckets: dict  # bucket key -> BucketObservation
     qos: dict  # class -> items (aggregate across buckets)
     fingerprints: dict  # schedule fingerprint -> batches observed under it
+    # tenant -> items (aggregate), mined from round-20 per-batch tenant
+    # counts; empty for pre-round-20 ledgers (the field is absent there)
+    tenants: dict = dataclasses.field(default_factory=dict)
 
     @property
     def total_items(self) -> int:
@@ -130,6 +134,7 @@ class WorkloadMix:
             "total_items": self.total_items,
             "qos": dict(self.qos),
             "fingerprints": dict(self.fingerprints),
+            "tenants": dict(self.tenants),
             "buckets": {
                 k: {
                     "batches": b.batches,
@@ -138,6 +143,7 @@ class WorkloadMix:
                     "mean_per_item_s": round(b.mean_per_item_s, 6),
                     "mean_batch": round(b.mean_batch, 2),
                     "qos": dict(b.qos),
+                    **({"model_id": b.model_id} if b.model_id else {}),
                 }
                 for (k, b), w in zip(sorted(self.buckets.items()),
                                      (self.weights()[k]
@@ -164,12 +170,20 @@ def mine_rows(rows: list, *, source: str = "<rows>", corrupt: int = 0,
     buckets: dict[str, BucketObservation] = {}
     qos_total: dict[str, int] = {}
     fingerprints: dict[str, int] = {}
+    tenants_total: dict[str, int] = {}
     for r in sorted(batches, key=lambda r: r["timestamp"]):
         shape = tuple(int(d) for d in r.get("bucket", ()))
         key = "x".join(str(d) for d in shape) if shape else "-"
+        # paged-model batches mine under model-qualified keys (the serve
+        # EMA convention, "model|bucket") so one model's service times
+        # never pollute another's drift baseline on a shared fleet
+        mid = r.get("model_id")
+        if mid:
+            key = f"{mid}|{key}"
         obs = buckets.get(key)
         if obs is None:
-            obs = buckets[key] = BucketObservation(key=key, shape=shape)
+            obs = buckets[key] = BucketObservation(key=key, shape=shape,
+                                                   model_id=mid)
         n = int(r["n_real"])
         obs.batches += 1
         obs.items += n
@@ -181,13 +195,15 @@ def mine_rows(rows: list, *, source: str = "<rows>", corrupt: int = 0,
         for cls, cnt in (r.get("qos") or {}).items():
             obs.qos[cls] = obs.qos.get(cls, 0) + int(cnt)
             qos_total[cls] = qos_total.get(cls, 0) + int(cnt)
+        for tenant, cnt in (r.get("tenants") or {}).items():
+            tenants_total[tenant] = tenants_total.get(tenant, 0) + int(cnt)
         fp = r.get("schedule_fingerprint")
         if fp:
             fingerprints[fp] = fingerprints.get(fp, 0) + 1
     return WorkloadMix(source=source, rows=len(batches),
                        corrupt_lines=corrupt, window=(earliest, latest),
                        buckets=buckets, qos=qos_total,
-                       fingerprints=fingerprints)
+                       fingerprints=fingerprints, tenants=tenants_total)
 
 
 def mine_ledger(path: str, *, window_s: float | None = None) -> WorkloadMix | None:
